@@ -1,0 +1,195 @@
+"""ray_tpu: a TPU-native distributed ML framework with Ray-level capabilities.
+
+Public core API parity: python/ray/_private/worker.py — init (:1186),
+get (:2506), put (:2621), wait (:2684), remote (:3016), shutdown (:1732),
+get_actor (:2805), kill, cancel, cluster_resources, nodes.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+__version__ = "0.1.0"
+
+from . import exceptions  # noqa: F401
+from .actor import ActorClass, ActorHandle
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+from ._private.config import GLOBAL_CONFIG
+from ._private.worker import global_worker
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorHandle",
+    "get_runtime_context",
+    "method",
+]
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: str = "",
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
+    **_kwargs,
+):
+    """Start (or connect to) a ray_tpu runtime."""
+    if global_worker.connected:
+        if ignore_reinit_error:
+            return _ctx()
+        raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
+    if _system_config:
+        GLOBAL_CONFIG.apply(_system_config)
+    from ._private.node import Node, default_resources
+
+    node = Node(default_resources(num_cpus, num_tpus, resources))
+    global_worker.connect_driver(node, namespace=namespace)
+    return _ctx()
+
+
+def _ctx():
+    return {
+        "session_dir": global_worker.session_dir,
+        "node_id": global_worker.node_id,
+    }
+
+
+def shutdown():
+    if global_worker.node is not None:
+        global_worker.node.stop()
+    global_worker.node = None
+    global_worker.disconnect()
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def remote(*args, **options):
+    """Decorate a function as a remote task or a class as an actor."""
+
+    def decorator(fn_or_cls):
+        if inspect.isclass(fn_or_cls):
+            return ActorClass(fn_or_cls, **options)
+        return RemoteFunction(fn_or_cls, **options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return decorator(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return decorator
+
+
+def method(num_returns: int = 1):
+    """Decorator to annotate actor methods (e.g. multiple returns)."""
+
+    def decorator(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+
+    return decorator
+
+
+def get(object_refs, *, timeout: Optional[float] = None):
+    return global_worker.get(object_refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    return global_worker.put(value)
+
+
+def wait(
+    object_refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    return global_worker.wait(
+        object_refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    global_worker.request(
+        {"t": "kill_actor", "actor_id": actor._actor_id, "no_restart": no_restart}
+    )
+
+
+def cancel(object_ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    # Round-1: cancellation of queued (not yet running) tasks only.
+    global_worker.send({"t": "cancel_task", "task_id": object_ref.task_id()})
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    info = global_worker.request(
+        {
+            "t": "get_named_actor",
+            "name": name,
+            "namespace": namespace if namespace is not None else global_worker.namespace,
+        }
+    )
+    meta = info["spec_meta"]
+    return ActorHandle(info["actor_id"], meta.get("method_names"), meta.get("cls_name") or "")
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker.request({"t": "cluster_resources"})["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker.request({"t": "cluster_resources"})["available"]
+
+
+def nodes() -> List[dict]:
+    return global_worker.request({"t": "nodes"})
+
+
+class RuntimeContext:
+    @property
+    def node_id(self):
+        return global_worker.node_id
+
+    @property
+    def job_id(self):
+        return global_worker.job_id
+
+    @property
+    def task_id(self):
+        return global_worker.current_task_id
+
+    @property
+    def actor_id(self):
+        return global_worker.current_actor_id
+
+    @property
+    def namespace(self):
+        return global_worker.namespace
+
+    def get_actor_id(self):
+        return global_worker.current_actor_id
+
+    def get_node_id(self):
+        return global_worker.node_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -1,0 +1,98 @@
+"""Flag/config system.
+
+Reference parity: src/ray/common/ray_config_def.h — a table of typed,
+env-overridable flags (RAY_<name>). Here: one dataclass-like registry,
+overridable via RAY_TPU_<NAME> env vars and `init(_system_config=...)`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: Callable, default: Any, doc: str = ""):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """Global config registry. Values resolve in order:
+    programmatic override > RAY_TPU_<NAME> env var > default."""
+
+    _FLAGS: Dict[str, _Flag] = {}
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    @classmethod
+    def define(cls, name: str, type_: Callable, default: Any, doc: str = ""):
+        cls._FLAGS[name] = _Flag(name, type_, default, doc)
+
+    def get(self, name: str):
+        flag = self._FLAGS[name]
+        if name in self._overrides:
+            return self._overrides[name]
+        env_name = "RAY_TPU_" + name.upper()
+        if env_name in os.environ:
+            raw = os.environ[env_name]
+            if flag.type is bool:
+                return _parse_bool(raw)
+            return flag.type(raw)
+        return flag.default
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def apply(self, overrides: Dict[str, Any]):
+        for k, v in overrides.items():
+            if k not in self._FLAGS:
+                raise ValueError(f"Unknown config flag: {k}")
+            self._overrides[k] = self._FLAGS[k].type(v) if not isinstance(v, bool) else v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {k: self.get(k) for k in self._FLAGS}
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+D = Config.define
+# --- core runtime ---
+D("raylet_heartbeat_period_ms", int, 1000, "worker->head heartbeat period")
+D("health_check_period_ms", int, 1000, "head-side liveness check period")
+D("health_check_failure_threshold", int, 5, "missed heartbeats before a worker is dead")
+D("worker_register_timeout_s", float, 30.0, "max wait for a spawned worker to register")
+D("task_retry_delay_ms", int, 100, "delay before retrying a failed task")
+D("max_pending_lease_requests", int, 1024)
+D("object_inline_limit_bytes", int, 128 * 1024, "objects <= this ride the control socket; larger go to shm")
+D("shm_store_bytes", int, 2 * 1024**3, "capacity of the C++ shared-memory object store")
+D("shm_store_enabled", bool, True)
+D("get_poll_timeout_s", float, 0.2)
+D("actor_restart_delay_ms", int, 100)
+D("worker_pool_prestart", int, 0, "workers to prestart per node at init")
+D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
+D("log_to_driver", bool, True)
+D("session_dir_root", str, "/tmp/ray_tpu")
+# --- TPU ---
+D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
+D("mesh_dryrun_platform", str, "cpu")
+
+GLOBAL_CONFIG = Config()

@@ -1,0 +1,69 @@
+"""Node: starts and supervises the in-driver head service.
+
+Reference parity: python/ray/_private/node.py (Node.start_head_processes) —
+but where the reference spawns separate gcs_server/raylet daemons, ray_tpu
+hosts the head service on a background asyncio thread of the driver process
+(see head.py for why this is the right shape on a TPU host).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import time
+import uuid
+from typing import Dict, Optional
+
+from .config import GLOBAL_CONFIG as cfg
+from .head import Head
+from .worker import EventLoopThread
+
+
+def detect_tpu_chips() -> int:
+    """Count local TPU chips without importing jax (device files on TPU VMs)."""
+    n = len(glob.glob("/dev/accel*"))
+    if n:
+        return n
+    if os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS"):
+        try:
+            return int(os.environ["TPU_CHIPS_PER_HOST_BOUNDS"].split(",")[-1])
+        except ValueError:
+            pass
+    return 0
+
+
+def default_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    tpus = num_tpus if num_tpus is not None else detect_tpu_chips()
+    if tpus:
+        out["TPU"] = float(tpus)
+    out["memory"] = float(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+    out["node:__internal_head__"] = 1.0
+    if resources:
+        out.update({k: float(v) for k, v in resources.items()})
+    return out
+
+
+class Node:
+    def __init__(self, resources: Dict[str, float]):
+        self.session_id = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+        self.session_dir = os.path.join(cfg.session_dir_root, self.session_id)
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.socket_path = os.path.join(self.session_dir, "head.sock")
+        self.io = EventLoopThread()
+        self.head = Head(self.session_dir, resources)
+        self.io.run(self.head.start())
+        self._stopped = False
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            self.io.run(self.head.stop(), timeout=10)
+        except Exception:
+            pass
+        self.io.stop()
+        shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -1,0 +1,62 @@
+"""Option validation and resource resolution.
+
+Reference parity: python/ray/_private/ray_option_utils.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_COMMON_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "num_gpus",
+    "resources",
+    "name",
+    "num_returns",
+    "max_retries",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "scheduling_strategy",
+    "namespace",
+    "lifetime",
+    "runtime_env",
+    "memory",
+}
+
+
+def validate_options(opts: Dict[str, Any]):
+    unknown = set(opts) - _COMMON_OPTIONS
+    if unknown:
+        raise ValueError(f"Unknown options: {sorted(unknown)}")
+    if "resources" in opts and opts["resources"] is not None:
+        res = opts["resources"]
+        if not isinstance(res, dict):
+            raise TypeError("resources must be a dict")
+        for k in ("CPU", "TPU", "GPU"):
+            if k in res:
+                raise ValueError(
+                    f"Use num_{k.lower()}s instead of resources={{'{k}': ...}}"
+                )
+    return opts
+
+
+def resolve_task_resources(opts: Dict[str, Any], is_actor: bool) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    num_cpus = opts.get("num_cpus")
+    if num_cpus is None:
+        # tasks default to 1 CPU; actors to 0 (they mostly wait on I/O or own
+        # the TPU explicitly) — matches the reference's defaults.
+        num_cpus = 0 if is_actor else 1
+    if num_cpus:
+        res["CPU"] = float(num_cpus)
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    return res

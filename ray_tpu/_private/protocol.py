@@ -1,0 +1,142 @@
+"""Control-plane wire protocol: length-prefixed pickled dicts over unix sockets.
+
+Reference parity: src/ray/rpc (GrpcServer/GrpcClient) + src/ray/protobuf.
+The reference uses gRPC because its control plane spans hosts and languages;
+here the intra-host control plane is asyncio over unix domain sockets (the
+multi-host plane in ray_tpu rides the same framing over TCP). Bulk data never
+rides this socket — it goes through the shared-memory object plane.
+
+Message = dict with "t" (type). Requests carry "rid"; replies are
+{"t": "reply", "rid", "ok", "value"|"error"}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+_LEN = struct.Struct("<Q")
+MAX_MSG = 1 << 40
+
+
+async def read_msg(reader: asyncio.StreamReader) -> dict:
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_MSG:
+        raise ConnectionError(f"oversized frame: {n}")
+    body = await reader.readexactly(n)
+    return pickle.loads(body)
+
+
+def _frame(msg: dict) -> bytes:
+    body = pickle.dumps(msg, protocol=5)
+    return _LEN.pack(len(body)) + body
+
+
+async def send_msg(writer: asyncio.StreamWriter, msg: dict) -> None:
+    writer.write(_frame(msg))
+    await writer.drain()
+
+
+class Connection:
+    """A bidirectional message channel with request/response correlation.
+
+    Both sides can issue requests and receive pushes. `handler(msg)` is called
+    for every inbound non-reply message; if the message has a "rid", the
+    handler's return value (or raised exception) is sent back as the reply.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Callable[[dict], Awaitable[Any]],
+        on_close: Optional[Callable[[], Awaitable[None]]] = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.on_close = on_close
+        self._rid_counter = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._reader_task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+        return self
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await read_msg(self.reader)
+                if msg.get("t") == "reply":
+                    fut = self._pending.pop(msg["rid"], None)
+                    if fut is not None and not fut.done():
+                        if msg["ok"]:
+                            fut.set_result(msg.get("value"))
+                        else:
+                            fut.set_exception(msg["error"])
+                else:
+                    asyncio.get_running_loop().create_task(self._dispatch(msg))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await self._close()
+
+    async def _dispatch(self, msg: dict):
+        rid = msg.get("rid")
+        try:
+            result = await self.handler(msg)
+            if rid is not None:
+                await self.send({"t": "reply", "rid": rid, "ok": True, "value": result})
+        except Exception as e:  # noqa: BLE001 - errors propagate to the peer
+            if rid is not None:
+                try:
+                    await self.send({"t": "reply", "rid": rid, "ok": False, "error": e})
+                except Exception:
+                    pass
+
+    async def send(self, msg: dict):
+        async with self._send_lock:
+            self.writer.write(_frame(msg))
+            await self.writer.drain()
+
+    async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        rid = next(self._rid_counter)
+        msg = dict(msg, rid=rid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        await self.send(msg)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    async def _close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("connection closed"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            await self.on_close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def close(self):
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        await self._close()

@@ -1,0 +1,79 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Reference parity: python/ray/_private/serialization.py (SerializationContext,
+serialize/deserialize_objects) — large binary buffers (numpy, jax host arrays)
+are extracted out-of-band so they can ride the shared-memory object store with
+zero copies instead of the control socket.
+
+ObjectRefs contained in a value are collected during pickling (thread-local
+collector wired into ObjectRef.__reduce__) so the runtime can track ownership
+and resolve dependencies — the analogue of Ray's contained-object-ID scan.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import cloudpickle
+
+_INLINE_BUFFER_LIMIT = 8 * 1024  # buffers below this are folded in-band
+
+
+class _RefCollector(threading.local):
+    def __init__(self):
+        self.active: Optional[list] = None
+
+
+_ref_collector = _RefCollector()
+
+
+def record_contained_ref(ref) -> None:
+    """Called from ObjectRef.__reduce__ during pickling."""
+    if _ref_collector.active is not None:
+        _ref_collector.active.append(ref)
+
+
+@dataclass
+class SerializedObject:
+    """A picklable envelope: payload + out-of-band buffers + contained refs."""
+
+    payload: bytes
+    buffers: List[bytes] = field(default_factory=list)
+    contained_refs: List[Any] = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        return len(self.payload) + sum(len(b) for b in self.buffers)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    refs: list = []
+    prev = _ref_collector.active
+    _ref_collector.active = refs
+    try:
+        def _cb(buf: pickle.PickleBuffer):
+            raw = buf.raw()
+            if raw.nbytes <= _INLINE_BUFFER_LIMIT:
+                return True  # keep in-band
+            buffers.append(buf)
+            return False
+
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=_cb)
+    finally:
+        _ref_collector.active = prev
+    out = [bytes(b.raw()) for b in buffers]
+    # Dedup refs by id while preserving order.
+    seen = set()
+    uniq = []
+    for r in refs:
+        if r.id not in seen:
+            seen.add(r.id)
+            uniq.append(r)
+    return SerializedObject(payload=payload, buffers=out, contained_refs=uniq)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    return pickle.loads(obj.payload, buffers=obj.buffers)

@@ -1,0 +1,404 @@
+"""CoreWorker-lite: the per-process runtime shared by driver and workers.
+
+Reference parity: src/ray/core_worker/core_worker.h:284 (CoreWorker) +
+python/ray/_private/worker.py (global Worker singleton, connect/get/put/wait).
+One instance per process; owns the control-plane connection, the ObjectRef
+reference counting hooks, and task/actor submission. Unlike the reference
+there is no separate in-process C++ library — the hot compute path on TPU is
+a single compiled XLA program, so the orchestration runtime stays in Python
+with the bulk-data plane (shared-memory store) in C++.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import hashlib
+import os
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from .. import exceptions
+from . import protocol, serialization
+from .config import GLOBAL_CONFIG as cfg
+from .ids import ActorID, JobID, ObjectID, TaskID
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+
+class EventLoopThread:
+    """A background thread running an asyncio loop, with sync bridges."""
+
+    def __init__(self, name="ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def post(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+@dataclass
+class _ArgRef:
+    """Placeholder for a top-level ObjectRef argument (replaced by its value
+    at execution; nested refs stay refs — reference semantics)."""
+
+    object_id: str
+
+
+class Worker:
+    """The global per-process runtime."""
+
+    def __init__(self):
+        self.mode: Optional[str] = None
+        self.connected = False
+        self.job_id = JobID.from_int(os.getpid() % (2**31))
+        self.node_id: Optional[str] = None
+        self.session_dir: Optional[str] = None
+        self.io: Optional[EventLoopThread] = None
+        self.conn: Optional[protocol.Connection] = None
+        self.node = None  # driver-only: the Node supervisor
+        self._fn_exported: Dict[str, bool] = {}
+        self.current_actor = None
+        self.current_actor_id: Optional[str] = None
+        self.current_task_id: Optional[str] = None
+        self.namespace: str = ""
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # connection
+    # ------------------------------------------------------------------
+
+    def connect_driver(self, node, namespace: str = ""):
+        self.mode = MODE_DRIVER
+        self.node = node
+        self.io = node.io
+        self.session_dir = node.session_dir
+        self.namespace = namespace
+        self.conn = self.io.run(self._open_conn(node.socket_path))
+        info = self.request({"t": "register_driver"})
+        self.node_id = info["node_id"]
+        self.connected = True
+
+    def connect_worker(self, socket_path: str, worker_id: str, io: EventLoopThread, conn):
+        self.mode = MODE_WORKER
+        self.io = io
+        self.conn = conn
+        self.connected = True
+
+    async def _open_conn(self, socket_path: str) -> protocol.Connection:
+        reader, writer = await asyncio.open_unix_connection(socket_path)
+
+        async def handler(msg):
+            return await self._handle_push(msg)
+
+        conn = protocol.Connection(reader, writer, handler)
+        conn.start()
+        return conn
+
+    async def _handle_push(self, msg):
+        raise ValueError(f"driver got unexpected message {msg.get('t')}")
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        if not self.conn or self.conn.closed:
+            raise exceptions.RayTpuError("ray_tpu is not connected (call ray_tpu.init())")
+        return self.io.run(self.conn.request(msg, timeout))
+
+    def send(self, msg: dict):
+        if self.conn is None or self.conn.closed or self.io is None:
+            return
+        try:
+            self.io.post(self.conn.send(msg))
+        except RuntimeError:
+            pass  # loop shut down
+
+    def disconnect(self):
+        self.connected = False
+        self.mode = None
+        self.conn = None
+
+    # ------------------------------------------------------------------
+    # refcounting (reference_count.h:61 — simplified owner-side counting)
+    # ------------------------------------------------------------------
+
+    def add_object_ref(self, object_id: str):
+        if self.connected:
+            self.send({"t": "add_refs", "counts": {object_id: 1}})
+
+    def remove_object_ref(self, object_id: str):
+        if self.connected:
+            self.send({"t": "remove_refs", "counts": {object_id: 1}})
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> "ObjectRef":
+        from ..object_ref import ObjectRef
+
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        oid = ObjectID.from_put(self.job_id).hex()
+        env = serialization.serialize(value)
+        self.request({"t": "put_object", "object_id": oid, "envelope": env, "initial_refs": 1})
+        return ObjectRef(oid, skip_adding_local_ref=True)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        from ..object_ref import ObjectRef
+
+        is_single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if is_single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        envs = self.request(
+            {"t": "get_objects", "object_ids": [r.id for r in ref_list], "timeout": timeout}
+        )
+        values = []
+        for env in envs:
+            value = serialization.deserialize(env)
+            if getattr(env, "is_error", False):
+                raise value
+            values.append(value)
+        return values[0] if is_single else values
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        from ..object_ref import ObjectRef
+
+        refs = list(refs)
+        if len(set(r.id for r in refs)) != len(refs):
+            raise ValueError("wait() expects a list of unique ObjectRefs.")
+        if num_returns > len(refs):
+            raise ValueError("num_returns cannot exceed the number of refs")
+        ready_ids, pending_ids = self.request(
+            {
+                "t": "wait_objects",
+                "object_ids": [r.id for r in refs],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            }
+        )
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in pending_ids]
+
+    # ------------------------------------------------------------------
+    # tasks
+    # ------------------------------------------------------------------
+
+    def _export_callable(self, obj, ns: str) -> str:
+        blob = cloudpickle.dumps(obj)
+        key = hashlib.sha1(blob).hexdigest()
+        with self._lock:
+            if key not in self._fn_exported:
+                self.request({"t": "kv_put", "ns": ns, "key": key, "value": blob, "overwrite": False})
+                self._fn_exported[key] = True
+        return key
+
+    def _prepare_args(self, args: tuple, kwargs: dict):
+        """Replace top-level ObjectRefs with _ArgRef markers; collect deps."""
+        from ..object_ref import ObjectRef
+
+        deps: List[str] = []
+
+        def conv(a):
+            if isinstance(a, ObjectRef):
+                deps.append(a.id)
+                return _ArgRef(a.id)
+            return a
+
+        new_args = tuple(conv(a) for a in args)
+        new_kwargs = {k: conv(v) for k, v in kwargs.items()}
+        env = serialization.serialize((new_args, new_kwargs))
+        # nested refs found during pickling are deps too (must exist at exec)
+        for r in env.contained_refs:
+            deps.append(r.id)
+        return env, sorted(set(deps))
+
+    def submit_task(
+        self,
+        function,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: int = 0,
+        scheduling_strategy=None,
+        runtime_env: Optional[dict] = None,
+    ) -> List["ObjectRef"]:
+        from ..object_ref import ObjectRef
+
+        fn_key = self._export_callable(function, "fn")
+        task_id = TaskID.for_task(self.job_id)
+        return_ids = [ObjectID.for_return(task_id, i).hex() for i in range(num_returns)]
+        env, deps = self._prepare_args(args, kwargs)
+        spec = {
+            "task_id": task_id.hex(),
+            "name": name,
+            "fn_key": fn_key,
+            "args": env,
+            "deps": deps,
+            "return_ids": return_ids,
+            "resources": resources,
+            "max_retries": max_retries,
+            "scheduling_strategy": scheduling_strategy,
+            "runtime_env": runtime_env,
+        }
+        # head takes the initial +1 on each return id at submit time
+        self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
+        self.request({"t": "submit_task", "spec": spec})
+        return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: Optional[str] = None,
+        namespace: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        scheduling_strategy=None,
+        lifetime: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> str:
+        cls_key = self._export_callable(cls, "cls")
+        actor_id = ActorID.of(self.job_id).hex()
+        env, deps = self._prepare_args(args, kwargs)
+        spec = {
+            "actor_id": actor_id,
+            "cls_key": cls_key,
+            "cls_name": getattr(cls, "__name__", str(cls)),
+            "args": env,
+            "deps": deps,
+            "name": name,
+            "namespace": namespace if namespace is not None else self.namespace,
+            "resources": resources,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "scheduling_strategy": scheduling_strategy,
+            "lifetime": lifetime,
+            "runtime_env": runtime_env,
+        }
+        self.request({"t": "create_actor", "spec": spec})
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+    ) -> List["ObjectRef"]:
+        from ..object_ref import ObjectRef
+
+        task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
+        return_ids = [ObjectID.for_return(task_id, i).hex() for i in range(num_returns)]
+        env, deps = self._prepare_args(args, kwargs)
+        spec = {
+            "task_id": task_id.hex(),
+            "actor_id": actor_id,
+            "method": method,
+            "args": env,
+            "deps": deps,
+            "return_ids": return_ids,
+        }
+        self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
+        self.request({"t": "submit_actor_task", "spec": spec})
+        return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]
+
+
+global_worker = Worker()
+
+
+# --------------------------------------------------------------------------
+# task execution (the worker side of run_task)
+# --------------------------------------------------------------------------
+
+
+def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
+    env: serialization.SerializedObject = args_msg["env"]
+    resolved: Dict[str, serialization.SerializedObject] = args_msg["resolved"]
+    args, kwargs = serialization.deserialize(env)
+
+    def conv(a):
+        if isinstance(a, _ArgRef):
+            dep_env = resolved.get(a.object_id)
+            if dep_env is None:
+                raise exceptions.ObjectLostError(a.object_id)
+            value = serialization.deserialize(dep_env)
+            if getattr(dep_env, "is_error", False):
+                raise value
+            return value
+        return a
+
+    args = tuple(conv(a) for a in args)
+    kwargs = {k: conv(v) for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def execute_and_package(fn, fn_name: str, args_msg: dict, return_ids: List[str]) -> dict:
+    """Run a task function and package results as envelopes.
+
+    Reference: _raylet.pyx:1630 execute_task_with_cancellation_handler.
+    """
+    try:
+        args, kwargs = resolve_task_args(args_msg)
+        result = fn(*args, **kwargs)
+        n = len(return_ids)
+        if n == 0:
+            return {"results": []}
+        if n == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != n:
+                raise ValueError(
+                    f"Task {fn_name} set num_returns={n} but returned {len(values)} values"
+                )
+        return {"results": [serialization.serialize(v) for v in values]}
+    except Exception as e:  # noqa: BLE001
+        tb = traceback.format_exc()
+        if isinstance(e, (exceptions.TaskError, exceptions.ActorError)):
+            err: Exception = e
+        else:
+            err = exceptions.TaskError(fn_name, tb, e)
+        env = serialization.serialize(err)
+        env.is_error = True  # type: ignore[attr-defined]
+        return {"results": [env for _ in return_ids] or [env]}
+
+
+@atexit.register
+def _shutdown_at_exit():
+    w = global_worker
+    if w.mode == MODE_DRIVER and w.node is not None:
+        try:
+            w.node.stop()
+        except Exception:
+            pass

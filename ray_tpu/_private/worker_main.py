@@ -1,0 +1,151 @@
+"""Worker process entrypoint.
+
+Reference parity: python/ray/_private/workers/default_worker.py + the
+execution upcall path _raylet.pyx:1791 (task_execution_handler). Spawned by
+the head's worker pool; connects back over the session unix socket, registers,
+then serves run_task/start_actor requests. Task bodies run on executor
+threads so the protocol loop stays responsive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import sys
+import threading
+
+import cloudpickle
+
+from . import protocol
+from .worker import (
+    EventLoopThread,
+    Worker,
+    execute_and_package,
+    global_worker,
+)
+
+
+class WorkerServer:
+    def __init__(self, socket_path: str, worker_id: str, node_id: str):
+        self.socket_path = socket_path
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn: protocol.Connection = None  # type: ignore
+        self._fn_cache = {}
+        self._cls_cache = {}
+        self.actor_instance = None
+        self.actor_id = None
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec"
+        )
+        self._loop: asyncio.AbstractEventLoop = None  # type: ignore
+
+    async def run(self):
+        self._loop = asyncio.get_running_loop()
+        reader, writer = await asyncio.open_unix_connection(self.socket_path)
+        self.conn = protocol.Connection(reader, writer, self.handle)
+        self.conn.start()
+
+        # Wire the in-process global worker so user task code can call
+        # ray_tpu.get/put/remote from inside tasks.
+        io = EventLoopThread.__new__(EventLoopThread)
+        io.loop = self._loop
+        io.thread = threading.current_thread()
+        global_worker.connect_worker(self.socket_path, self.worker_id, io, self.conn)
+
+        await self.conn.request(
+            {
+                "t": "register_worker",
+                "worker_id": self.worker_id,
+                "pid": os.getpid(),
+                "node_id": self.node_id,
+            }
+        )
+        # serve until the connection dies
+        while not self.conn.closed:
+            await asyncio.sleep(0.2)
+
+    async def handle(self, msg):
+        t = msg["t"]
+        if t == "run_task":
+            return await self._run_task(msg)
+        if t == "start_actor":
+            return await self._start_actor(msg)
+        if t == "ping":
+            return "pong"
+        if t == "shutdown":
+            self._loop.call_soon(sys.exit, 0)
+            return True
+        raise ValueError(f"worker got unknown message {t!r}")
+
+    async def _fetch_blob(self, ns: str, key: str, cache: dict):
+        if key in cache:
+            return cache[key]
+        blob = await self.conn.request({"t": "kv_get", "ns": ns, "key": key})
+        if blob is None:
+            raise RuntimeError(f"function/class {key} not found in KV")
+        obj = cloudpickle.loads(blob)
+        cache[key] = obj
+        return obj
+
+    async def _start_actor(self, msg):
+        cls = await self._fetch_blob("cls", msg["cls_key"], self._cls_cache)
+        self.actor_id = msg["actor_id"]
+        max_concurrency = msg.get("max_concurrency", 1)
+        if max_concurrency != 1:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_concurrency, thread_name_prefix="actor-exec"
+            )
+
+        def _init():
+            from .worker import resolve_task_args
+
+            args, kwargs = resolve_task_args(msg["args"])
+            self.actor_instance = cls(*args, **kwargs)
+            global_worker.current_actor = self.actor_instance
+            global_worker.current_actor_id = self.actor_id
+
+        await self._loop.run_in_executor(self._executor, _init)
+        return True
+
+    async def _run_task(self, msg):
+        if "actor_id" in msg and msg.get("actor_id"):
+            method_name = msg["method"]
+
+            def _call():
+                global_worker.current_task_id = msg["task_id"]
+                inst = self.actor_instance
+                if inst is None:
+                    raise RuntimeError("actor not initialized")
+                if method_name == "__ray_terminate__":
+                    self._loop.call_soon_threadsafe(self._loop.call_later, 0.05, sys.exit, 0)
+                    return {"results": []}
+                fn = getattr(inst, method_name)
+                return execute_and_package(fn, method_name, msg["args"], msg["return_ids"])
+
+            return await self._loop.run_in_executor(self._executor, _call)
+        fn = await self._fetch_blob("fn", msg["fn_key"], self._fn_cache)
+
+        def _run():
+            global_worker.current_task_id = msg["task_id"]
+            return execute_and_package(
+                fn, getattr(fn, "__name__", "task"), msg["args"], msg["return_ids"]
+            )
+
+        return await self._loop.run_in_executor(self._executor, _run)
+
+
+def main():
+    socket_path = os.environ["RAY_TPU_SOCKET"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    server = WorkerServer(socket_path, worker_id, node_id)
+    try:
+        asyncio.run(server.run())
+    except (KeyboardInterrupt, ConnectionError):
+        pass
+
+
+if __name__ == "__main__":
+    main()

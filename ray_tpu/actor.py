@@ -1,0 +1,130 @@
+"""Actor classes and handles.
+
+Reference parity: python/ray/actor.py (ActorClass.options/._remote,
+ActorMethod._remote → submit_actor_task; max_restarts plumbed like
+actor.py:332-351).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+from ._private.options import resolve_task_resources, validate_options
+from .remote_function import _strategy_to_wire
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor methods cannot be called directly. Use "
+            f"actor.{self._method_name}.remote() instead."
+        )
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ._private.worker import global_worker
+
+        refs = global_worker.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def bind(self):
+        from .dag.class_node import bind_method
+
+        import functools
+
+        return functools.partial(bind_method, self._handle, self._method_name)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, method_names=None, class_name: str = ""):
+        self._actor_id = actor_id
+        self._method_names = set(method_names or [])
+        self._class_name = class_name
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"Actor {self._class_name or self._actor_id} has no method {name!r}"
+            )
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, tuple(self._method_names), self._class_name))
+
+    def _state(self) -> Optional[str]:
+        from ._private.worker import global_worker
+
+        return global_worker.request({"t": "actor_state", "actor_id": self._actor_id})
+
+
+class ActorClass:
+    def __init__(self, cls, **default_options):
+        self._cls = cls
+        self._default_options = validate_options(default_options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actors cannot be instantiated directly. "
+            f"Use {self._cls.__name__}.remote() instead."
+        )
+
+    def options(self, **actor_options) -> "ActorClass":
+        opts = dict(self._default_options)
+        opts.update(actor_options)
+        return ActorClass(self._cls, **opts)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ._private.worker import global_worker
+
+        opts = self._default_options
+        actor_id = global_worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            namespace=opts.get("namespace"),
+            resources=resolve_task_resources(opts, is_actor=True),
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
+            lifetime=opts.get("lifetime"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return ActorHandle(actor_id, self._method_names(), self._cls.__name__)
+
+    def _method_names(self):
+        return [
+            n
+            for n, m in inspect.getmembers(self._cls, predicate=callable)
+            if not n.startswith("__")
+        ] + ["__ray_terminate__"]
+
+    @property
+    def bind(self):
+        from .dag.class_node import bind_class
+
+        import functools
+
+        return functools.partial(bind_class, self)

@@ -1,0 +1,74 @@
+"""User-facing exceptions.
+
+Reference parity: python/ray/exceptions.py (RayError hierarchy).
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get` with the remote traceback."""
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Task '{function_name}' failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.traceback_str, self.cause))
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str, reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex} died. {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id_hex, self.reason))
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} was lost or freed.")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id_hex,))
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    pass

@@ -1,0 +1,86 @@
+"""ObjectRef: a handle to a (possibly pending) object in the cluster.
+
+Reference parity: python/ray/_raylet.pyx ObjectRef + the distributed
+refcounting hooks of reference_count.h:61. Each live Python ObjectRef holds
+one reference registered with the owner directory; unpickling a ref in any
+process registers a new one (borrower registration, simplified).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Optional
+
+from ._private import serialization
+
+
+class ObjectRef:
+    __slots__ = ("id", "_registered", "__weakref__")
+
+    def __init__(self, id_hex: str, skip_adding_local_ref: bool = False):
+        from ._private.worker import global_worker
+
+        self.id = id_hex
+        self._registered = False
+        if not skip_adding_local_ref and global_worker.connected:
+            global_worker.add_object_ref(id_hex)
+            self._registered = True
+        elif skip_adding_local_ref:
+            self._registered = True  # ref was pre-counted at creation
+
+    def hex(self) -> str:
+        return self.id
+
+    def binary(self) -> bytes:
+        return bytes.fromhex(self.id)
+
+    def task_id(self) -> str:
+        return self.id[:-8]
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id})"
+
+    def __reduce__(self):
+        serialization.record_contained_ref(self)
+        return (ObjectRef, (self.id,))
+
+    def __del__(self):
+        try:
+            if self._registered:
+                from ._private.worker import global_worker
+
+                global_worker.remove_object_ref(self.id)
+        except Exception:
+            pass
+
+    def future(self) -> concurrent.futures.Future:
+        """Return a concurrent.futures.Future resolving to the object value."""
+        from ._private.worker import global_worker
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _poll():
+            try:
+                fut.set_result(global_worker.get(self))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=_poll, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _make_ref(id_hex: str) -> ObjectRef:
+    return ObjectRef(id_hex)

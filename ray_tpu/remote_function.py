@@ -1,0 +1,76 @@
+"""@remote task functions.
+
+Reference parity: python/ray/remote_function.py (RemoteFunction._remote :245
+→ core_worker.submit_task :391).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+
+class RemoteFunction:
+    def __init__(self, function, **default_options):
+        self._function = function
+        self._default_options = default_options
+        functools.update_wrapper(self, function)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly. "
+            f"Use {self._function.__name__}.remote() instead."
+        )
+
+    def options(self, **task_options) -> "RemoteFunction":
+        opts = dict(self._default_options)
+        opts.update(task_options)
+        return RemoteFunction(self._function, **opts)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_options)
+
+    def _remote(self, args, kwargs, opts: Dict[str, Any]):
+        from ._private.worker import global_worker
+        from ._private.options import resolve_task_resources
+
+        num_returns = opts.get("num_returns", 1)
+        refs = global_worker.submit_task(
+            self._function,
+            args,
+            kwargs,
+            name=opts.get("name") or self._function.__name__,
+            num_returns=num_returns,
+            resources=resolve_task_resources(opts, is_actor=False),
+            max_retries=opts.get("max_retries", 0),
+            scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
+            runtime_env=opts.get("runtime_env"),
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def bind(self):
+        from .dag.function_node import bind_function
+
+        return functools.partial(bind_function, self)
+
+
+def _strategy_to_wire(strategy):
+    from .util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if strategy is None or isinstance(strategy, str):
+        return strategy
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return {
+            "type": "placement_group",
+            "pg_id": strategy.placement_group.id,
+            "bundle_index": strategy.placement_group_bundle_index,
+        }
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"type": "node_affinity", "node_id": strategy.node_id, "soft": strategy.soft}
+    raise TypeError(f"Unknown scheduling strategy {strategy!r}")

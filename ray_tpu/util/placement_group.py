@@ -1,0 +1,96 @@
+"""Placement groups: atomic gang reservation of resource bundles.
+
+Reference parity: python/ray/util/placement_group.py (PlacementGroup :34,
+placement_group() :139) + GcsPlacementGroupManager. On TPU the canonical use
+is reserving a pod slice (bundles of {"TPU": chips_per_host, "CPU": ...} per
+host) with STRICT_SPREAD/SPREAD so one SPMD gang lands one-worker-per-host.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .._private.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self._bundles = bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self):
+        """Returns an ObjectRef-like wait: blocks until placed (sync helper)."""
+        from .._private.worker import global_worker
+
+        ok = global_worker.request({"t": "pg_ready", "pg_id": self.id, "timeout": None})
+        return ok
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        from .._private.worker import global_worker
+
+        return global_worker.request(
+            {"t": "pg_ready", "pg_id": self.id, "timeout": timeout_seconds}
+        )
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    from .._private.worker import global_worker
+
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"Invalid strategy {strategy!r}")
+    if not bundles:
+        raise ValueError("bundles cannot be empty")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"Invalid bundle {b!r}")
+    pg_id = PlacementGroupID.of(global_worker.job_id).hex()
+    spec = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name or None}
+    global_worker.request({"t": "create_placement_group", "spec": spec})
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from .._private.worker import global_worker
+
+    global_worker.request({"t": "remove_placement_group", "pg_id": pg.id})
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> dict:
+    from .._private.worker import global_worker
+
+    table = global_worker.request({"t": "pg_table"})
+    if pg is not None:
+        return table.get(pg.id, {})
+    return table
+
+
+def tpu_slice_placement_group(
+    num_hosts: int,
+    chips_per_host: int = 4,
+    cpus_per_host: float = 1.0,
+    strategy: str = "STRICT_SPREAD",
+) -> PlacementGroup:
+    """Reserve a TPU pod slice: one bundle per host, each with the host's chips.
+
+    TPU-native addition (the reference has no TPU resource type — SURVEY §5.5).
+    """
+    bundles = [
+        {"TPU": float(chips_per_host), "CPU": cpus_per_host} for _ in range(num_hosts)
+    ]
+    return placement_group(bundles, strategy=strategy)

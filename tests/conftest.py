@@ -1,0 +1,29 @@
+import os
+
+# Multi-device CPU mesh for all JAX-based tests: 8 virtual devices.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fixture ladder rung 1 (reference: python/ray/tests/conftest.py:351)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Rung 2: in-process multi-node cluster (cluster_utils.Cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()

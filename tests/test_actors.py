@@ -1,0 +1,173 @@
+"""Actor API tests (reference model: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def get_items(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get_items.remote()) == list(range(20))
+
+
+def test_actor_init_args(ray_start_regular):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, a, b=2):
+            self.v = a + b
+
+        def value(self):
+            return self.v
+
+    h = Holder.remote(1, b=10)
+    assert ray_tpu.get(h.value.remote()) == 11
+
+
+def test_actor_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    e = Echo.remote()
+    ref = ray_tpu.put("hello")
+    assert ray_tpu.get(e.echo.remote(ref)) == "hello"
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor boom")
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="actor boom"):
+        ray_tpu.get(b.boom.remote())
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg").remote()
+    h = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+
+
+def test_get_missing_named_actor(ray_start_regular):
+    with pytest.raises(Exception, match="look up actor"):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(ray_tpu.exceptions.ActorError):
+        ray_tpu.get(v.ping.remote(), timeout=5)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Suicidal:
+        def __init__(self):
+            self.count = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    s = Suicidal.remote()
+    pid1 = ray_tpu.get(s.pid.remote())
+    s.die.remote()
+    time.sleep(1.0)
+    # actor should be restarted with a fresh process
+    deadline = time.time() + 15
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(s.pid.remote(), timeout=10)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def use_counter(c):
+        return ray_tpu.get(c.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(use_counter.remote(c)) == 1
+    assert ray_tpu.get(c.inc.remote()) == 2
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def slow(self):
+            time.sleep(0.5)
+            return 1
+
+    p = Parallel.remote()
+    t0 = time.time()
+    ray_tpu.get([p.slow.remote() for _ in range(4)])
+    assert time.time() - t0 < 1.9

@@ -1,0 +1,165 @@
+"""Core task/object API tests (reference model: python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_numpy(ray_start_regular):
+    arr = np.arange(100000, dtype=np.float32).reshape(100, 1000)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(f.remote(ref)) == 20
+
+
+def test_task_chaining(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 6
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("boom")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="boom"):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_propagates_through_chain(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("original")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(consume.remote(fail.remote()))
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert pending == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(5)) == 11
+
+
+def test_nested_ref_in_structure(ray_start_regular):
+    @ray_tpu.remote
+    def f(d):
+        # nested refs stay refs
+        return ray_tpu.get(d["ref"]) + 1
+
+    ref = ray_tpu.put(41)
+    assert ray_tpu.get(f.remote({"ref": ref})) == 42
+
+
+def test_options_name(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom").remote()) == 1
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+
+
+def test_parallel_tasks(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def sleepy(i):
+        time.sleep(0.5)
+        return i
+
+    t0 = time.time()
+    out = ray_tpu.get([sleepy.remote(i) for i in range(4)])
+    elapsed = time.time() - t0
+    assert out == list(range(4))
+    # 4 half-second tasks on 4 CPUs should overlap
+    assert elapsed < 1.9, f"tasks did not run in parallel: {elapsed:.2f}s"
+
+
+def test_put_on_ref_raises(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
