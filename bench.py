@@ -1,0 +1,119 @@
+"""Benchmark: flagship-model training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured MFU / 0.45 — the BASELINE.json north-star target
+(the reference publishes no tokens/sec numbers; see BASELINE.md notes).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v6 lite": 918e12,
+    "cpu": 5e11,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_BF16_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return PEAK_BF16_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.parallel import MeshSpec, PRESET_RULES, build_mesh
+    from ray_tpu.train.step import default_optimizer, make_sharded_init, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
+    n_chips = len(jax.devices())
+
+    if on_tpu:
+        cfg = CONFIGS["gpt2_125m"]
+        batch, seq, steps = 16, 1024, 10
+    else:  # CI / local smoke: tiny model
+        import dataclasses
+
+        cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
+        batch, seq, steps = 8, 128, 5
+
+    mesh = build_mesh(MeshSpec(dp=n_chips))
+    rules = PRESET_RULES["dp"] if n_chips == 1 else PRESET_RULES["fsdp"]
+    opt = default_optimizer(lr=1e-3, warmup=10)
+    init_fn, shardings = make_sharded_init(cfg, mesh, rules, opt)
+    state = init_fn(jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, rules, opt, shardings)
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq + 1)), jnp.int32
+        ),
+        "mask": jnp.ones((batch, seq + 1), jnp.int32),
+    }
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+
+    flops_per_token = cfg.flops_per_token() + cfg.attention_flops_per_token(seq)
+    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops(dev)
+    vs_baseline = mfu / 0.45
+
+    print(
+        f"[bench] dev={getattr(dev, 'device_kind', dev.platform)} chips={n_chips} "
+        f"model={cfg.d_model}x{cfg.n_layers} batch={batch} seq={seq} "
+        f"compile={compile_s:.1f}s step={dt / steps * 1000:.1f}ms "
+        f"loss={float(metrics['loss']):.3f} mfu={mfu:.3f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_125m_train_tokens_per_sec_per_chip"
+                if on_tpu
+                else "tiny_train_tokens_per_sec_per_chip_cpu",
+                "value": round(tokens_per_sec_per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+                "mfu": round(mfu, 4),
+                "device": getattr(dev, "device_kind", dev.platform),
+                "step_ms": round(dt / steps * 1000, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,280 @@
+// Shared-memory object store (plasma-lite), C ABI for ctypes.
+//
+// Reference parity: src/ray/object_manager/plasma (PlasmaStore store.h:55,
+// ObjectLifecycleManager, eviction_policy.h) — redesigned for the TPU-host
+// shape: instead of a separate store daemon + unix-socket IPC + dlmalloc
+// slabs, each object is one POSIX shm segment created by the producing
+// process and mapped read-only by consumers (zero-copy numpy/jax host
+// buffers). A small shared control segment carries the capacity ledger and
+// per-object refcounts/seal state so any process can admit, pin, and evict
+// without a broker round-trip. Coordination (who owns which id, when to
+// free) stays in the head's ObjectDirectory, exactly like the reference
+// keeps location metadata in the owner/GCS rather than in plasma itself.
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lrt -pthread
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52545055;  // "RTPU"
+constexpr int kMaxObjects = 1 << 16;
+constexpr int kNameLen = 48;
+
+struct ObjectEntry {
+  char name[kNameLen];          // shm segment name ("" = free slot)
+  std::atomic<int64_t> size;    // payload bytes
+  std::atomic<int32_t> refs;    // process-shared pin count
+  std::atomic<int32_t> sealed;  // 0 = being written, 1 = immutable
+  std::atomic<int64_t> last_use_ns;
+};
+
+struct ControlBlock {
+  uint32_t magic;
+  std::atomic<int64_t> capacity;
+  std::atomic<int64_t> used;
+  std::atomic<int64_t> num_objects;
+  std::atomic<int64_t> clock_ns;  // logical clock for LRU
+  ObjectEntry entries[kMaxObjects];
+};
+
+struct StoreHandle {
+  ControlBlock* ctrl;
+  char prefix[kNameLen];
+};
+
+uint64_t fnv1a(const char* s) {
+  uint64_t h = 1469598103934665603ull;
+  for (; *s; ++s) {
+    h ^= (unsigned char)*s;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// '\1' marks a tombstone: a deleted slot that keeps probe chains intact
+// (plain '\0' would terminate lookups for colliding live entries).
+constexpr char kTombstone = '\1';
+
+ObjectEntry* find_entry(ControlBlock* cb, const char* name, bool create) {
+  uint64_t h = fnv1a(name) % kMaxObjects;
+  ObjectEntry* first_tomb = nullptr;
+  for (int probe = 0; probe < kMaxObjects; ++probe) {
+    ObjectEntry* e = &cb->entries[(h + probe) % kMaxObjects];
+    if (e->name[0] == '\0') {
+      if (!create) return nullptr;
+      ObjectEntry* slot = first_tomb ? first_tomb : e;
+      // claim the slot (benign race: callers create unique names)
+      memset(slot->name, 0, kNameLen);
+      strncpy(slot->name, name, kNameLen - 1);
+      return slot;
+    }
+    if (e->name[0] == kTombstone) {
+      if (create && first_tomb == nullptr) first_tomb = e;
+      continue;
+    }
+    if (strncmp(e->name, name, kNameLen) == 0) return e;
+  }
+  return nullptr;
+}
+
+int64_t now_tick(ControlBlock* cb) {
+  return cb->clock_ns.fetch_add(1) + 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Opens (or creates) the store control segment for a session.
+void* shm_store_connect(const char* session, int64_t capacity_bytes) {
+  char ctrl_name[kNameLen];
+  snprintf(ctrl_name, sizeof(ctrl_name), "/rtpu_%s_ctrl", session);
+  int fd = shm_open(ctrl_name, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, sizeof(ControlBlock)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sizeof(ControlBlock), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* cb = static_cast<ControlBlock*>(mem);
+  uint32_t expected = 0;
+  if (cb->magic != kMagic) {
+    cb->capacity.store(capacity_bytes);
+    cb->magic = kMagic;
+  }
+  auto* h = new StoreHandle;
+  h->ctrl = cb;
+  snprintf(h->prefix, sizeof(h->prefix), "/rtpu_%s", session);
+  (void)expected;
+  return h;
+}
+
+int64_t shm_store_capacity(void* handle) {
+  return static_cast<StoreHandle*>(handle)->ctrl->capacity.load();
+}
+
+int64_t shm_store_used(void* handle) {
+  return static_cast<StoreHandle*>(handle)->ctrl->used.load();
+}
+
+// Creates an object buffer; returns writable pointer (caller must seal).
+// Returns nullptr if capacity would be exceeded (caller may evict+retry).
+void* shm_store_create(void* handle, const char* object_name, int64_t size) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  int64_t used = cb->used.fetch_add(size);
+  if (used + size > cb->capacity.load()) {
+    cb->used.fetch_sub(size);
+    return nullptr;
+  }
+  char seg[kNameLen * 2];
+  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
+  int fd = shm_open(seg, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    cb->used.fetch_sub(size);
+    return nullptr;
+  }
+  if (ftruncate(fd, size ? size : 1) != 0) {
+    close(fd);
+    shm_unlink(seg);
+    cb->used.fetch_sub(size);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, size ? size : 1, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(seg);
+    cb->used.fetch_sub(size);
+    return nullptr;
+  }
+  ObjectEntry* e = find_entry(cb, object_name, /*create=*/true);
+  if (e == nullptr) {
+    munmap(mem, size ? size : 1);
+    shm_unlink(seg);
+    cb->used.fetch_sub(size);
+    return nullptr;
+  }
+  e->size.store(size);
+  e->refs.store(1);
+  e->sealed.store(0);
+  e->last_use_ns.store(now_tick(cb));
+  cb->num_objects.fetch_add(1);
+  return mem;
+}
+
+int shm_store_seal(void* handle, const char* object_name) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ObjectEntry* e = find_entry(h->ctrl, object_name, false);
+  if (e == nullptr) return -1;
+  e->sealed.store(1);
+  return 0;
+}
+
+// Maps a sealed object read-only; returns pointer, sets *size_out.
+void* shm_store_get(void* handle, const char* object_name, int64_t* size_out) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ObjectEntry* e = find_entry(h->ctrl, object_name, false);
+  if (e == nullptr || !e->sealed.load()) return nullptr;
+  char seg[kNameLen * 2];
+  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
+  int fd = shm_open(seg, O_RDONLY, 0600);
+  if (fd < 0) return nullptr;
+  int64_t size = e->size.load();
+  void* mem = mmap(nullptr, size ? size : 1, PROT_READ, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  e->refs.fetch_add(1);
+  e->last_use_ns.store(now_tick(h->ctrl));
+  *size_out = size;
+  return mem;
+}
+
+// Unmaps a previously created/got mapping and drops its pin.
+int shm_store_release(void* handle, const char* object_name, void* mem) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ObjectEntry* e = find_entry(h->ctrl, object_name, false);
+  if (e == nullptr) return -1;
+  int64_t size = e->size.load();
+  munmap(mem, size ? size : 1);
+  e->refs.fetch_sub(1);
+  return 0;
+}
+
+// Deletes the object (unlink + ledger update). Safe while readers hold
+// mappings (POSIX keeps pages until last munmap).
+int shm_store_delete(void* handle, const char* object_name) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  ObjectEntry* e = find_entry(cb, object_name, false);
+  if (e == nullptr) return -1;
+  char seg[kNameLen * 2];
+  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
+  shm_unlink(seg);
+  cb->used.fetch_sub(e->size.load());
+  cb->num_objects.fetch_sub(1);
+  e->size.store(0);
+  e->sealed.store(0);
+  e->refs.store(0);
+  e->name[0] = kTombstone;  // keep probe chains intact
+  e->name[1] = '\0';
+  return 0;
+}
+
+// Evicts up to `want_bytes` of sealed, unpinned objects (LRU order).
+// Returns bytes evicted. The caller (head) must treat evicted ids as lost
+// and trigger lineage reconstruction — same contract as plasma eviction.
+int64_t shm_store_evict(void* handle, int64_t want_bytes) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  int64_t freed = 0;
+  while (freed < want_bytes) {
+    ObjectEntry* best = nullptr;
+    int64_t best_tick = INT64_MAX;
+    for (int i = 0; i < kMaxObjects; ++i) {
+      ObjectEntry* e = &cb->entries[i];
+      if (e->name[0] && e->name[0] != kTombstone && e->sealed.load() &&
+          e->refs.load() <= 1) {
+        int64_t t = e->last_use_ns.load();
+        if (t < best_tick) {
+          best_tick = t;
+          best = e;
+        }
+      }
+    }
+    if (best == nullptr) break;
+    freed += best->size.load();
+    char name_copy[kNameLen];
+    strncpy(name_copy, best->name, kNameLen);
+    shm_store_delete(handle, name_copy);
+  }
+  return freed;
+}
+
+void shm_store_disconnect(void* handle) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  munmap(h->ctrl, sizeof(ControlBlock));
+  delete h;
+}
+
+// Destroys the session's control segment (head calls at shutdown).
+void shm_store_destroy(const char* session) {
+  char ctrl_name[kNameLen];
+  snprintf(ctrl_name, sizeof(ctrl_name), "/rtpu_%s_ctrl", session);
+  shm_unlink(ctrl_name);
+}
+
+}  // extern "C"

@@ -144,12 +144,13 @@ class ObjectDirectory:
     large objects live in the shared-memory plane; only envelopes live here.
     """
 
-    def __init__(self):
+    def __init__(self, on_free=None):
         self.objects: Dict[str, Any] = {}
         self.events: Dict[str, asyncio.Event] = {}
         self.refcounts: collections.Counter = collections.Counter()
         self.task_pins: collections.Counter = collections.Counter()
         self.errors: Dict[str, Any] = {}
+        self.on_free = on_free  # called with the envelope when freed
 
     def _event(self, oid: str) -> asyncio.Event:
         ev = self.events.get(oid)
@@ -188,10 +189,12 @@ class ObjectDirectory:
 
     def _maybe_free(self, oid: str):
         if self.refcounts[oid] <= 0 and self.task_pins[oid] <= 0:
-            self.objects.pop(oid, None)
+            env = self.objects.pop(oid, None)
             self.events.pop(oid, None)
             self.refcounts.pop(oid, None)
             self.task_pins.pop(oid, None)
+            if env is not None and self.on_free is not None:
+                self.on_free(env)
 
 
 # --------------------------------------------------------------------------
@@ -204,7 +207,7 @@ class Head:
         self.session_dir = session_dir
         self.socket_path = os.path.join(session_dir, "head.sock")
         self.kv: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
-        self.objects = ObjectDirectory()
+        self.objects = ObjectDirectory(on_free=self._free_shm_buffers)
         self.nodes: Dict[str, NodeRecord] = {}
         self.workers: Dict[str, WorkerRecord] = {}
         self.actors: Dict[str, ActorRecord] = {}
@@ -223,10 +226,34 @@ class Head:
         self._spawning_task_workers: collections.Counter = collections.Counter()
         self._driver_conn: Optional[protocol.Connection] = None
         self.job_config: Dict[str, Any] = {}
+        self._shm = None
+        self._shm_tried = False
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+
+    def _shm_client(self):
+        if not self._shm_tried:
+            self._shm_tried = True
+            from .shm import connect_for_session
+
+            self._shm = connect_for_session(self.session_dir)
+        return self._shm
+
+    def _free_shm_buffers(self, env):
+        from .serialization import shm_buffer_names
+
+        try:
+            names = shm_buffer_names(env)
+        except Exception:
+            return
+        if not names:
+            return
+        shm = self._shm_client()
+        if shm is not None:
+            for n in names:
+                shm.delete(n)
 
     async def start(self):
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
@@ -242,6 +269,18 @@ class Head:
         for conn in list(self._client_conns):
             try:
                 await conn.close()
+            except Exception:
+                pass
+        # tear down the shared-memory plane
+        shm = self._shm_client()
+        if shm is not None:
+            try:
+                for env in self.objects.objects.values():
+                    self._free_shm_buffers(env)
+                shm.disconnect()
+                from .shm import ShmClient
+
+                ShmClient.destroy(os.path.basename(self.session_dir))
             except Exception:
                 pass
 

@@ -38,14 +38,37 @@ def record_contained_ref(ref) -> None:
 
 @dataclass
 class SerializedObject:
-    """A picklable envelope: payload + out-of-band buffers + contained refs."""
+    """A picklable envelope: payload + out-of-band buffers + contained refs.
+
+    Buffers may be zero-copy memoryviews (fresh from serialize) — pickling
+    the envelope (socket path) converts them to bytes; the shm path consumes
+    the views directly without ever materializing bytes."""
 
     payload: bytes
-    buffers: List[bytes] = field(default_factory=list)
+    buffers: List[Any] = field(default_factory=list)
     contained_refs: List[Any] = field(default_factory=list)
+    is_error: bool = False
 
     def total_bytes(self) -> int:
-        return len(self.payload) + sum(len(b) for b in self.buffers)
+        return len(self.payload) + sum(
+            b.size if hasattr(b, "size") and not isinstance(b, (bytes, memoryview)) else len(b)
+            for b in self.buffers
+        )
+
+    def __reduce__(self):
+        wire_buffers = [
+            bytes(b) if isinstance(b, memoryview) else b for b in self.buffers
+        ]
+        return (
+            _rebuild_envelope,
+            (self.payload, wire_buffers, self.contained_refs, self.is_error),
+        )
+
+
+def _rebuild_envelope(payload, buffers, refs, is_error):
+    return SerializedObject(
+        payload=payload, buffers=buffers, contained_refs=refs, is_error=is_error
+    )
 
 
 def serialize(value: Any) -> SerializedObject:
@@ -64,7 +87,9 @@ def serialize(value: Any) -> SerializedObject:
         payload = cloudpickle.dumps(value, protocol=5, buffer_callback=_cb)
     finally:
         _ref_collector.active = prev
-    out = [bytes(b.raw()) for b in buffers]
+    # keep raw views (zero-copy); __reduce__ converts to bytes only if the
+    # envelope actually rides the socket instead of the shm plane
+    out = [b.raw() for b in buffers]
     # Dedup refs by id while preserving order.
     seen = set()
     uniq = []
@@ -77,3 +102,48 @@ def serialize(value: Any) -> SerializedObject:
 
 def deserialize(obj: SerializedObject) -> Any:
     return pickle.loads(obj.payload, buffers=obj.buffers)
+
+
+def externalize(env: SerializedObject, shm_client, threshold: int) -> SerializedObject:
+    """Move large out-of-band buffers into the shared-memory store, replacing
+    them with ShmBufferRef handles (zero-copy across host processes)."""
+    if shm_client is None:
+        return env
+    import uuid
+
+    new_buffers = []
+    for buf in env.buffers:
+        if isinstance(buf, (bytes, memoryview)) and len(buf) >= threshold:
+            ref = shm_client.create(uuid.uuid4().hex, memoryview(buf))
+            new_buffers.append(ref if ref is not None else buf)
+        else:
+            new_buffers.append(buf)
+    env.buffers = new_buffers
+    return env
+
+
+def materialize(env: SerializedObject, shm_client) -> SerializedObject:
+    """Resolve ShmBufferRef buffers into mapped memoryviews (no copy)."""
+    from .shm import ShmBufferRef
+
+    out = []
+    for buf in env.buffers:
+        if isinstance(buf, ShmBufferRef):
+            if shm_client is None:
+                raise RuntimeError("shm buffer present but shm store unavailable")
+            mv = shm_client.get(buf)
+            if mv is None:
+                from ..exceptions import ObjectLostError
+
+                raise ObjectLostError(buf.name)
+            out.append(mv)
+        else:
+            out.append(buf)
+    env.buffers = out
+    return env
+
+
+def shm_buffer_names(env: SerializedObject):
+    from .shm import ShmBufferRef
+
+    return [b.name for b in env.buffers if isinstance(b, ShmBufferRef)]

@@ -1,0 +1,172 @@
+"""Python client for the C++ shared-memory object store (cpp/shm_store.cc).
+
+Builds the .so on first use (g++ is a baked dependency), loads it via
+ctypes, and exposes zero-copy create/get as memoryviews that numpy/jax wrap
+without copies. Reference parity: CoreWorkerPlasmaStoreProvider
+(plasma_store_provider.h:88) on the client side.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+_LIB_PATH = os.path.abspath(os.path.join(_CPP_DIR, "libshm_store.so"))
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(
+            os.path.join(_CPP_DIR, "shm_store.cc")
+        ):
+            subprocess.run(
+                ["make", "-s", "-C", os.path.abspath(_CPP_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.shm_store_connect.restype = ctypes.c_void_p
+        lib.shm_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.shm_store_create.restype = ctypes.c_void_p
+        lib.shm_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.shm_store_get.restype = ctypes.c_void_p
+        lib.shm_store_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+        lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_evict.restype = ctypes.c_int64
+        lib.shm_store_evict.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.shm_store_used.restype = ctypes.c_int64
+        lib.shm_store_used.argtypes = [ctypes.c_void_p]
+        lib.shm_store_capacity.restype = ctypes.c_int64
+        lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_store_disconnect.argtypes = [ctypes.c_void_p]
+        lib.shm_store_destroy.argtypes = [ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+@dataclass
+class ShmBufferRef:
+    """Picklable handle to a shared-memory buffer (travels in envelopes)."""
+
+    name: str
+    size: int
+
+
+def _release_mapping(lib, handle, name_bytes, ptr):
+    try:
+        lib.shm_store_release(handle, name_bytes, ptr)
+    except Exception:
+        pass
+
+
+def connect_for_session(session_dir: str):
+    """Shared lazy-connect helper (head + workers): returns a ShmClient for
+    the session, or None if disabled/unavailable."""
+    from .config import GLOBAL_CONFIG as cfg
+
+    if not cfg.shm_store_enabled or not session_dir:
+        return None
+    try:
+        return ShmClient(os.path.basename(session_dir), cfg.shm_store_bytes)
+    except Exception:
+        return None
+
+
+class ShmClient:
+    def __init__(self, session: str, capacity_bytes: int):
+        self.session = session
+        self.lib = _load_lib()
+        self.handle = self.lib.shm_store_connect(session.encode(), capacity_bytes)
+        if not self.handle:
+            raise OSError("failed to connect to shm store")
+
+    def create(self, name: str, data: memoryview | bytes) -> Optional[ShmBufferRef]:
+        """Copy `data` into a new sealed shm object. Returns None when the
+        store is full — the caller falls back to the socket path; eviction is
+        NEVER triggered here (only the head, which knows the live-ref set,
+        may evict — evicting from a producer would drop objects that other
+        processes still reference)."""
+        data = memoryview(data)
+        size = data.nbytes
+        ptr = self.lib.shm_store_create(self.handle, name.encode(), size)
+        if not ptr:
+            return None
+        try:
+            # zero-copy source view when the buffer is writable & contiguous
+            src: object = (ctypes.c_char * size).from_buffer(data)
+        except (TypeError, BufferError):
+            src = data.tobytes()
+        ctypes.memmove(ptr, src, size)
+        del src
+        self.lib.shm_store_seal(self.handle, name.encode())
+        self.lib.shm_store_release(self.handle, name.encode(), ptr)
+        return ShmBufferRef(name=name, size=size)
+
+    def get(self, ref: ShmBufferRef) -> Optional[memoryview]:
+        """Map a sealed object read-only, zero-copy. The mapping is unmapped
+        and its pin dropped automatically when the last view dies (weakref
+        finalizer on the backing ctypes buffer)."""
+        import weakref
+
+        size_out = ctypes.c_int64(0)
+        ptr = self.lib.shm_store_get(self.handle, ref.name.encode(), ctypes.byref(size_out))
+        if not ptr:
+            return None
+        buf = (ctypes.c_char * size_out.value).from_address(ptr)
+        weakref.finalize(
+            buf, _release_mapping, self.lib, self.handle, ref.name.encode(), ptr
+        )
+        # read-only: the page is PROT_READ; a writable view would SIGSEGV on
+        # write instead of raising (numpy arrays unpickled from this buffer
+        # correctly come out non-writeable, like the reference's plasma gets)
+        return memoryview(buf).toreadonly()
+
+    def delete(self, name: str):
+        self.lib.shm_store_delete(self.handle, name.encode())
+
+    def used(self) -> int:
+        return self.lib.shm_store_used(self.handle)
+
+    def capacity(self) -> int:
+        return self.lib.shm_store_capacity(self.handle)
+
+    def evict(self, nbytes: int) -> int:
+        return self.lib.shm_store_evict(self.handle, nbytes)
+
+    def disconnect(self):
+        # The C handle is intentionally NOT freed: outstanding mapping
+        # finalizers (weakref on ctypes buffers) may still call
+        # shm_store_release with it after disconnect. One control-block mmap
+        # per process leaks until exit — bounded and harmless.
+        self.handle = None
+
+    @staticmethod
+    def destroy(session: str):
+        """Remove the control segment AND sweep any leftover data segments
+        (objects still referenced by crashed/leaked handles)."""
+        _load_lib().shm_store_destroy(session.encode())
+        import glob
+
+        for path in glob.glob(f"/dev/shm/rtpu_{session}_*"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

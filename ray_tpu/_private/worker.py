@@ -81,6 +81,20 @@ class Worker:
         self.current_task_id: Optional[str] = None
         self.namespace: str = ""
         self._lock = threading.RLock()
+        self._shm = None
+        self._shm_tried = False
+
+    @property
+    def shm(self):
+        """Lazy client for the C++ shared-memory object plane (None if
+        disabled or unavailable)."""
+        if self._shm_tried:
+            return self._shm
+        self._shm_tried = True
+        from .shm import connect_for_session
+
+        self._shm = connect_for_session(self.session_dir)
+        return self._shm
 
     # ------------------------------------------------------------------
     # connection
@@ -88,6 +102,14 @@ class Worker:
 
     def connect_driver(self, node, namespace: str = ""):
         self.mode = MODE_DRIVER
+        self._fn_exported.clear()
+        if self._shm is not None:
+            try:
+                self._shm.disconnect()
+            except Exception:
+                pass
+        self._shm = None
+        self._shm_tried = False
         self.node = node
         self.io = node.io
         self.session_dir = node.session_dir
@@ -155,8 +177,11 @@ class Worker:
 
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        from .config import GLOBAL_CONFIG as cfg
+
         oid = ObjectID.from_put(self.job_id).hex()
         env = serialization.serialize(value)
+        env = serialization.externalize(env, self.shm, cfg.object_inline_limit_bytes)
         self.request({"t": "put_object", "object_id": oid, "envelope": env, "initial_refs": 1})
         return ObjectRef(oid, skip_adding_local_ref=True)
 
@@ -173,6 +198,7 @@ class Worker:
         )
         values = []
         for env in envs:
+            env = serialization.materialize(env, self.shm)
             value = serialization.deserialize(env)
             if getattr(env, "is_error", False):
                 raise value
@@ -345,6 +371,7 @@ global_worker = Worker()
 def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
     env: serialization.SerializedObject = args_msg["env"]
     resolved: Dict[str, serialization.SerializedObject] = args_msg["resolved"]
+    env = serialization.materialize(env, global_worker.shm)
     args, kwargs = serialization.deserialize(env)
 
     def conv(a):
@@ -352,6 +379,7 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
             dep_env = resolved.get(a.object_id)
             if dep_env is None:
                 raise exceptions.ObjectLostError(a.object_id)
+            dep_env = serialization.materialize(dep_env, global_worker.shm)
             value = serialization.deserialize(dep_env)
             if getattr(dep_env, "is_error", False):
                 raise value
@@ -382,7 +410,15 @@ def execute_and_package(fn, fn_name: str, args_msg: dict, return_ids: List[str])
                 raise ValueError(
                     f"Task {fn_name} set num_returns={n} but returned {len(values)} values"
                 )
-        return {"results": [serialization.serialize(v) for v in values]}
+        from .config import GLOBAL_CONFIG as cfg
+
+        envs = []
+        for v in values:
+            env = serialization.serialize(v)
+            envs.append(
+                serialization.externalize(env, global_worker.shm, cfg.object_inline_limit_bytes)
+            )
+        return {"results": envs}
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         if isinstance(e, (exceptions.TaskError, exceptions.ActorError)):

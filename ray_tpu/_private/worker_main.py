@@ -52,6 +52,7 @@ class WorkerServer:
         io = EventLoopThread.__new__(EventLoopThread)
         io.loop = self._loop
         io.thread = threading.current_thread()
+        global_worker.session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
         global_worker.connect_worker(self.socket_path, self.worker_id, io, self.conn)
 
         await self.conn.request(

@@ -1,0 +1,22 @@
+"""ray_tpu.data: lazy, streaming, distributed datasets.
+
+Reference parity: python/ray/data (Dataset dataset.py:168, lazy logical plan,
+streaming executor streaming_executor.py:48, blocks = Arrow/numpy). TPU-first
+additions: per-host shard iterators with double-buffered jax.device_put
+prefetch (SURVEY §7.1 M4), feeding sharded global batches directly onto a
+mesh.
+"""
+
+from .dataset import (  # noqa: F401
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range as range_,  # noqa: A001
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+
+range = range_  # noqa: A001 — mirror ray.data.range

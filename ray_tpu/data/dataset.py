@@ -1,0 +1,438 @@
+"""Lazy distributed Dataset.
+
+Design (reference: python/ray/data/dataset.py:168 + _internal/execution):
+  - a Dataset is an immutable logical plan: a block source + a chain of ops
+    (map_batches / filter / repartition / ...). Nothing runs until iteration
+    or materialize().
+  - blocks are plain Python payloads (dict-of-numpy "batch" format, lists of
+    rows, or pyarrow Tables) stored in the object store; transforms run as
+    ray_tpu tasks over blocks with windowed streaming (submit-ahead window =
+    backpressure, the moral equivalent of StreamingExecutor's resource-aware
+    pull loop).
+  - per-worker shards come from split_at(rank, n) — contiguous block ranges,
+    matching DataConfig's streaming split (train/_internal/dataset_spec.py).
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+Batch = Union[Dict[str, np.ndarray], "pd.DataFrame", List[Any]]  # noqa: F821
+
+
+# --------------------------------------------------------------------------
+# block helpers
+# --------------------------------------------------------------------------
+
+
+def _block_num_rows(block) -> int:
+    if isinstance(block, dict):
+        for v in block.values():
+            return len(v)
+        return 0
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return block.num_rows
+    except ImportError:
+        pass
+    return len(block)
+
+
+def _block_slice(block, start: int, end: int):
+    if isinstance(block, dict):
+        return {k: v[start:end] for k, v in block.items()}
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return block.slice(start, end - start)
+    except ImportError:
+        pass
+    return block[start:end]
+
+
+def _block_concat(blocks: List[Any]):
+    first = blocks[0]
+    if isinstance(first, dict):
+        return {k: np.concatenate([b[k] for b in blocks]) for k in first}
+    try:
+        import pyarrow as pa
+
+        if isinstance(first, pa.Table):
+            return pa.concat_tables(blocks)
+    except ImportError:
+        pass
+    out = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def _block_to_rows(block) -> Iterator[Any]:
+    if isinstance(block, dict):
+        keys = list(block)
+        n = _block_num_rows(block)
+        for i in builtins.range(n):
+            yield {k: block[k][i] for k in keys}
+        return
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            yield from block.to_pylist()
+            return
+    except ImportError:
+        pass
+    yield from block
+
+
+# --------------------------------------------------------------------------
+# logical ops
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Op:
+    kind: str  # map_batches | map | filter | flat_map
+    fn: Callable
+    batch_size: Optional[int] = None
+    fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def _apply_ops(block, ops: List[_Op]):
+    """Runs inside a task: fold the op chain over one block."""
+    for op in ops:
+        if op.kind == "map_batches":
+            if op.batch_size is None:
+                block = op.fn(block, **op.fn_kwargs)
+            else:
+                n = _block_num_rows(block)
+                outs = [
+                    op.fn(_block_slice(block, s, min(s + op.batch_size, n)), **op.fn_kwargs)
+                    for s in builtins.range(0, n, op.batch_size)
+                ]
+                block = _block_concat(outs) if outs else block
+        elif op.kind == "map":
+            block = [op.fn(row) for row in _block_to_rows(block)]
+        elif op.kind == "filter":
+            block = [row for row in _block_to_rows(block) if op.fn(row)]
+        elif op.kind == "flat_map":
+            out: List[Any] = []
+            for row in _block_to_rows(block):
+                out.extend(op.fn(row))
+            block = out
+        else:
+            raise ValueError(f"unknown op {op.kind}")
+    return block
+
+
+def _execute_block(block_or_ref, ops: List[_Op]):
+    return _apply_ops(block_or_ref, ops)
+
+
+class Dataset:
+    def __init__(self, block_fns: List[Callable[[], Any]], ops: Optional[List[_Op]] = None):
+        # block_fns: zero-arg callables producing the source blocks (lazy read)
+        self._block_fns = block_fns
+        self._ops = ops or []
+
+    # ---- metadata ----
+
+    def num_blocks(self) -> int:
+        return len(self._block_fns)
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={self.num_blocks()}, ops={[o.kind for o in self._ops]})"
+
+    # ---- transforms (lazy) ----
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._block_fns, self._ops + [op])
+
+    def map_batches(
+        self,
+        fn: Callable[[Batch], Batch],
+        *,
+        batch_size: Optional[int] = None,
+        fn_kwargs: Optional[Dict[str, Any]] = None,
+        **_,
+    ) -> "Dataset":
+        return self._with_op(_Op("map_batches", fn, batch_size, fn_kwargs or {}))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with_op(_Op("map", fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._with_op(_Op("filter", fn))
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        return self._with_op(_Op("flat_map", fn))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materializing repartition into equal-ish contiguous blocks."""
+        blocks = self._compute_blocks()
+        merged = _block_concat(blocks) if len(blocks) > 1 else blocks[0]
+        total = _block_num_rows(merged)
+        per = max(1, total // num_blocks)
+        slices = []
+        for i in builtins.range(num_blocks):
+            s = i * per
+            e = total if i == num_blocks - 1 else min((i + 1) * per, total)
+            if s >= total:
+                break
+            blk = _block_slice(merged, s, e)
+            slices.append(lambda b=blk: b)
+        return Dataset(slices)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle (materializes; push-based shuffle is the planned
+        scale path, reference _internal/push_based_shuffle.py)."""
+        rows = list(self.iter_rows())
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(rows))
+        shuffled = [rows[i] for i in order]
+        return from_items(shuffled, override_num_blocks=max(1, self.num_blocks()))
+
+    def split_at(self, rank: int, world_size: int) -> "Dataset":
+        """Contiguous block-range shard for one worker (streaming split)."""
+        n = self.num_blocks()
+        if n % world_size == 0:
+            per = n // world_size
+            fns = self._block_fns[rank * per : (rank + 1) * per]
+        else:
+            fns = self._block_fns[rank::world_size]
+        return Dataset(fns, list(self._ops))
+
+    # aliases matching the reference API
+    def split(self, n: int) -> List["Dataset"]:
+        return [self.split_at(i, n) for i in builtins.range(n)]
+
+    # ---- execution ----
+
+    def _compute_blocks(self, parallel: bool = True) -> List[Any]:
+        return list(self._iter_computed_blocks(parallel=parallel))
+
+    def _iter_computed_blocks(self, parallel: bool = True, window: int = 4):
+        """Streaming block computation: submit up to `window` block tasks
+        ahead and yield in order (backpressure against unbounded memory)."""
+        import ray_tpu
+
+        ops = self._ops
+        use_tasks = parallel and ray_tpu.is_initialized() and len(self._block_fns) > 1
+
+        if not use_tasks:
+            for fn in self._block_fns:
+                yield _apply_ops(fn(), ops)
+            return
+
+        exec_task = ray_tpu.remote(_execute_block)
+        pending: List[Any] = []
+        fn_iter = iter(self._block_fns)
+        for fn in itertools.islice(fn_iter, window):
+            pending.append(exec_task.remote(fn(), ops))
+        while pending:
+            ref = pending.pop(0)
+            nxt = next(fn_iter, None)
+            if nxt is not None:
+                pending.append(exec_task.remote(nxt(), ops))
+            yield ray_tpu.get(ref)
+
+    def materialize(self) -> "Dataset":
+        blocks = self._compute_blocks()
+        return Dataset([lambda b=b: b for b in blocks])
+
+    # ---- consumption ----
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_computed_blocks():
+            yield from _block_to_rows(block)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        prefetch_blocks: int = 2,
+    ) -> Iterator[Batch]:
+        carry = None
+        for block in self._iter_computed_blocks(window=max(1, prefetch_blocks)):
+            if carry is not None:
+                block = _block_concat([carry, block])
+                carry = None
+            n = _block_num_rows(block)
+            s = 0
+            while n - s >= batch_size:
+                yield _block_slice(block, s, s + batch_size)
+                s += batch_size
+            if s < n:
+                carry = _block_slice(block, s, n)
+        if carry is not None and not drop_last:
+            yield carry
+
+    def iter_device_batches(
+        self,
+        *,
+        batch_size: int,
+        mesh=None,
+        rules=None,
+        drop_last: bool = True,
+        prefetch: int = 2,
+    ):
+        """TPU feed path: host batches -> sharded device arrays, with a
+        `prefetch`-deep pipeline so device_put overlaps the step (the
+        iter_torch_batches ergonomics of the reference, device-native)."""
+        import collections
+
+        import jax
+
+        batch_axes = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = rules.spec("batch") if rules is not None else P()
+            batch_axes = spec[0] if len(spec) else None
+
+        def to_device(batch):
+            def put(v):
+                arr = np.asarray(v)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    # shard dim 0 (batch); replicate the rest, rank-aware
+                    s = NamedSharding(
+                        mesh, P(*([batch_axes] + [None] * (arr.ndim - 1)))
+                    )
+                    return jax.device_put(arr, s)
+                return jax.device_put(arr)
+
+            if isinstance(batch, dict):
+                return {k: put(v) for k, v in batch.items()}
+            return put(batch)
+
+        queue: collections.deque = collections.deque()
+        it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
+        for batch in it:
+            queue.append(to_device(batch))
+            if len(queue) > prefetch:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(_block_num_rows(b) for b in self._iter_computed_blocks())
+
+    def schema(self):
+        for block in self._iter_computed_blocks(parallel=False):
+            if isinstance(block, dict):
+                return {k: getattr(v, "dtype", type(v)) for k, v in block.items()}
+            try:
+                import pyarrow as pa
+
+                if isinstance(block, pa.Table):
+                    return block.schema
+            except ImportError:
+                pass
+            rows = list(_block_to_rows(block))
+            return type(rows[0]) if rows else None
+        return None
+
+    def to_pandas(self):
+        import pandas as pd
+
+        rows = self.take_all()
+        if rows and isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"value": rows})
+
+
+# --------------------------------------------------------------------------
+# sources
+# --------------------------------------------------------------------------
+
+
+def from_items(items: List[Any], *, override_num_blocks: int = 8) -> Dataset:
+    n = max(1, min(override_num_blocks, len(items) or 1))
+    per = (len(items) + n - 1) // n
+    chunks = [items[i * per : (i + 1) * per] for i in builtins.range(n)]
+    chunks = [c for c in chunks if c]
+    return Dataset([lambda c=c: c for c in chunks])
+
+
+def range(n: int, *, override_num_blocks: int = 8) -> Dataset:  # noqa: A001
+    k = max(1, min(override_num_blocks, n or 1))
+    per = (n + k - 1) // k
+    spans = [(i * per, min((i + 1) * per, n)) for i in builtins.range(k)]
+    spans = [s for s in spans if s[0] < s[1]]
+    return Dataset(
+        [lambda s=s: {"id": np.arange(s[0], s[1], dtype=np.int64)} for s in spans]
+    )
+
+
+def from_numpy(arr: np.ndarray, *, override_num_blocks: int = 8) -> Dataset:
+    chunks = np.array_split(arr, override_num_blocks)
+    return Dataset([lambda c=c: {"data": c} for c in chunks if len(c)])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([lambda: {c: df[c].to_numpy() for c in df.columns}])
+
+
+def _file_blocks(paths, read_one: Callable[[str], Any]) -> Dataset:
+    import glob as globmod
+    import os
+
+    expanded: List[str] = []
+    for p in paths if isinstance(paths, (list, tuple)) else [paths]:
+        if os.path.isdir(p):
+            expanded.extend(sorted(globmod.glob(os.path.join(p, "*"))))
+        elif any(ch in p for ch in "*?["):
+            expanded.extend(sorted(globmod.glob(p)))
+        else:
+            expanded.append(p)
+    if not expanded:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return Dataset([lambda p=p: read_one(p) for p in expanded])
+
+
+def read_parquet(paths) -> Dataset:
+    import pyarrow.parquet as pq
+
+    return _file_blocks(paths, lambda p: pq.read_table(p))
+
+
+def read_csv(paths) -> Dataset:
+    import pyarrow.csv as pacsv
+
+    return _file_blocks(paths, lambda p: pacsv.read_csv(p))
+
+
+def read_json(paths) -> Dataset:
+    import json
+
+    def read_one(p):
+        with open(p) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    return _file_blocks(paths, read_one)
+
+
+def read_numpy(paths) -> Dataset:
+    return _file_blocks(paths, lambda p: {"data": np.load(p)})

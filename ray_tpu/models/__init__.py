@@ -1,0 +1,11 @@
+"""Model zoo: TPU-first pure-functional models (pytree params + jit-able
+apply fns, logical-axis sharding annotations)."""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    param_specs,
+    make_forward,
+    make_loss_fn,
+    CONFIGS,
+)

@@ -1,0 +1,54 @@
+"""Dense causal attention with GQA.
+
+The einsum formulation keeps both matmuls on the MXU with a single fused
+softmax between them; logits accumulate in f32. For long sequences use
+ring_attention (sequence-parallel) — this kernel materializes [B,H,L,L]
+scores and is intended for L up to a few thousand per shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, Lq, H, D]
+    k: jnp.ndarray,  # [B, Lk, Hkv, D]
+    v: jnp.ndarray,  # [B, Lk, Hkv, D]
+    *,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    q_offset: int = 0,
+    segment_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """q_offset: global position of q[0] relative to k[0] (for decode steps
+    and sequence-parallel blocks)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(lq)[:, None] + q_offset
+        kpos = jnp.arange(lk)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+    if segment_ids is not None:
+        mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out

@@ -1,0 +1,27 @@
+"""Losses. Cross-entropy computed blockwise-stable in f32 without
+materializing one-hot labels (vocab can be sharded on tp; XLA keeps the
+log-softmax fused with the unembed matmul)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_with_int_labels(
+    logits: jnp.ndarray,  # [..., vocab]
+    labels: jnp.ndarray,  # [...], int
+    where=None,  # optional bool mask [...]
+):
+    """Returns (mean_loss, total_weight)."""
+    logits = logits.astype(jnp.float32)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - jax.lax.stop_gradient(m)
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logits
+    if where is not None:
+        w = where.astype(jnp.float32)
+        total = jnp.maximum(jnp.sum(w), 1.0)
+        return jnp.sum(nll * w) / total, total
+    return jnp.mean(nll), jnp.array(nll.size, jnp.float32)

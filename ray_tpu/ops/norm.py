@@ -1,0 +1,15 @@
+"""RMSNorm. Computed in f32 regardless of input dtype (TPU numerics: bf16
+accumulation of squares loses ~3 decimal digits), cast back on output; XLA
+fuses the whole thing into neighbouring ops so no Pallas kernel is needed."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * scale.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,17 @@
+"""Parallelism library: meshes, sharding rules, collectives, SP/PP/EP.
+
+This is the subsystem the reference does NOT have natively (SURVEY §2.4):
+where Ray delegates DP to torch-DDP/NCCL and leaves TP/PP/SP to external
+libraries (Alpa), ray_tpu makes every parallelism a first-class mesh axis
+lowered by GSPMD/XLA onto ICI/DCN.
+"""
+
+from .mesh import MeshSpec, build_mesh, local_device_count  # noqa: F401
+from .sharding import (  # noqa: F401
+    LOGICAL_AXES,
+    ShardingRules,
+    PRESET_RULES,
+    logical_spec,
+    logical_sharding,
+    constrain,
+)

@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over the `pp` axis.
+
+Net-new vs the reference (SURVEY §2.4: PP "Not in-repo; Alpa release tests
+only"). Stages live on the `pp` mesh axis (typically across DCN / multi-
+slice); activations hop stage-to-stage with `ppermute`; a scan over
+n_microbatches + pp - 1 ticks keeps every stage busy after warmup. The
+backward pipeline falls out of autodiff (ppermute transposes to the reverse
+permutation), so one combinator serves training and inference.
+
+Runs inside shard_map manual over `pp` only — dp/fsdp/tp/sp stay auto, so
+GSPMD still shards each stage's internals from the sharding table.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatches: int):
+    """Runs on one stage (inside shard_map). x_mb: [n_mb, mb, ...] full input
+    (only stage 0 reads it); returns [n_mb, mb, ...] outputs (valid on the
+    last stage, zeros elsewhere — caller psums over pp to broadcast)."""
+    pp = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_mb = n_microbatches
+    total_ticks = n_mb + pp - 1
+    # strip the local stage dim: leaves are [1, ...] here
+    local_params = jax.tree.map(lambda p: p[0], stage_params)
+    mb_shape = x_mb.shape[1:]
+    fwd = jax.checkpoint(lambda x: stage_fn(local_params, x))
+
+    send_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        # stage 0 ingests microbatch t (clamped; inactive ticks are masked)
+        mb_idx = jnp.clip(t, 0, n_mb - 1)
+        x0 = lax.dynamic_index_in_dim(x_mb, mb_idx, axis=0, keepdims=False)
+        x_in = jnp.where(stage == 0, x0, recv)
+        y = fwd(x_in)
+        # pass activations downstream for the next tick
+        new_recv = lax.ppermute(y, axis_name, send_perm)
+        # last stage stores its (active) output at t - (pp - 1)
+        is_active_last = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+        store_idx = jnp.clip(t - (pp - 1), 0, n_mb - 1)
+        cur = lax.dynamic_index_in_dim(out_buf, store_idx, axis=0, keepdims=False)
+        upd = jnp.where(is_active_last, y, cur)
+        out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, store_idx, axis=0)
+        return (new_recv, out_buf), None
+
+    recv0 = jnp.zeros(mb_shape, x_mb.dtype)
+    out0 = jnp.zeros((n_mb,) + mb_shape, x_mb.dtype)
+    (recv, out_buf), _ = lax.scan(tick, (recv0, out0), jnp.arange(total_ticks))
+    # only the last stage holds real outputs; zero elsewhere then psum to
+    # broadcast. psum in f32: bf16 all-reduce hits an XLA CHECK on the CPU
+    # backend (hlo_instruction.cc "Invalid binary instruction opcode copy").
+    out_buf = jnp.where(stage == pp - 1, out_buf, jnp.zeros_like(out_buf))
+    return lax.psum(out_buf.astype(jnp.float32), axis_name).astype(out_buf.dtype)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Apply a pp-stage pipeline to x: [B, ...].
+
+    stage_params: pytree whose leaves have leading dim pp (sharded on `pp`).
+    stage_fn(params_one_stage, x_mb) -> y_mb with matching shapes.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by n_microbatches {n_microbatches}")
+    x_mb = x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = partial(
+        _pipeline_local, stage_fn, axis_name=axis_name, n_microbatches=n_microbatches
+    )
+    out_mb = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_vma=False,
+        axis_names=frozenset({axis_name}),
+    )(stage_params, x_mb)
+    return out_mb.reshape((b,) + out_mb.shape[2:])

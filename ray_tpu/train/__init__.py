@@ -1,0 +1,13 @@
+"""ray_tpu.train: distributed training (Ray Train parity, TPU-native).
+
+Where the reference's DataParallelTrainer spawns N torch-DDP workers with
+NCCL process groups (train/torch/config.py:113), JaxTrainer spawns ONE
+worker per HOST; each worker's train_loop compiles a single SPMD program
+under jit over the pod-slice mesh and GSPMD owns all collectives.
+"""
+
+from .step import TrainState, make_train_step, make_sharded_init  # noqa: F401
+from .trainer import JaxTrainer  # noqa: F401
+from .config import ScalingConfig, RunConfig, FailureConfig, CheckpointConfig  # noqa: F401
+from .session import report, get_context  # noqa: F401
+from .checkpoint import Checkpoint, save_checkpoint, restore_checkpoint  # noqa: F401

@@ -1,0 +1,54 @@
+"""Typed training configs.
+
+Reference parity: python/ray/air/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many worker processes and what each owns.
+
+    TPU-native semantics: num_workers = HOSTS (one SPMD process per host,
+    owning all its chips through one mesh), not devices — the reference's
+    one-worker-per-GPU model (ScalingConfig num_workers * 1 GPU) does not
+    map to XLA's single-client-per-host runtime.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpu_chips_per_worker: int = 4
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "SPREAD"
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.tpu_chips_per_worker))
+        res.setdefault("CPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)

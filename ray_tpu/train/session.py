@@ -1,0 +1,67 @@
+"""Worker-side training session.
+
+Reference parity: ray.air.session (air/session.py:43 report, :97
+get_checkpoint, :359 get_dataset_shard) + _TrainSession
+(train/_internal/session.py:76): the user's train loop calls
+session.report(metrics, checkpoint=...) and the trainer streams them out.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    trial_name: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Any] = None
+    results: "queue.Queue" = field(default_factory=queue.Queue)
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+_ctx = threading.local()
+
+
+def _set_context(ctx: TrainContext):
+    _ctx.value = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_ctx, "value", None)
+    if ctx is None:
+        raise RuntimeError("session API used outside a train loop")
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
+    ctx = get_context()
+    ctx.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def get_checkpoint():
+    return get_context().checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().dataset_shards.get(name)
+
+
+def get_world_rank() -> int:
+    return get_context().world_rank
+
+
+def get_world_size() -> int:
+    return get_context().world_size
+
+
+def get_local_rank() -> int:
+    return get_context().local_rank

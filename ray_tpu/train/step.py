@@ -1,0 +1,122 @@
+"""Sharded train-step factory.
+
+The TPU-native replacement for the reference's whole DDP/FSDP/DeepSpeed
+engine zoo (train_loop_utils.py:75 prepare_model): one jit'ed function with
+NamedSharding in/out specs; GSPMD inserts gradient all-reduces (dp), param
+all-gathers + grad reduce-scatters (fsdp = ZeRO-3), activation collectives
+(tp), and ring/all-to-all exchanges (sp) from the sharding table alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig, init_params, make_loss_fn, param_specs
+from ..parallel.sharding import ShardingRules
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _param_shardings(mesh: Mesh, rules: ShardingRules, specs_tree):
+    def is_spec(x):
+        return isinstance(x, tuple) and all(a is None or isinstance(a, str) for a in x)
+
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, rules.spec(*axes)), specs_tree, is_leaf=is_spec
+    )
+
+
+def _opt_shardings(opt_state_shapes, params_shapes, params_shardings, mesh):
+    """Optimizer-state leaves mirror param leaves structurally (adam mu/nu);
+    match by array shape — equal-shaped params share equal specs in our
+    models, scalars replicate."""
+    by_shape = {}
+    flat_p, _ = jax.tree.flatten(params_shapes)
+    flat_s, _ = jax.tree.flatten(params_shardings)
+    for p, s in zip(flat_p, flat_s):
+        by_shape[tuple(p.shape)] = s
+    replicated = NamedSharding(mesh, P())
+
+    def pick(leaf):
+        return by_shape.get(tuple(leaf.shape), replicated)
+
+    return jax.tree.map(pick, opt_state_shapes)
+
+
+def make_sharded_init(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    rules: ShardingRules,
+    optimizer: optax.GradientTransformation,
+) -> Tuple[Callable[[jax.Array], TrainState], Any]:
+    """Returns (init_fn, state_shardings). init_fn is jit'ed with sharded
+    outputs so params are born distributed — no host-memory spike."""
+    specs = param_specs(cfg)
+    p_shard = _param_shardings(mesh, rules, specs)
+    p_shapes = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
+    o_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    o_shard = _opt_shardings(o_shapes, p_shapes, p_shard, mesh)
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()), params=p_shard, opt_state=o_shard
+    )
+
+    def _init(rng) -> TrainState:
+        params = init_params(rng, cfg)
+        opt_state = optimizer.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+    init_jit = jax.jit(_init, out_shardings=state_shardings)
+    return init_jit, state_shardings
+
+
+def batch_sharding(mesh: Mesh, rules: ShardingRules) -> Dict[str, NamedSharding]:
+    # Raw batches arrive batch-sharded only (their seq length is often L+1,
+    # not divisible by sp); activations get resharded onto `sp` by the first
+    # sharding constraint inside the compiled program.
+    tok = NamedSharding(mesh, rules.spec("batch", None))
+    return {"tokens": tok, "mask": tok}
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    rules: ShardingRules,
+    optimizer: optax.GradientTransformation,
+    state_shardings: TrainState,
+):
+    """Returns train_step(state, batch) -> (state, metrics), jit'ed with
+    donated state (in-place HBM update) and sharded in/out."""
+    loss_fn = make_loss_fn(cfg, rules, mesh)
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(state.step + 1, new_params, new_opt)
+        return new_state, {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
+
+    b_shard = batch_sharding(mesh, rules)
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, b_shard),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100):
+    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, 10000, lr * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
