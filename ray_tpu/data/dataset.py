@@ -75,6 +75,20 @@ def _block_concat(blocks: List[Any]):
     return out
 
 
+def _block_take(block, indices):
+    """Row gather preserving block format."""
+    if isinstance(block, dict):
+        return {k: np.asarray(v)[indices] for k, v in block.items()}
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return block.take(indices)
+    except ImportError:
+        pass
+    return [block[i] for i in indices]
+
+
 def _block_to_rows(block) -> Iterator[Any]:
     if isinstance(block, dict):
         keys = list(block)
@@ -133,8 +147,11 @@ def _apply_ops(block, ops: List[_Op]):
     return block
 
 
-def _execute_block(block_or_ref, ops: List[_Op]):
-    return _apply_ops(block_or_ref, ops)
+def _execute_block(block_fn, ops: List[_Op]):
+    """Runs inside a task: the source read (block_fn) AND the op chain both
+    execute off-driver so I/O parallelizes and the driver stays off the data
+    path (reference: plan_read_op.py fuses read+transform into one task)."""
+    return _apply_ops(block_fn(), ops)
 
 
 class Dataset:
@@ -193,12 +210,25 @@ class Dataset:
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Global shuffle (materializes; push-based shuffle is the planned
-        scale path, reference _internal/push_based_shuffle.py)."""
-        rows = list(self.iter_rows())
+        scale path, reference _internal/push_based_shuffle.py). Preserves the
+        block format (dict-of-numpy stays dict-of-numpy)."""
+        blocks = self._compute_blocks()
+        if not blocks:
+            return Dataset([])
+        merged = _block_concat(blocks) if len(blocks) > 1 else blocks[0]
+        n = _block_num_rows(merged)
+        if n == 0:
+            return Dataset([lambda: merged])
         rng = np.random.default_rng(seed)
-        order = rng.permutation(len(rows))
-        shuffled = [rows[i] for i in order]
-        return from_items(shuffled, override_num_blocks=max(1, self.num_blocks()))
+        order = rng.permutation(n)
+        shuffled = _block_take(merged, order)
+        k = max(1, self.num_blocks())
+        per = (n + k - 1) // k
+        slices = [
+            _block_slice(shuffled, s, min(s + per, n))
+            for s in builtins.range(0, n, per)
+        ]
+        return Dataset([lambda b=b: b for b in slices])
 
     def split_at(self, rank: int, world_size: int) -> "Dataset":
         """Contiguous block-range shard for one worker (streaming split)."""
@@ -236,12 +266,12 @@ class Dataset:
         pending: List[Any] = []
         fn_iter = iter(self._block_fns)
         for fn in itertools.islice(fn_iter, window):
-            pending.append(exec_task.remote(fn(), ops))
+            pending.append(exec_task.remote(fn, ops))
         while pending:
             ref = pending.pop(0)
             nxt = next(fn_iter, None)
             if nxt is not None:
-                pending.append(exec_task.remote(nxt(), ops))
+                pending.append(exec_task.remote(nxt, ops))
             yield ray_tpu.get(ref)
 
     def materialize(self) -> "Dataset":

@@ -1,0 +1,168 @@
+"""ray_tpu.serve: online model serving.
+
+Reference parity: python/ray/serve — @serve.deployment (api.py:241),
+serve.run (api.py:413), deployment composition via bind (deployment.py:261),
+controller reconciliation (controller.py:79), replica autoscaling
+(autoscaling_policy.py), @serve.batch (batching.py), HTTP proxy
+(http_proxy.py:320).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Union
+
+from .batching import batch  # noqa: F401
+from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
+from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+
+_PROXY_NAME = "SERVE_HTTP_PROXY"
+
+
+def deployment(
+    _func_or_class=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 100,
+    autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+):
+    """@serve.deployment decorator."""
+
+    def wrap(func_or_class):
+        ac = autoscaling_config
+        if isinstance(ac, dict):
+            ac = AutoscalingConfig(**ac)
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=ac,
+            ray_actor_options=ray_actor_options or {},
+        )
+        return Deployment(func_or_class, name or func_or_class.__name__, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def _get_or_create_controller():
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    from .controller import ServeController
+
+    Ctl = ray_tpu.remote(ServeController)
+    h = Ctl.options(name=CONTROLLER_NAME, lifetime="detached", max_concurrency=16).remote()
+    ray_tpu.get(h.ready.remote())
+    return h
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    _blocking: bool = True,
+) -> DeploymentHandle:
+    """Deploy an application graph; returns a handle to its ingress."""
+    import ray_tpu
+
+    if not isinstance(app, Application):
+        raise TypeError("serve.run takes the result of deployment.bind(...)")
+    controller = _get_or_create_controller()
+
+    ordered = app._walk({})  # dependencies first, ingress last
+    specs = []
+    for dep_name, node in ordered.items():
+        def to_handle(a):
+            if isinstance(a, Application):
+                return DeploymentHandle(a.deployment.name)
+            return a
+
+        specs.append(
+            {
+                "name": dep_name,
+                "func_or_class": node.deployment.func_or_class,
+                "init_args": tuple(to_handle(a) for a in node.args),
+                "init_kwargs": {k: to_handle(v) for k, v in node.kwargs.items()},
+                "config": node.deployment.config,
+            }
+        )
+    ingress = app.deployment.name
+    ray_tpu.get(controller.deploy_application.remote(name, specs, ingress))
+
+    if route_prefix is not None:
+        proxy = start_http_proxy()
+        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress))
+    return DeploymentHandle(ingress)
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
+    """Idempotently start the HTTP proxy actor; returns its handle."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:
+        pass
+    from .http_proxy import HTTPProxyActor
+
+    Proxy = ray_tpu.remote(HTTPProxyActor)
+    h = Proxy.options(name=_PROXY_NAME, lifetime="detached", max_concurrency=32).remote(
+        host, port
+    )
+    ray_tpu.get(h.ready.remote())
+    return h
+
+
+def proxy_address() -> Optional[str]:
+    import ray_tpu
+
+    try:
+        h = ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:
+        return None
+    info = ray_tpu.get(h.ready.remote())
+    return f"{info['host']}:{info['port']}"
+
+
+def status() -> Dict[str, dict]:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str = "default"):
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.delete_application.remote(name))
+
+
+def shutdown():
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=10)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+        ray_tpu.get(proxy.stop.remote(), timeout=5)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass

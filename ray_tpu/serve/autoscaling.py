@@ -1,0 +1,21 @@
+"""Queue-depth autoscaling policy.
+
+Reference parity: serve/_private/autoscaling_policy.py:9
+(calculate_desired_num_replicas: desired = ongoing / target_per_replica,
+clamped to [min, max]).
+"""
+
+from __future__ import annotations
+
+import math
+
+from .deployment import AutoscalingConfig
+
+
+def calculate_desired_num_replicas(
+    config: AutoscalingConfig, total_ongoing_requests: float, current_replicas: int
+) -> int:
+    if current_replicas == 0:
+        return config.min_replicas
+    desired = math.ceil(total_ongoing_requests / max(config.target_ongoing_requests, 1e-9))
+    return max(config.min_replicas, min(config.max_replicas, desired))

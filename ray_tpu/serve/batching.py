@@ -1,0 +1,92 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Reference parity: serve/batching.py (_BatchQueue: collect up to
+max_batch_size requests or batch_wait_timeout_s, call the wrapped fn once
+with the list, scatter results). Implemented with a flusher thread because
+replica methods execute on a thread pool (see _private/worker_main.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, self_arg, item) -> Future:
+        fut: Future = Future()
+        self.q.put((self_arg, item, fut))
+        return fut
+
+    def _flush_loop(self):
+        while True:
+            first = self.q.get()
+            batch = [first]
+            deadline = self.timeout_s
+            import time
+
+            t0 = time.monotonic()
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self.q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self_arg = batch[0][0]
+            items = [b[1] for b in batch]
+            futs = [b[2] for b in batch]
+            try:
+                if self_arg is None:
+                    results = self.fn(items)
+                else:
+                    results = self.fn(self_arg, items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch fn returned {len(results)} results for "
+                        f"{len(items)} inputs"
+                    )
+                for f, r in zip(futs, results):
+                    f.set_result(r)
+            except Exception as e:  # noqa: BLE001
+                for f in futs:
+                    f.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """Decorate a method taking List[T] -> List[R]; callers pass single T."""
+
+    def decorator(fn):
+        bq_attr = f"__batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                self_arg, item = args
+                holder = self_arg
+            else:  # plain function: (item,)
+                (item,) = args
+                self_arg, holder = None, wrapper
+            bq = getattr(holder, bq_attr, None)
+            if bq is None:
+                bq = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                setattr(holder, bq_attr, bq)
+            return bq.submit(self_arg, item).result()
+
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

@@ -1,0 +1,185 @@
+"""ServeController: the reconciliation control plane, as a named actor.
+
+Reference parity: serve/controller.py:79 (ServeController detached actor),
+deployment_state.py:2073 (DeploymentStateManager reconciling target vs live
+replicas), autoscaling decision loop (_private/autoscaling_policy.py:69-141).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .autoscaling import calculate_desired_num_replicas
+from .deployment import AutoscalingConfig, DeploymentConfig
+from .replica import Replica
+
+
+class _DeploymentState:
+    def __init__(self, name: str, func_or_class, init_args, init_kwargs, config):
+        self.name = name
+        self.func_or_class = func_or_class
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config: DeploymentConfig = config
+        self.replicas: List[Any] = []  # ActorHandles
+        self.target: int = (
+            config.autoscaling_config.min_replicas
+            if config.autoscaling_config
+            else config.num_replicas
+        )
+        self.last_scale_ts = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._loop_thread.start()
+
+    def ready(self):
+        return True
+
+    # ---------------------------------------------------------- deploy API
+
+    def deploy_application(self, app_name: str, specs: List[dict], ingress: str):
+        """specs: [{name, func_or_class, init_args, init_kwargs, config}],
+        dependencies first (so handles in init args resolve to live replicas)."""
+        with self._lock:
+            self._apps[app_name] = {"deployments": [s["name"] for s in specs], "ingress": ingress}
+        for s in specs:
+            with self._lock:
+                state = self._deployments.get(s["name"])
+                if state is None:
+                    state = _DeploymentState(
+                        s["name"], s["func_or_class"], s["init_args"], s["init_kwargs"], s["config"]
+                    )
+                    self._deployments[s["name"]] = state
+                else:  # redeploy: replace code/config, restart replicas
+                    state.func_or_class = s["func_or_class"]
+                    state.init_args = s["init_args"]
+                    state.init_kwargs = s["init_kwargs"]
+                    state.config = s["config"]
+                    ac = state.config.autoscaling_config
+                    state.target = ac.min_replicas if ac else state.config.num_replicas
+                    self._stop_replicas(state.replicas)
+                    state.replicas = []
+            self._reconcile(state)
+        return True
+
+    def get_replicas(self, deployment_name: str):
+        state = self._deployments.get(deployment_name)
+        if state is None:
+            raise ValueError(f"no deployment named {deployment_name!r}")
+        return list(state.replicas)
+
+    def get_ingress(self, app_name: str) -> str:
+        return self._apps[app_name]["ingress"]
+
+    def list_deployments(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "target": s.target,
+                "live": len(s.replicas),
+                "autoscaling": s.config.autoscaling_config is not None,
+            }
+            for name, s in self._deployments.items()
+        }
+
+    def delete_application(self, app_name: str):
+        app = self._apps.pop(app_name, None)
+        if not app:
+            return False
+        for name in app["deployments"]:
+            state = self._deployments.pop(name, None)
+            if state:
+                self._stop_replicas(state.replicas)
+        return True
+
+    def graceful_shutdown(self):
+        self._stop.set()
+        for state in self._deployments.values():
+            self._stop_replicas(state.replicas)
+        self._deployments.clear()
+        self._apps.clear()
+        return True
+
+    # ------------------------------------------------------- reconciliation
+
+    def _stop_replicas(self, replicas):
+        import ray_tpu
+
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def _reconcile(self, state: _DeploymentState):
+        import ray_tpu
+
+        while len(state.replicas) < state.target:
+            opts = dict(state.config.ray_actor_options)
+            opts.setdefault("num_cpus", 1)
+            ReplicaCls = ray_tpu.remote(Replica)
+            h = ReplicaCls.options(max_concurrency=8, **opts).remote(
+                state.name, state.func_or_class, state.init_args, state.init_kwargs
+            )
+            state.replicas.append(h)
+        if len(state.replicas) > state.target:
+            victims = state.replicas[state.target :]
+            state.replicas = state.replicas[: state.target]
+            self._stop_replicas(victims)
+        # block until new replicas constructed
+        import ray_tpu
+
+        ray_tpu.get([r.ready.remote() for r in state.replicas])
+
+    def _autoscale(self, state: _DeploymentState):
+        import ray_tpu
+
+        ac: AutoscalingConfig = state.config.autoscaling_config
+        try:
+            stats = ray_tpu.get(
+                [r.stats.remote() for r in state.replicas], timeout=5
+            )
+        except Exception:
+            return
+        total_ongoing = sum(s["ongoing"] for s in stats)
+        desired = calculate_desired_num_replicas(ac, total_ongoing, len(state.replicas))
+        now = time.time()
+        delay = ac.upscale_delay_s if desired > state.target else ac.downscale_delay_s
+        if desired != state.target and now - state.last_scale_ts >= delay:
+            state.target = desired
+            state.last_scale_ts = now
+            self._reconcile(state)
+
+    def _health_check(self, state: _DeploymentState):
+        import ray_tpu
+
+        alive = []
+        dead = 0
+        for r in state.replicas:
+            try:
+                ray_tpu.get(r.check_health.remote(), timeout=10)
+                alive.append(r)
+            except Exception:
+                dead += 1
+        if dead:
+            state.replicas = alive
+            self._reconcile(state)  # replace dead replicas
+
+    def _reconcile_loop(self):
+        while not self._stop.is_set():
+            time.sleep(0.25)
+            for state in list(self._deployments.values()):
+                try:
+                    if state.config.autoscaling_config is not None:
+                        self._autoscale(state)
+                    self._health_check(state)
+                except Exception:
+                    pass

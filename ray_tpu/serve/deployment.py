@@ -1,0 +1,81 @@
+"""Deployment declaration and application graphs.
+
+Reference parity: serve/deployment.py:97 (Deployment, bind :261),
+serve/api.py:241 (@serve.deployment decorator), serve/config.py
+(DeploymentConfig / AutoscalingConfig).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    health_check_period_s: float = 2.0
+
+
+class Deployment:
+    def __init__(self, func_or_class, name: str, config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def options(self, **kwargs) -> "Deployment":
+        import copy
+
+        cfg = copy.deepcopy(self.config)
+        name = kwargs.pop("name", self.name)
+        if "autoscaling_config" in kwargs:
+            ac = kwargs.pop("autoscaling_config")
+            cfg.autoscaling_config = (
+                AutoscalingConfig(**ac) if isinstance(ac, dict) else ac
+            )
+        for k, v in kwargs.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+            else:
+                raise ValueError(f"unknown deployment option {k!r}")
+        return Deployment(self.func_or_class, name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+class Application:
+    """A bound deployment node; bound args may contain other Applications
+    (composition — reference: serve DAG from Deployment.bind)."""
+
+    def __init__(self, deployment: Deployment, args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _walk(self, seen: Dict[str, "Application"]):
+        """Collect all Applications in the graph, ingress last."""
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, Application):
+                a._walk(seen)
+        if self.deployment.name in seen and seen[self.deployment.name] is not self:
+            raise ValueError(
+                f"two different deployments named {self.deployment.name!r} in one app"
+            )
+        seen[self.deployment.name] = self
+        return seen

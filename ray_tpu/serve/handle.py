@@ -1,0 +1,109 @@
+"""DeploymentHandle: the Python-native ingress to a deployment.
+
+Reference parity: serve/handle.py (DeploymentHandle/DeploymentResponse) with
+the router's power-of-two-choices replica selection (serve/_private/router.py:370)
+done handle-side over locally-tracked in-flight counts.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from typing import Any, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class DeploymentResponse:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.method_name = method_name
+        self._replicas = []
+        self._refreshed = 0.0
+        self._inflight: deque = deque()  # (replica_index, ref)
+        self._counts: dict = {}
+
+    # -- pickling: drop live state; reconnect lazily on the other side
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.method_name))
+
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, method_name or self.method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name)
+
+    # ------------------------------------------------------------- routing
+
+    def _controller(self):
+        import ray_tpu
+
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        if not force and time.time() - self._refreshed < 1.0 and self._replicas:
+            return
+        import ray_tpu
+
+        self._replicas = ray_tpu.get(
+            self._controller().get_replicas.remote(self.deployment_name)
+        )
+        self._refreshed = time.time()
+        self._counts = {i: self._counts.get(i, 0) for i in range(len(self._replicas))}
+
+    def _prune(self):
+        import ray_tpu
+
+        still = deque()
+        while self._inflight:
+            idx, ref = self._inflight.popleft()
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if ready:
+                self._counts[idx] = max(0, self._counts.get(idx, 1) - 1)
+            else:
+                still.append((idx, ref))
+        self._inflight = still
+
+    def _pick_replica(self) -> int:
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = random.sample(range(n), 2)
+        return a if self._counts.get(a, 0) <= self._counts.get(b, 0) else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        self._prune()
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
+        for attempt in range(2):
+            idx = self._pick_replica()
+            try:
+                ref = self._replicas[idx].handle_request.remote(
+                    self.method_name, args, kwargs
+                )
+                break
+            except Exception:
+                if attempt == 1:
+                    raise
+                self._refresh(force=True)  # replica set changed under us
+        self._counts[idx] = self._counts.get(idx, 0) + 1
+        self._inflight.append((idx, ref))
+        return DeploymentResponse(ref)

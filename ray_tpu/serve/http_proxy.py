@@ -1,0 +1,80 @@
+"""Minimal HTTP ingress: JSON POST/GET -> ingress DeploymentHandle.
+
+Reference parity: serve/_private/http_proxy.py:320 (HTTPProxy / HTTPProxyActor).
+The reference rides uvicorn+starlette; here a stdlib ThreadingHTTPServer is
+enough — TPU model serving is throughput-bound on the replicas, not the
+ingress parser.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self.routes: Dict[str, object] = {}  # route_prefix -> DeploymentHandle
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body):
+                route = self.path.rstrip("/") or "/"
+                handle = proxy.routes.get(route)
+                if handle is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no app at this route"}')
+                    return
+                try:
+                    args = () if body is None else (body,)
+                    result = handle.remote(*args).result(timeout_s=60)
+                    payload = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    body = raw.decode()
+                self._dispatch(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def ready(self):
+        return {"host": self.host, "port": self.port}
+
+    def set_route(self, route_prefix: str, deployment_name: str):
+        from .handle import DeploymentHandle
+
+        self.routes[route_prefix.rstrip("/") or "/"] = DeploymentHandle(deployment_name)
+        return True
+
+    def remove_route(self, route_prefix: str):
+        self.routes.pop(route_prefix.rstrip("/") or "/", None)
+        return True
+
+    def stop(self):
+        self._server.shutdown()
+        return True

@@ -1,0 +1,54 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference parity: serve/_private/replica.py:382 (RayServeReplica — wraps the
+user callable, tracks ongoing requests for autoscaling stats).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Dict
+
+
+class Replica:
+    def __init__(self, deployment_name: str, func_or_class, init_args, init_kwargs):
+        self.deployment_name = deployment_name
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if inspect.isclass(func_or_class):
+            self.callable = func_or_class(*init_args, **init_kwargs)
+            self.is_function = False
+        else:
+            self.callable = func_or_class
+            self.is_function = True
+
+    def ready(self):
+        return True
+
+    def handle_request(self, method_name: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self.is_function:
+                return self.callable(*args, **kwargs)
+            if method_name == "__call__":
+                fn = self.callable
+            else:
+                fn = getattr(self.callable, method_name)
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+
+    def check_health(self) -> bool:
+        user_check = getattr(self.callable, "check_health", None)
+        if user_check is not None and not self.is_function:
+            user_check()
+        return True

@@ -78,12 +78,13 @@ def make_sharded_init(
     return init_jit, state_shardings
 
 
-def batch_sharding(mesh: Mesh, rules: ShardingRules) -> Dict[str, NamedSharding]:
+def batch_sharding(mesh: Mesh, rules: ShardingRules) -> NamedSharding:
     # Raw batches arrive batch-sharded only (their seq length is often L+1,
     # not divisible by sp); activations get resharded onto `sp` by the first
-    # sharding constraint inside the compiled program.
-    tok = NamedSharding(mesh, rules.spec("batch", None))
-    return {"tokens": tok, "mask": tok}
+    # sharding constraint inside the compiled program. Returned as a single
+    # sharding used as a pytree PREFIX, so it applies to every leaf of the
+    # batch dict whether or not an (optional) mask is present.
+    return NamedSharding(mesh, rules.spec("batch", None))
 
 
 def make_train_step(

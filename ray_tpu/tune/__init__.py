@@ -1,0 +1,209 @@
+"""ray_tpu.tune: hyperparameter / experiment parallelism.
+
+Reference parity: python/ray/tune — Tuner (tune/tuner.py:53, fit :320),
+tune.run (tune/tune.py:293), search spaces (tune/search/sample.py),
+schedulers (tune/schedulers/), experiment resume (Tuner.restore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..train.config import RunConfig
+from .controller import TuneController
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .trainable import Trainable, report  # noqa: F401
+from .trial import Trial  # noqa: F401
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    """Reference parity: tune/result_grid.py."""
+
+    def __init__(self, trials: List[Trial], metric: str, mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __iter__(self):
+        return iter(self._trials)
+
+    def __getitem__(self, i):
+        return self._trials[i]
+
+    @property
+    def errors(self):
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> Trial:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [t for t in self._trials if t.metric(metric) is not None]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(scored, key=lambda t: t.metric(metric))
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{"trial_id": t.trial_id, **t.last_result} for t in self._trials])
+
+
+class Tuner:
+    """Reference parity: tune/tuner.py:53."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._resources = resources_per_trial
+        self._restored_trials: List[Trial] = []
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        metric = tc.metric or "_metric"
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self._space, num_samples=tc.num_samples, seed=tc.seed
+        )
+        controller = TuneController(
+            self._trainable,
+            searcher=searcher,
+            scheduler=tc.scheduler,
+            metric=metric,
+            mode=tc.mode,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            resources_per_trial=self._resources,
+            max_failures=self._run_config.failure_config.max_failures,
+            storage_path=self._run_config.storage_path,
+            experiment_name=self._run_config.name or "experiment",
+        )
+        controller.trials.extend(self._restored_trials)
+        trials = controller.run()
+        return ResultGrid(trials, metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, **kwargs) -> "Tuner":
+        """Resume an experiment: finished trials keep their results; unfinished
+        ones re-run from their last checkpoint (reference: tune/tuner.py restore)."""
+        import os
+        import pickle
+
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        storage_path, name = os.path.split(path.rstrip("/"))
+        run_config = kwargs.pop("run_config", None) or RunConfig(
+            name=name, storage_path=storage_path
+        )
+        class _Exhausted(Searcher):
+            def suggest(self, trial_id):
+                return None
+
+        tuner = cls(
+            trainable,
+            tune_config=kwargs.pop(
+                "tune_config",
+                TuneConfig(
+                    metric=state["metric"], mode=state["mode"], search_alg=_Exhausted()
+                ),
+            ),
+            run_config=run_config,
+            **kwargs,
+        )
+        from .trial import PENDING, TERMINATED
+
+        for ts in state["trials"]:
+            t = Trial(config=ts["config"], trial_id=ts["trial_id"])
+            t.last_result = ts["last_result"]
+            t.checkpoint = ts["checkpoint"]
+            if ts["status"] == TERMINATED:
+                t.status = TERMINATED
+            else:
+                t.status = PENDING
+            tuner._restored_trials.append(t)
+        return tuner
+
+
+def run(
+    trainable: Callable,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "max",
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    resources_per_trial: Optional[Dict[str, float]] = None,
+    max_concurrent_trials: int = 0,
+    storage_path: Optional[str] = None,
+    name: Optional[str] = None,
+) -> ResultGrid:
+    """Functional entry point (reference: tune/tune.py:293)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            scheduler=scheduler,
+            search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+        run_config=RunConfig(name=name, storage_path=storage_path),
+        resources_per_trial=resources_per_trial,
+    ).fit()
+
+
+def with_parameters(fn: Callable, **params) -> Callable:
+    """Bind large objects by reference (reference: tune/trainable/util.py)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        return fn(config, **params)
+
+    return wrapped

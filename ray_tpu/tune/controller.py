@@ -1,0 +1,215 @@
+"""TuneController: the experiment event loop.
+
+Reference parity: tune/execution/tune_controller.py:49 (step loop :267 —
+ask searcher → launch trial actors → route results to scheduler) plus
+experiment checkpointing (tune/execution/experiment_state.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from . import schedulers as sched_mod
+from .schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
+from .search import Searcher
+from .trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial
+from .trainable import TrialRunner
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable,
+        searcher: Searcher,
+        scheduler: Optional[TrialScheduler],
+        metric: str,
+        mode: str = "max",
+        max_concurrent_trials: int = 0,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        max_failures: int = 0,
+        storage_path: Optional[str] = None,
+        experiment_name: str = "experiment",
+        checkpoint_every_s: float = 5.0,
+    ):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.scheduler.set_properties(metric, mode)
+        self.searcher.set_search_properties(metric, mode, None)
+        self.max_concurrent = max_concurrent_trials or 8
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.max_failures = max_failures
+        self.trials: List[Trial] = []
+        self.storage_path = storage_path
+        self.experiment_name = experiment_name
+        self._ckpt_every = checkpoint_every_s
+        self._last_ckpt = 0.0
+        self._searcher_done = False
+
+    # ---------------------------------------------------------------- launch
+
+    def _launch(self, trial: Trial):
+        RunnerCls = ray_tpu.remote(TrialRunner)
+        opts: Dict[str, Any] = {"max_concurrency": 2, "num_cpus": self.resources.get("CPU", 1)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = self.resources["TPU"]
+        extra = {k: v for k, v in self.resources.items() if k not in ("CPU", "TPU")}
+        if extra:
+            opts["resources"] = extra
+        trial.actor = RunnerCls.options(**opts).remote(
+            trial.trial_id, trial.config, trial.checkpoint
+        )
+        trial.run_ref = trial.actor.run.remote(self.trainable)
+        trial.status = RUNNING
+
+    def _teardown(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+        trial.actor = None
+        trial.run_ref = None
+
+    # ------------------------------------------------------------------ loop
+
+    def _maybe_add_trial(self):
+        running = sum(1 for t in self.trials if t.status == RUNNING)
+        while running < self.max_concurrent:
+            # resume PAUSED (PBT exploit) and PENDING (restored/retried) first
+            waiting = [t for t in self.trials if t.status in (PAUSED, PENDING)]
+            if waiting:
+                self._launch(waiting[0])
+                running += 1
+                continue
+            if self._searcher_done:
+                break
+            trial_id = f"trial_{len(self.trials)}"
+            cfg = self.searcher.suggest(trial_id)
+            if cfg is None:
+                self._searcher_done = True
+                break
+            if cfg == "PENDING":
+                break
+            trial = Trial(config=cfg, trial_id=trial_id)
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(trial)
+            self._launch(trial)
+            running += 1
+
+    def _process_results(self, trial: Trial):
+        try:
+            reports, _done = ray_tpu.get(trial.actor.next_results.remote())
+        except Exception as e:  # actor died (worker crash/OOM) — retry path
+            self._fail_or_retry(trial, e)
+            return
+        for rep in reports:
+            metrics = rep["metrics"]
+            metrics.setdefault(
+                "training_iteration", len(trial.metrics_history) + 1
+            )
+            trial.last_result = metrics
+            trial.metrics_history.append(metrics)
+            if rep.get("checkpoint") is not None:
+                trial.checkpoint = rep["checkpoint"]
+            self.searcher.on_trial_result(trial.trial_id, metrics)
+            decision = self.scheduler.on_trial_result(trial, metrics)
+            if decision == STOP or metrics.get("done"):
+                self._complete(trial, TERMINATED)
+                return
+            if decision == PAUSE:
+                exploit = getattr(trial, "_pbt_exploit", None)
+                self._teardown(trial)
+                if exploit is not None:
+                    trial.config = exploit["config"]
+                    trial.checkpoint = exploit["checkpoint"]
+                    trial._pbt_exploit = None
+                trial.status = PAUSED
+                return
+
+    def _complete(self, trial: Trial, status: str, err: Optional[str] = None):
+        self._teardown(trial)
+        trial.status = status
+        trial.error = err
+        self.searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=status == ERROR
+        )
+        self.scheduler.on_trial_complete(trial)
+
+    def _check_done(self, trial: Trial):
+        if trial.run_ref is None:
+            return
+        ready, _ = ray_tpu.wait([trial.run_ref], timeout=0)
+        if not ready:
+            return
+        # drain any final reports before closing out
+        self._process_results(trial)
+        if trial.status != RUNNING:
+            return
+        try:
+            ray_tpu.get(trial.run_ref)
+            self._complete(trial, TERMINATED)
+        except Exception as e:  # noqa: BLE001
+            self._fail_or_retry(trial, e)
+
+    def _fail_or_retry(self, trial: Trial, err: Exception):
+        trial.num_failures += 1
+        if trial.num_failures <= self.max_failures:
+            self._teardown(trial)
+            trial.status = PENDING
+            self._launch(trial)
+        else:
+            self._complete(trial, ERROR, err=repr(err))
+
+    def step(self) -> bool:
+        """One controller iteration. Returns False when the experiment is over."""
+        self._maybe_add_trial()
+        for trial in list(self.trials):
+            if trial.status != RUNNING:
+                continue
+            self._process_results(trial)
+            if trial.status == RUNNING:
+                self._check_done(trial)
+        self._maybe_checkpoint()
+        live = any(t.status in (RUNNING, PENDING, PAUSED) for t in self.trials)
+        return live or not self._searcher_done
+
+    def run(self) -> List[Trial]:
+        while self.step():
+            time.sleep(0.02)
+        self._maybe_checkpoint(force=True)
+        return self.trials
+
+    # ----------------------------------------------------------- persistence
+
+    def _maybe_checkpoint(self, force: bool = False):
+        if not self.storage_path:
+            return
+        now = time.time()
+        if not force and now - self._last_ckpt < self._ckpt_every:
+            return
+        self._last_ckpt = now
+        exp_dir = os.path.join(self.storage_path, self.experiment_name)
+        os.makedirs(exp_dir, exist_ok=True)
+        state = {
+            "metric": self.metric,
+            "mode": self.mode,
+            "trials": [t.public_state() for t in self.trials],
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+
+    @staticmethod
+    def load_experiment_state(storage_path: str, experiment_name: str) -> Dict[str, Any]:
+        path = os.path.join(storage_path, experiment_name, "experiment_state.pkl")
+        with open(path, "rb") as f:
+            return pickle.load(f)

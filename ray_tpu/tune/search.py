@@ -1,0 +1,237 @@
+"""Search spaces and suggestion algorithms.
+
+Reference parity: tune/search/sample.py (Domain/Categorical/Float/Integer,
+grid_search), tune/search/basic_variant.py (BasicVariantGenerator: grid
+cross-product x num_samples random draws), tune/search/searcher.py (the
+Searcher plugin interface), tune/search/concurrency_limiter.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List, Optional
+
+
+# --------------------------------------------------------------------------
+# sample domains
+# --------------------------------------------------------------------------
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randint(self.lower, self.upper - 1)
+
+
+class Quantized(Domain):
+    def __init__(self, inner: Domain, q: float):
+        self.inner, self.q = inner, q
+
+    def sample(self, rng):
+        v = self.inner.sample(rng)
+        return round(v / self.q) * self.q
+
+
+class Function(Domain):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int = 1) -> Quantized:
+    return Quantized(Integer(lower, upper), q)
+
+
+def quniform(lower: float, upper: float, q: float) -> Quantized:
+    return Quantized(Float(lower, upper), q)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _resolve(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    """Sample every Domain leaf; grid leaves must already be substituted."""
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict) and not _is_grid(v):
+            out[k] = _resolve(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
+def _collect_grids(space: Dict[str, Any], prefix: str = "") -> Dict[str, list]:
+    """Find grid_search leaves at any nesting depth, keyed by dotted path."""
+    out = {}
+    for k, v in space.items():
+        if _is_grid(v):
+            out[prefix + k] = v["grid_search"]
+        elif isinstance(v, dict):
+            out.update(_collect_grids(v, prefix + k + "."))
+    return out
+
+
+def _set_path(cfg: Dict[str, Any], path: str, value) -> None:
+    keys = path.split(".")
+    d = cfg
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    d[keys[-1]] = value
+
+
+# --------------------------------------------------------------------------
+# searchers
+# --------------------------------------------------------------------------
+
+
+class Searcher:
+    """Plugin interface (reference: tune/search/searcher.py:73).
+
+    Subclasses implement suggest/on_trial_complete.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if self.metric is None:
+            self.metric = metric
+        if self.mode is None:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result=None, error: bool = False) -> None:
+        pass
+
+
+FINISHED = "FINISHED"
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples random draws
+    (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None):
+        super().__init__()
+        self._rng = random.Random(seed)
+        self._space = dict(space or {})
+        grid_map = _collect_grids(self._space)
+        grid_keys = list(grid_map)
+        self._variants: List[Dict[str, Any]] = []
+        for _ in range(num_samples):
+            if grid_keys:
+                for combo in itertools.product(*grid_map.values()):
+                    self._variants.append(dict(zip(grid_keys, combo)))
+            else:
+                self._variants.append({})
+        self._next = 0
+
+    @property
+    def total_samples(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._variants):
+            return None
+        fixed = self._variants[self._next]
+        self._next += 1
+        cfg = _resolve(self._space, self._rng)
+        for path, value in fixed.items():
+            _set_path(cfg, path, value)
+        return cfg
+
+
+class RandomSearch(BasicVariantGenerator):
+    pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference: tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "PENDING":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,116 @@
+"""Trial execution: the per-trial actor and the report API.
+
+Reference parity: tune/trainable/function_trainable.py:287 (FunctionTrainable
+runs the user fn in a thread; _StatusReporter queues results) and
+tune/trainable/trainable.py (class API: setup/step/save/restore).
+"""
+
+from __future__ import annotations
+
+import inspect
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..train.session import TrainContext, _set_context
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
+    """tune.report — usable from function trainables (and train loops)."""
+    from ..train import session
+
+    session.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint():
+    from ..train import session
+
+    return session.get_checkpoint()
+
+
+_get_checkpoint = get_checkpoint
+
+
+class Trainable:
+    """Class trainable API (reference: tune/trainable/trainable.py:107)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.iteration = 0
+        self.setup(config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return None
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+
+class TrialRunner:
+    """Actor hosting one trial (max_concurrency=2: run + result pump)."""
+
+    def __init__(self, trial_id: str, config: Dict[str, Any], checkpoint: Any = None):
+        self.trial_id = trial_id
+        self.config = config
+        self.checkpoint = checkpoint
+        self.ctx: Optional[TrainContext] = None
+        self._stop = threading.Event()
+
+    def ready(self):
+        return True
+
+    def run(self, trainable) -> Any:
+        self.ctx = TrainContext(
+            trial_name=self.trial_id, config=self.config, checkpoint=self.checkpoint
+        )
+        _set_context(self.ctx)
+        try:
+            if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+                return self._run_class(trainable)
+            sig = inspect.signature(trainable)
+            if len(sig.parameters) >= 1:
+                return trainable(self.config)
+            return trainable()
+        finally:
+            self.ctx.done.set()
+
+    def _run_class(self, cls) -> Any:
+        obj = cls(self.config)
+        if self.checkpoint is not None:
+            obj.load_checkpoint(self.checkpoint)
+        try:
+            while not self._stop.is_set():
+                result = obj.step()
+                obj.iteration += 1
+                result.setdefault("training_iteration", obj.iteration)
+                ckpt = obj.save_checkpoint()
+                self.ctx.results.put({"metrics": result, "checkpoint": ckpt})
+                if result.get("done"):
+                    break
+        finally:
+            obj.cleanup()
+        return None
+
+    def stop(self):
+        self._stop.set()
+        return True
+
+    def next_results(self, max_items: int = 100):
+        out = []
+        if self.ctx is None:
+            return out, False
+        while len(out) < max_items:
+            try:
+                out.append(self.ctx.results.get_nowait())
+            except queue.Empty:
+                break
+        return out, self.ctx.done.is_set()

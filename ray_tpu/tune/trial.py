@@ -1,0 +1,50 @@
+"""Trial record.
+
+Reference parity: tune/experiment/trial.py (status machine PENDING →
+RUNNING → {TERMINATED, ERROR, PAUSED}).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint: Optional[Any] = None
+    error: Optional[str] = None
+    num_failures: int = 0
+    # internal: live actor handle + pending run ref
+    actor: Any = None
+    run_ref: Any = None
+
+    @property
+    def training_iteration(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+    def metric(self, name: str, default=None):
+        return self.last_result.get(name, default)
+
+    def public_state(self) -> Dict[str, Any]:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "last_result": self.last_result,
+            "checkpoint": self.checkpoint,
+            "error": self.error,
+            "num_failures": self.num_failures,
+        }

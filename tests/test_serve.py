@@ -1,0 +1,168 @@
+"""Serve tests (reference model: python/ray/serve/tests/test_standalone.py,
+test_deployment_graph.py, test_batching.py, test_autoscaling_policy.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind(), name="echo_app")
+    assert handle.remote("hi").result() == {"echo": "hi"}
+
+
+def test_class_deployment_replicas(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def triple(self, x):
+            return x * 3
+
+    handle = serve.run(Doubler.bind(), name="doubler")
+    out = [handle.remote(i).result() for i in range(6)]
+    assert out == [0, 2, 4, 6, 8, 10]
+    # named method routing
+    assert handle.triple.remote(3).result() == 9
+    st = serve.status()
+    assert st["Doubler"]["live"] == 2
+
+
+def test_composition_graph(serve_cluster):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result()
+            return y * 10
+
+    app = Model.bind(Preprocess.bind())
+    handle = serve.run(app, name="graph")
+    assert handle.remote(4).result() == 50
+
+
+def test_batching(serve_cluster):
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            # whole batch processed at once
+            return [{"v": i, "batch_size": len(items)} for i in items]
+
+    handle = serve.run(Batched.bind(), name="batched")
+    responses = [handle.remote(i) for i in range(4)]
+    results = [r.result(timeout_s=10) for r in responses]
+    assert [r["v"] for r in results] == [0, 1, 2, 3]
+    assert max(r["batch_size"] for r in results) > 1  # actually batched
+
+
+def test_autoscaling_policy_math():
+    from ray_tpu.serve.autoscaling import calculate_desired_num_replicas
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    ac = AutoscalingConfig(min_replicas=1, max_replicas=10, target_ongoing_requests=2)
+    assert calculate_desired_num_replicas(ac, 0, 1) == 1
+    assert calculate_desired_num_replicas(ac, 9, 1) == 5
+    assert calculate_desired_num_replicas(ac, 100, 4) == 10  # clamped
+    assert calculate_desired_num_replicas(ac, 0, 0) == 1
+
+
+def test_autoscaling_e2e_upscale(serve_cluster):
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "upscale_delay_s": 0.1,
+            "downscale_delay_s": 60,
+        }
+    )
+    class Slow:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return x
+
+    handle = serve.run(Slow.bind(), name="slow")
+    # flood with concurrent requests to build queue depth
+    responses = [handle.remote(i) for i in range(12)]
+    deadline = time.time() + 15
+    scaled = False
+    while time.time() < deadline:
+        if serve.status()["Slow"]["live"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.25)
+    [r.result(timeout_s=30) for r in responses]
+    assert scaled, f"never scaled up: {serve.status()}"
+
+
+def test_redeploy_updates_code(serve_cluster):
+    @serve.deployment(name="V")
+    def v1(x):
+        return "v1"
+
+    @serve.deployment(name="V")
+    def v2(x):
+        return "v2"
+
+    h = serve.run(v1.bind(), name="app_v")
+    assert h.remote(0).result() == "v1"
+    h = serve.run(v2.bind(), name="app_v")
+    assert h.remote(0).result() == "v2"
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    def classify(body):
+        return {"label": "cat", "input": body}
+
+    serve.run(classify.bind(), name="http_app", route_prefix="/classify")
+    addr = serve.proxy_address()
+    assert addr is not None
+
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{addr}/classify",
+        data=json.dumps({"pixels": [1, 2]}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out["result"]["label"] == "cat"
+    assert out["result"]["input"] == {"pixels": [1, 2]}
+
+
+def test_delete_application(serve_cluster):
+    @serve.deployment
+    def f(x):
+        return x
+
+    serve.run(f.bind(), name="todelete")
+    assert "f" in serve.status()
+    serve.delete("todelete")
+    assert "f" not in serve.status()

@@ -1,0 +1,169 @@
+"""Tune tests (reference model: python/ray/tune/tests/test_tune_*.py,
+test_trial_scheduler.py, test_tuner_restore.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+
+
+@pytest.fixture
+def ray_cpus():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _objective(config):
+    score = -((config["x"] - 3.0) ** 2)
+    for i in range(3):
+        tune.report({"score": score + 0.01 * i, "training_iteration": i + 1})
+
+
+def test_grid_search(ray_cpus):
+    results = tune.run(
+        _objective,
+        config={"x": tune.grid_search([0.0, 1.0, 3.0])},
+        metric="score",
+        mode="max",
+    )
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.config["x"] == 3.0
+    assert not results.errors
+
+
+def test_random_search_num_samples(ray_cpus):
+    results = tune.run(
+        _objective,
+        config={"x": tune.uniform(-5, 5), "lr": tune.loguniform(1e-5, 1e-1)},
+        num_samples=8,
+        metric="score",
+        mode="max",
+    )
+    assert len(results) == 8
+    for t in results:
+        assert -5 <= t.config["x"] <= 5
+        assert 1e-5 <= t.config["lr"] <= 1e-1
+
+
+def test_asha_stops_bad_trials(ray_cpus):
+    def slow_objective(config):
+        for i in range(20):
+            tune.report({"score": config["x"] * (i + 1), "training_iteration": i + 1})
+
+    results = tune.run(
+        slow_objective,
+        # strong trials first: ASHA is asynchronous, so a rung's cutoff only
+        # exists once peers have recorded — weak trials arriving later get cut
+        config={"x": tune.grid_search([0.9, 1.0, 0.1, 0.2])},
+        metric="score",
+        mode="max",
+        scheduler=tune.ASHAScheduler(max_t=20, grace_period=2, reduction_factor=2),
+        max_concurrent_trials=4,
+    )
+    best = results.get_best_result()
+    assert best.config["x"] in (0.9, 1.0)
+    # at least one weak trial stopped before max_t
+    iters = [t.training_iteration for t in results]
+    assert min(iters) < 20
+
+
+def test_class_trainable_and_checkpoint(ray_cpus):
+    class Counter(tune.Trainable):
+        def setup(self, config):
+            self.count = 0
+
+        def step(self):
+            self.count += 1
+            return {"count": self.count, "done": self.count >= 5}
+
+        def save_checkpoint(self):
+            return {"count": self.count}
+
+        def load_checkpoint(self, ckpt):
+            self.count = ckpt["count"]
+
+    results = tune.run(Counter, config={}, metric="count", mode="max")
+    best = results.get_best_result()
+    assert best.metric("count") == 5
+    assert best.checkpoint == {"count": 5}
+
+
+def test_pbt_runs(ray_cpus):
+    def pbt_objective(config):
+        lr = config["lr"]
+        ckpt = tune.trainable._get_checkpoint()
+        score = ckpt["score"] if ckpt else 0.0
+        for i in range(10):
+            score += lr
+            tune.report(
+                {"score": score, "training_iteration": i + 1},
+                checkpoint={"score": score},
+            )
+
+    results = tune.run(
+        pbt_objective,
+        config={"lr": tune.uniform(0.1, 1.0)},
+        num_samples=4,
+        metric="score",
+        mode="max",
+        scheduler=tune.PopulationBasedTraining(
+            perturbation_interval=3,
+            hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)},
+            seed=0,
+        ),
+        max_concurrent_trials=4,
+    )
+    assert len(results) == 4
+    assert results.get_best_result().metric("score") > 0
+
+
+def test_failing_trial_reports_error(ray_cpus):
+    def bad(config):
+        raise ValueError("boom")
+
+    results = tune.run(bad, config={}, metric="score", mode="max")
+    assert len(results.errors) == 1
+
+
+def test_experiment_checkpoint_and_restore(ray_cpus, tmp_path):
+    results = tune.run(
+        _objective,
+        config={"x": tune.grid_search([1.0, 3.0])},
+        metric="score",
+        mode="max",
+        storage_path=str(tmp_path),
+        name="exp1",
+    )
+    assert os.path.exists(tmp_path / "exp1" / "experiment_state.pkl")
+    tuner = tune.Tuner.restore(str(tmp_path / "exp1"), _objective)
+    grid = tuner.fit()
+    # all trials were terminated, so restore just replays state
+    assert len(grid) == 2
+    assert grid.get_best_result().config["x"] == 3.0
+
+
+def test_median_stopping(ray_cpus):
+    sched = tune.MedianStoppingRule(grace_period=2, min_samples_required=2)
+    sched.set_properties("score", "max")
+    from ray_tpu.tune.trial import Trial
+
+    good, bad1, bad2 = Trial({"x": 1}), Trial({"x": 2}), Trial({"x": 3})
+    for i in range(5):
+        assert sched.on_trial_result(good, {"score": 10.0, "training_iteration": i + 1}) == "CONTINUE"
+        sched.on_trial_result(bad1, {"score": 5.0, "training_iteration": i + 1})
+    decision = sched.on_trial_result(bad2, {"score": 1.0, "training_iteration": 3})
+    assert decision == "STOP"
+
+
+def test_concurrency_limiter(ray_cpus):
+    searcher = tune.ConcurrencyLimiter(
+        tune.BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=6), max_concurrent=2
+    )
+    results = tune.run(_objective, search_alg=searcher, metric="score", mode="max")
+    assert len(results) == 6
+    assert not results.errors
