@@ -956,7 +956,8 @@ class Head:
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = node_id
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+        user_env_vars = (runtime_env or {}).get("env_vars") or {}
+        for k, v in user_env_vars.items():
             env[k] = str(v)
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         if needs_tpu:
@@ -965,10 +966,14 @@ class Head:
             env.pop("JAX_PLATFORMS", None)
         else:
             # Non-TPU workers must not grab the chips: exactly one process per
-            # host may own them. Also skip `site` (-S) — site hooks can be
-            # arbitrarily slow — and hand down the driver's sys.path instead.
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            if "env_vars" not in (runtime_env or {}) or "PYTHONPATH" not in (runtime_env or {}).get("env_vars", {}):
+            # host may own them. Overwrite (not setdefault) — the inherited
+            # value may name a TPU plugin platform whose registration hook
+            # lives in `site` packages, which -S below skips. Also skip `site`
+            # (-S) — site hooks can be arbitrarily slow — and hand down the
+            # driver's sys.path instead.
+            if "JAX_PLATFORMS" not in user_env_vars:
+                env["JAX_PLATFORMS"] = "cpu"
+            if "PYTHONPATH" not in user_env_vars:
                 env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
             argv.insert(1, "-S")
         w.proc = subprocess.Popen(argv, env=env, cwd=os.getcwd())

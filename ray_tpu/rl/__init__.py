@@ -1,0 +1,28 @@
+"""RL library: CPU rollout-actor fleets feeding TPU learners.
+
+Reference parity (SURVEY §2.3 RLlib rows, §3.6 call stack):
+  - `AlgorithmConfig` fluent config   <- rllib/algorithms/algorithm_config.py
+  - `RolloutWorker` / `WorkerSet`     <- rllib/evaluation/rollout_worker.py:166,
+                                         worker_set.py:80
+  - `SampleBatch`                     <- rllib/policy/sample_batch.py
+  - `Learner` / `LearnerGroup`        <- rllib/core/learner/learner.py:170,
+                                         learner_group.py:61
+  - `Algorithm` (a tune Trainable)    <- rllib/algorithms/algorithm.py:149
+  - `PPO`                             <- rllib/algorithms/ppo
+
+TPU-first design: the sampling side stays numpy-on-CPU actors (envs are
+Python), while the gradient side is a single pure-JAX update compiled over a
+device mesh — epochs x minibatches run inside ONE jitted program
+(lax.scan), not a Python SGD loop, and scale over the `dp` mesh axis via
+sharded batches instead of the reference's NCCL allreduce between learner
+actors.
+"""
+
+from .algorithm import Algorithm, WorkerSet  # noqa: F401
+from .config import AlgorithmConfig  # noqa: F401
+from .learner import Learner, LearnerGroup  # noqa: F401
+from .models import ac_apply, init_ac_params  # noqa: F401
+from .policy import Policy  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .rollout_worker import RolloutWorker  # noqa: F401
+from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401

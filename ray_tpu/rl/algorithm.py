@@ -1,0 +1,170 @@
+"""Algorithm: the top-level RL training driver (a tune Trainable).
+
+Reference parity: rllib/algorithms/algorithm.py:149 (Algorithm is a
+Trainable; step :757 calls the algo's training_step :1347) and
+rllib/evaluation/worker_set.py:80 (WorkerSet fan-out with local fallback).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..tune.trainable import Trainable
+from .config import AlgorithmConfig
+from .rollout_worker import RolloutWorker
+from .sample_batch import SampleBatch, concat_samples
+
+
+class WorkerSet:
+    """N remote rollout actors, or one inline local worker when N == 0."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self._local: Optional[RolloutWorker] = None
+        self._remote_workers: List[Any] = []
+        kwargs = dict(
+            env_spec=config.env,
+            num_envs=config.num_envs_per_worker,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma,
+            lam=config.lambda_,
+            policy_hidden=tuple(config.model.get("hidden", (64, 64))),
+        )
+        if config.num_rollout_workers == 0:
+            self._local = RolloutWorker(seed=config.seed, **kwargs)
+        else:
+            import ray_tpu
+
+            cls = ray_tpu.remote(RolloutWorker)
+            self._remote_workers = [
+                cls.options(num_cpus=config.num_cpus_per_worker).remote(
+                    seed=config.seed + 1000 * (i + 1), **kwargs
+                )
+                for i in range(config.num_rollout_workers)
+            ]
+            ray_tpu.get([w.ready.remote() for w in self._remote_workers])
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._remote_workers)
+
+    def sample(self) -> SampleBatch:
+        """synchronous_parallel_sample (rllib/execution/rollout_ops.py)."""
+        if self._local is not None:
+            return self._local.sample()
+        import ray_tpu
+
+        return concat_samples(
+            ray_tpu.get([w.sample.remote() for w in self._remote_workers])
+        )
+
+    def set_weights(self, weights) -> None:
+        if self._local is not None:
+            self._local.set_weights(weights)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([w.set_weights.remote(weights) for w in self._remote_workers])
+
+    def episode_metrics(self) -> Dict[str, float]:
+        if self._local is not None:
+            stats = [self._local.episode_metrics()]
+        else:
+            import ray_tpu
+
+            stats = ray_tpu.get(
+                [w.episode_metrics.remote() for w in self._remote_workers]
+            )
+        merged: Dict[str, float] = {"episodes_this_iter": 0}
+        rewards = [
+            s["episode_reward_mean"]
+            for s in stats
+            if not np.isnan(s["episode_reward_mean"])
+        ]
+        lens = [
+            s["episode_len_mean"] for s in stats if not np.isnan(s["episode_len_mean"])
+        ]
+        merged["episodes_this_iter"] = int(
+            sum(s["episodes_this_iter"] for s in stats)
+        )
+        merged["episode_reward_mean"] = float(np.mean(rewards)) if rewards else float("nan")
+        merged["episode_len_mean"] = float(np.mean(lens)) if lens else float("nan")
+        return merged
+
+    def stop(self) -> None:
+        if self._local is not None:
+            self._local.stop()
+        else:
+            import ray_tpu
+
+            for w in self._remote_workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+
+
+class Algorithm(Trainable):
+    """Subclasses implement `_build_learner()` and `training_step()`."""
+
+    _config_class = AlgorithmConfig
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None, **kwargs):
+        if config is None:
+            config = self._config_class()
+        if isinstance(config, dict):
+            cfg_obj = self._config_class()
+            for k, v in config.items():
+                setattr(cfg_obj, "lambda_" if k == "lambda" else k, v)
+            config = cfg_obj
+        self.algo_config = config
+        self._timesteps_total = 0
+        super().__init__(config=config.to_dict())
+
+    # -- Trainable API --
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self.workers = WorkerSet(self.algo_config)
+        self.learner_group = self._build_learner()
+        # push initial learner weights so all rollout policies start equal
+        self.workers.set_weights(self.learner_group.get_weights())
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        result = self.training_step()
+        result.setdefault("timesteps_total", self._timesteps_total)
+        result["time_this_iter_s"] = time.perf_counter() - t0
+        result.update(self.workers.episode_metrics())
+        return result
+
+    def train(self) -> Dict[str, Any]:
+        """Convenience alias matching the reference's Algorithm.train()."""
+        result = self.step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    def save_checkpoint(self) -> Any:
+        return {"weights": self.learner_group.get_weights(),
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.learner_group.set_weights(checkpoint["weights"])
+        self._timesteps_total = checkpoint.get("timesteps_total", 0)
+        self.workers.set_weights(checkpoint["weights"])
+
+    def cleanup(self) -> None:
+        self.workers.stop()
+
+    stop = cleanup
+
+    # -- to implement --
+
+    def _build_learner(self):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError

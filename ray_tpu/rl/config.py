@@ -1,0 +1,99 @@
+"""AlgorithmConfig: fluent, typed algorithm configuration.
+
+Reference parity: rllib/algorithms/algorithm_config.py (AlgorithmConfig with
+.environment()/.rollouts()/.training()/.resources() chaining and
+.build(env)). Kept flat — one dataclass-ish object, chainable setters.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Union
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class=None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Union[str, Callable[[], Any], None] = None
+        # rollouts
+        self.num_rollout_workers: int = 2
+        self.num_envs_per_worker: int = 1
+        self.rollout_fragment_length: int = 200
+        # training
+        self.gamma: float = 0.99
+        self.lambda_: float = 0.95
+        self.lr: float = 3e-4
+        self.train_batch_size: int = 4000
+        self.minibatch_size: int = 128
+        self.num_epochs: int = 4
+        self.model: Dict[str, Any] = {"hidden": (64, 64)}
+        self.seed: int = 0
+        # resources
+        self.num_cpus_per_worker: float = 1.0
+        self.num_tpus_for_learner: float = 0.0
+        self.remote_learner: bool = False
+        self.mesh = None
+
+    # -- fluent setters (subset of the reference's surface) --
+
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def rollouts(
+        self,
+        num_rollout_workers: Optional[int] = None,
+        num_envs_per_worker: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+    ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            key = "lambda_" if k in ("lambda", "lambda_") else k
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, key, v)
+        return self
+
+    def resources(
+        self,
+        num_cpus_per_worker: Optional[float] = None,
+        num_tpus_for_learner: Optional[float] = None,
+        remote_learner: Optional[bool] = None,
+        mesh=None,
+    ) -> "AlgorithmConfig":
+        if num_cpus_per_worker is not None:
+            self.num_cpus_per_worker = num_cpus_per_worker
+        if num_tpus_for_learner is not None:
+            self.num_tpus_for_learner = num_tpus_for_learner
+        if remote_learner is not None:
+            self.remote_learner = remote_learner
+        if mesh is not None:
+            self.mesh = mesh
+        return self
+
+    def debugging(self, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.copy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items() if k != "algo_class"}
+
+    def build(self, env=None):
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("no algorithm class bound to this config")
+        return self.algo_class(config=self)

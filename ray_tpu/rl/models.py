@@ -1,0 +1,56 @@
+"""Policy/value networks as pure JAX functions.
+
+Reference parity: rllib/models/catalog.py:204 (ModelCatalog) and
+rllib/core/models/catalog.py:28 build framework-specific torch/tf modules;
+here the catalog is a pair of pure functions (init, apply) over a params
+pytree, so the same network runs jitted on a CPU rollout actor and pjit'ed
+on the learner mesh without wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _dense_init(rng, fan_in: int, fan_out: int, scale: float) -> Dict[str, jnp.ndarray]:
+    # orthogonal init, the PPO-standard choice
+    w = jax.nn.initializers.orthogonal(scale)(rng, (fan_in, fan_out), jnp.float32)
+    return {"w": w, "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def init_ac_params(
+    rng: jax.Array,
+    obs_dim: int,
+    num_actions: int,
+    hidden: Sequence[int] = (64, 64),
+) -> Dict[str, Any]:
+    """Separate actor and critic MLP towers (rllib's default fcnet)."""
+    params: Dict[str, Any] = {"pi": [], "vf": []}
+    for tower, out_dim, out_scale in (("pi", num_actions, 0.01), ("vf", 1, 1.0)):
+        dims = [obs_dim, *hidden]
+        layers = []
+        for i in range(len(dims) - 1):
+            rng, sub = jax.random.split(rng)
+            layers.append(_dense_init(sub, dims[i], dims[i + 1], np.sqrt(2)))
+        rng, sub = jax.random.split(rng)
+        layers.append(_dense_init(sub, dims[-1], out_dim, out_scale))
+        params[tower] = layers
+    return params
+
+
+def _mlp(layers, x: jnp.ndarray) -> jnp.ndarray:
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+def ac_apply(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (action_logits [B, A], value [B])."""
+    logits = _mlp(params["pi"], obs)
+    value = _mlp(params["vf"], obs)[..., 0]
+    return logits, value

@@ -1,0 +1,52 @@
+"""Inference-side policy: jitted action computation on CPU rollout actors.
+
+Reference parity: rllib/policy/policy.py (compute_actions_from_input_dict,
+get/set_weights). One jit-compiled forward per rollout worker; sampling and
+bookkeeping stay numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+from .models import ac_apply, init_ac_params
+
+
+class Policy:
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64), seed: int = 0):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.params = init_ac_params(
+            jax.random.PRNGKey(seed), obs_dim, num_actions, hidden
+        )
+        self._apply = jax.jit(ac_apply)
+        self._value = jax.jit(lambda params, obs: ac_apply(params, obs)[1])
+        self._np_rng = np.random.default_rng(seed)
+
+    def compute_actions(
+        self, obs: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """obs [E, obs_dim] -> (actions [E], logp [E], values [E])."""
+        logits, values = jax.device_get(self._apply(self.params, obs))
+        logits = logits - logits.max(axis=-1, keepdims=True)
+        probs = np.exp(logits)
+        probs /= probs.sum(axis=-1, keepdims=True)
+        # vectorized categorical sampling via inverse CDF
+        u = self._np_rng.random((obs.shape[0], 1))
+        actions = (probs.cumsum(axis=-1) < u).sum(axis=-1).astype(np.int64)
+        actions = np.minimum(actions, self.num_actions - 1)
+        logp = np.log(probs[np.arange(obs.shape[0]), actions] + 1e-20)
+        return actions, logp.astype(np.float32), values.astype(np.float32)
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        """Value-only forward: no sampling, does not advance the action RNG."""
+        return np.asarray(jax.device_get(self._value(self.params, obs)), np.float32)
+
+    def get_weights(self) -> Dict[str, Any]:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        self.params = weights

@@ -1,0 +1,78 @@
+"""PPO: synchronous sample → compiled minibatch-SGD update → weight sync.
+
+Reference parity: rllib/algorithms/ppo/ppo.py (PPO.training_step :440 —
+synchronous_parallel_sample, LearnerGroup.update, weight broadcast) with the
+learner math in rllib/algorithms/ppo/torch/ppo_torch_learner.py, redesigned
+as a single jitted update (see learner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .algorithm import Algorithm
+from .config import AlgorithmConfig
+from .learner import LearnerGroup, PPOLearner
+from .sample_batch import concat_samples
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        # PPO-specific training knobs
+        self.clip_eps: float = 0.2
+        self.vf_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.max_grad_norm: float = 0.5
+
+
+class PPO(Algorithm):
+    _config_class = PPOConfig
+
+    def _build_learner(self) -> LearnerGroup:
+        cfg = self.algo_config
+        # probe the env once for spaces (reference: Algorithm.setup builds
+        # the learner from the local worker's policy spaces)
+        from .rollout_worker import _make_env
+
+        env = _make_env(cfg.env)
+        import numpy as np
+
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+
+        def factory():
+            return PPOLearner(
+                obs_dim=obs_dim,
+                num_actions=num_actions,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                lr=cfg.lr,
+                clip_eps=getattr(cfg, "clip_eps", 0.2),
+                vf_coeff=getattr(cfg, "vf_coeff", 0.5),
+                entropy_coeff=getattr(cfg, "entropy_coeff", 0.01),
+                num_epochs=cfg.num_epochs,
+                minibatch_size=cfg.minibatch_size,
+                max_grad_norm=getattr(cfg, "max_grad_norm", 0.5),
+                seed=cfg.seed,
+                mesh=cfg.mesh,
+            )
+
+        return LearnerGroup(
+            factory, remote=cfg.remote_learner, num_tpus=cfg.num_tpus_for_learner
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        batches = [self.workers.sample()]
+        collected = len(batches[0])
+        # keep sampling until train_batch_size is met (rollout_ops semantics)
+        while collected < self.algo_config.train_batch_size:
+            b = self.workers.sample()
+            collected += len(b)
+            batches.append(b)
+        batch = concat_samples(batches)
+        self._timesteps_total += len(batch)
+        metrics = self.learner_group.update(batch)
+        self.workers.set_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled_this_iter"] = len(batch)
+        return metrics

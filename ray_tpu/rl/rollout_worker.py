@@ -1,0 +1,167 @@
+"""RolloutWorker: a CPU actor stepping vectorized envs with the current policy.
+
+Reference parity: rllib/evaluation/rollout_worker.py:166 (RolloutWorker.sample
+collecting SampleBatches from env loops) with the env vectorization of
+rllib/env/vector_env.py. Persistent env state across sample() calls
+(truncate-style rollout fragments), episode-return tracking for metrics, and
+GAE postprocessing done worker-side (rllib postprocessing.py) so the learner
+receives ready-to-train columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .policy import Policy
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGP,
+    OBS,
+    REWARDS,
+    TARGETS,
+    VALUES,
+    SampleBatch,
+    compute_gae,
+)
+
+
+def _make_env(env_spec: Union[str, Callable[[], Any]]):
+    if callable(env_spec):
+        return env_spec()
+    import gymnasium
+
+    return gymnasium.make(env_spec)
+
+
+class RolloutWorker:
+    """One sampling actor; also usable inline (local mode, num_workers=0)."""
+
+    def __init__(
+        self,
+        env_spec: Union[str, Callable[[], Any]],
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lam: float = 0.95,
+        seed: int = 0,
+        policy_hidden=(64, 64),
+    ):
+        self.envs = [_make_env(env_spec) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.T = rollout_fragment_length
+        self.gamma = gamma
+        self.lam = lam
+        obs_space = self.envs[0].observation_space
+        act_space = self.envs[0].action_space
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.num_actions = int(act_space.n)
+        self.policy = Policy(self.obs_dim, self.num_actions, policy_hidden, seed=seed)
+        self._obs = np.stack(
+            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
+        ).astype(np.float32).reshape(num_envs, self.obs_dim)
+        self._episode_returns = np.zeros(num_envs, np.float32)
+        self._episode_lens = np.zeros(num_envs, np.int64)
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+        self._episodes_since_drain = 0
+
+    def ready(self) -> bool:
+        return True
+
+    # -- weight sync (rollout_worker.py get/set_weights) --
+
+    def get_weights(self) -> Dict[str, Any]:
+        return self.policy.get_weights()
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        self.policy.set_weights(weights)
+
+    # -- sampling --
+
+    def sample(self) -> SampleBatch:
+        """Collect T steps from each of E envs; returns a flat [T*E] batch
+        with GAE advantages/targets already attached."""
+        T, E = self.T, self.num_envs
+        obs_buf = np.empty((T, E, self.obs_dim), np.float32)
+        act_buf = np.empty((T, E), np.int64)
+        rew_buf = np.empty((T, E), np.float32)
+        done_buf = np.empty((T, E), np.float32)
+        val_buf = np.empty((T, E), np.float32)
+        logp_buf = np.empty((T, E), np.float32)
+
+        # (t, e, final_obs) for time-limit truncations: their value is folded
+        # into the reward below so GAE doesn't chain across the reset.
+        truncations: List[tuple] = []
+
+        for t in range(T):
+            actions, logp, values = self.policy.compute_actions(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            val_buf[t] = values
+            logp_buf[t] = logp
+            for e, env in enumerate(self.envs):
+                nobs, rew, terminated, truncated, _ = env.step(int(actions[e]))
+                nobs = np.asarray(nobs, np.float32).reshape(self.obs_dim)
+                self._episode_returns[e] += rew
+                self._episode_lens[e] += 1
+                rew_buf[t, e] = rew
+                done_buf[t, e] = float(terminated or truncated)
+                if truncated and not terminated:
+                    truncations.append((t, e, nobs))
+                if terminated or truncated:
+                    self._completed_returns.append(float(self._episode_returns[e]))
+                    self._completed_lens.append(int(self._episode_lens[e]))
+                    self._episodes_since_drain += 1
+                    self._episode_returns[e] = 0.0
+                    self._episode_lens[e] = 0
+                    nobs, _ = env.reset()
+                    nobs = np.asarray(nobs, np.float32).reshape(self.obs_dim)
+                self._obs[e] = nobs
+
+        if truncations:
+            # bootstrap through time-limit truncation: fold gamma * V(s_final)
+            # into the reward at the truncated step, then treat it as terminal
+            final_obs = np.stack([o for _, _, o in truncations])
+            final_vals = self.policy.compute_values(final_obs)
+            for (t, e, _), v in zip(truncations, final_vals):
+                rew_buf[t, e] += self.gamma * v
+
+        bootstrap = self.policy.compute_values(self._obs) * (1.0 - done_buf[-1])
+        gae = compute_gae(rew_buf, val_buf, done_buf, bootstrap, self.gamma, self.lam)
+        flat = lambda a: a.reshape((T * E,) + a.shape[2:])
+        return SampleBatch(
+            {
+                OBS: flat(obs_buf),
+                ACTIONS: flat(act_buf),
+                REWARDS: flat(rew_buf),
+                DONES: flat(done_buf),
+                VALUES: flat(val_buf),
+                LOGP: flat(logp_buf),
+                ADVANTAGES: flat(gae[ADVANTAGES]),
+                TARGETS: flat(gae[TARGETS]),
+            }
+        )
+
+    def episode_metrics(self, window: int = 100) -> Dict[str, Any]:
+        """Drain completed-episode stats (rllib metrics.py collect_episodes)."""
+        returns = self._completed_returns[-window:]
+        lens = self._completed_lens[-window:]
+        out = {
+            "episodes_this_iter": self._episodes_since_drain,
+            "episode_reward_mean": float(np.mean(returns)) if returns else float("nan"),
+            "episode_reward_max": float(np.max(returns)) if returns else float("nan"),
+            "episode_reward_min": float(np.min(returns)) if returns else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        }
+        self._completed_returns = self._completed_returns[-window:]
+        self._completed_lens = self._completed_lens[-window:]
+        self._episodes_since_drain = 0
+        return out
+
+    def stop(self) -> None:
+        for env in self.envs:
+            env.close()

@@ -1,0 +1,87 @@
+"""SampleBatch: columnar rollout storage + GAE.
+
+Reference parity: rllib/policy/sample_batch.py (SampleBatch,
+concat_samples) and the GAE postprocessing in
+rllib/evaluation/postprocessing.py (compute_advantages). Kept numpy-native:
+batches are built on CPU rollout actors and shipped to the learner host,
+where they become device arrays once, sharded over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+VALUES = "values"
+LOGP = "logp"
+ADVANTAGES = "advantages"
+TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    """A dict of equally-long numpy columns."""
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        for i in range(0, len(self), size):
+            yield self.slice(i, i + size)
+
+    def truncate(self, n: int) -> "SampleBatch":
+        return self.slice(0, n)
+
+
+def concat_samples(batches: Sequence[SampleBatch]) -> SampleBatch:
+    """rllib sample_batch.py concat_samples equivalent."""
+    batches = [b for b in batches if len(b) > 0]
+    if not batches:
+        return SampleBatch()
+    keys = batches[0].keys()
+    return SampleBatch({k: np.concatenate([b[k] for b in batches]) for k in keys})
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    dones: np.ndarray,
+    bootstrap_value: np.ndarray,
+    gamma: float = 0.99,
+    lam: float = 0.95,
+) -> Dict[str, np.ndarray]:
+    """Generalized Advantage Estimation over a [T, E] rollout block.
+
+    rewards/values/dones: [T, E]; bootstrap_value: [E] (value of the state
+    after the last step, zeroed where done). Returns advantages and value
+    targets, both [T, E].
+    """
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards, dtype=np.float32)
+    next_value = bootstrap_value.astype(np.float32)
+    next_adv = np.zeros_like(next_value)
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        next_adv = delta + gamma * lam * nonterminal * next_adv
+        adv[t] = next_adv
+        next_value = values[t]
+    targets = adv + values
+    return {ADVANTAGES: adv, TARGETS: targets}

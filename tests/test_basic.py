@@ -151,12 +151,21 @@ def test_parallel_tasks(ray_start_regular):
         time.sleep(0.5)
         return i
 
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    # Warm the worker pool: 4 concurrent noops force 4 workers to spawn, so
+    # the timed batch below measures execution overlap, not interpreter
+    # cold-start (which serializes on single-core CI machines).
+    ray_tpu.get([noop.remote() for _ in range(4)])
+
     t0 = time.time()
     out = ray_tpu.get([sleepy.remote(i) for i in range(4)])
     elapsed = time.time() - t0
     assert out == list(range(4))
-    # 4 half-second tasks on 4 CPUs should overlap
-    assert elapsed < 1.9, f"tasks did not run in parallel: {elapsed:.2f}s"
+    # 4 half-second tasks on 4 warm workers should overlap
+    assert elapsed < 1.5, f"tasks did not run in parallel: {elapsed:.2f}s"
 
 
 def test_put_on_ref_raises(ray_start_regular):

@@ -1,0 +1,142 @@
+"""RL library tests.
+
+Reference test strategy: rllib/tests + per-algorithm "learning tests" that
+assert a reward threshold (SURVEY §4.1 library-tests row).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import PPO, PPOConfig
+from ray_tpu.rl.sample_batch import (
+    ADVANTAGES,
+    TARGETS,
+    SampleBatch,
+    compute_gae,
+    concat_samples,
+)
+
+
+def test_gae_matches_manual():
+    rewards = np.array([[1.0], [1.0], [1.0]], np.float32)
+    values = np.array([[0.5], [0.5], [0.5]], np.float32)
+    dones = np.array([[0.0], [0.0], [1.0]], np.float32)
+    bootstrap = np.array([0.0], np.float32)
+    out = compute_gae(rewards, values, dones, bootstrap, gamma=0.9, lam=1.0)
+    # terminal step: delta = 1 - 0.5 = 0.5
+    assert out[ADVANTAGES][2, 0] == pytest.approx(0.5)
+    # with lam=1 this is just discounted-return - value
+    ret1 = 1 + 0.9 * (1 + 0.9 * 1)
+    assert out[ADVANTAGES][0, 0] == pytest.approx(ret1 - 0.5, rel=1e-5)
+    assert out[TARGETS][0, 0] == pytest.approx(ret1, rel=1e-5)
+
+
+def test_sample_batch_ops():
+    b1 = SampleBatch({"x": np.arange(4), "y": np.arange(4) * 2})
+    b2 = SampleBatch({"x": np.arange(3), "y": np.arange(3) * 2})
+    cat = concat_samples([b1, b2])
+    assert len(cat) == 7
+    mbs = list(cat.minibatches(3))
+    assert [len(m) for m in mbs] == [3, 3, 1]
+    shuffled = cat.shuffle(np.random.default_rng(0))
+    assert sorted(shuffled["x"]) == sorted(cat["x"])
+    assert np.all(shuffled["y"] == shuffled["x"] * 2)
+
+
+def test_rollout_worker_shapes():
+    from ray_tpu.rl.rollout_worker import RolloutWorker
+
+    w = RolloutWorker("CartPole-v1", num_envs=3, rollout_fragment_length=10)
+    batch = w.sample()
+    assert len(batch) == 30
+    assert batch["obs"].shape == (30, 4)
+    assert batch["actions"].dtype == np.int64
+    # persistent env state: second sample continues episodes
+    batch2 = w.sample()
+    assert len(batch2) == 30
+    w.stop()
+
+
+def test_ppo_learns_cartpole():
+    """Learning test (rllib tuned_examples pattern): reward must clear a
+    threshold well above the ~20 random-policy baseline."""
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=8, rollout_fragment_length=256)
+        .training(train_batch_size=2048, minibatch_size=256, num_epochs=4, lr=3e-4)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(15):
+        result = algo.train()
+        reward = result.get("episode_reward_mean", float("nan"))
+        if not np.isnan(reward):
+            best = max(best, reward)
+        if best > 100:
+            break
+    algo.cleanup()
+    assert best > 80, f"PPO failed to learn CartPole: best reward {best}"
+
+
+def test_ppo_checkpoint_roundtrip():
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=2, rollout_fragment_length=32)
+        .training(train_batch_size=64, minibatch_size=32, num_epochs=1)
+    )
+    algo = cfg.build()
+    algo.train()
+    ckpt = algo.save_checkpoint()
+    w0 = algo.learner_group.get_weights()
+    algo2 = cfg.copy().build()
+    algo2.load_checkpoint(ckpt)
+    w1 = algo2.learner_group.get_weights()
+    np.testing.assert_allclose(w0["pi"][0]["w"], w1["pi"][0]["w"])
+    assert algo2._timesteps_total == algo._timesteps_total
+    algo.cleanup()
+    algo2.cleanup()
+
+
+def test_ppo_remote_rollout_workers(ray_start_regular):
+    """End-to-end: sampling on remote CPU actors, learner on the driver."""
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=2, rollout_fragment_length=32)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=2)
+    )
+    algo = cfg.build()
+    result = algo.train()
+    assert result["num_env_steps_sampled_this_iter"] >= 128
+    assert "total_loss" in result
+    # weights actually propagated to the actors
+    import ray_tpu
+
+    w = ray_tpu.get(algo.workers._remote_workers[0].get_weights.remote())
+    lw = algo.learner_group.get_weights()
+    np.testing.assert_allclose(w["pi"][0]["w"], lw["pi"][0]["w"], rtol=1e-6)
+    algo.cleanup()
+
+
+def test_ppo_mesh_data_parallel_learner():
+    """The learner compiled over a multi-device mesh (dp axis) produces
+    finite metrics — GSPMD replaces the reference's NCCL-between-learners."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("dp",))
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=4, rollout_fragment_length=64)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=2)
+        .resources(mesh=mesh)
+    )
+    algo = cfg.build()
+    result = algo.train()
+    assert np.isfinite(result["total_loss"])
+    algo.cleanup()
