@@ -1,0 +1,32 @@
+"""Collective communication with Ray's group-management API shape.
+
+Reference: python/ray/util/collective/collective.py (init_collective_group
+:120, allreduce :258, broadcast :373, allgather :423, reducescatter :472,
+send :531, recv :594) over NCCL/Gloo groups.
+
+TPU-native split (SURVEY.md §5.9): in-graph collectives are XLA/GSPMD ops
+on mesh axes (`ray_tpu.util.collective.in_graph` — psum/all_gather/
+ppermute lowered by pjit over ICI); the out-of-graph "host" backend here
+serves the reference's Gloo role — host-buffer rendezvous for control,
+weight broadcast, and DCN-side exchange — built on the object store
+instead of a separate transport.
+"""
+
+from .types import Backend, ReduceOp  # noqa: F401
+from .collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reducescatter,
+    send,
+)
+from . import in_graph  # noqa: F401

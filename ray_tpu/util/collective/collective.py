@@ -1,0 +1,343 @@
+"""Out-of-graph host collectives over the object store.
+
+API parity with python/ray/util/collective/collective.py; the transport is
+a named rendezvous actor per group (the moral equivalent of the reference's
+NCCLUniqueID store + communicator, nccl_collective_group.py:127) holding a
+two-phase mailbox: every rank `contribute()`s its buffer (non-blocking on
+the actor), then polls `fetch()` until the op is complete. Actor methods
+stay serial, so there is no blocking wait inside the actor and no deadlock.
+
+Collective calls must be issued in the same order by every rank of a group
+(standard collective semantics); each local client keeps a per-group op
+counter that forms the rendezvous key.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .types import Backend, ReduceOp
+
+_POLL_S = 0.002
+_POLL_MAX_S = 0.05
+DEFAULT_TIMEOUT_S = 300.0
+
+
+def _reduce(arrs: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    out = np.array(arrs[0], copy=True)
+    for a in arrs[1:]:
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            out = out + a
+        elif op == ReduceOp.PRODUCT:
+            out = out * a
+        elif op == ReduceOp.MIN:
+            out = np.minimum(out, a)
+        elif op == ReduceOp.MAX:
+            out = np.maximum(out, a)
+    if op == ReduceOp.AVERAGE:
+        out = out / len(arrs)
+    return out
+
+
+class _Rendezvous:
+    """Named actor: per-group mailbox. One instance per collective group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.members: set = set(range(world_size))
+        self.ops: Dict[Any, dict] = {}  # key -> {parts, meta, result, fetched}
+        self.p2p: Dict[Any, Any] = {}  # (src, dst, seq) -> payload
+
+    def describe(self) -> dict:
+        return {"world_size": self.world_size}
+
+    def leave(self, rank: int) -> int:
+        """A rank leaving the group (destroy_collective_group). Returns the
+        number of remaining members; the last leaver kills the actor."""
+        self.members.discard(rank)
+        return len(self.members)
+
+    def contribute(self, key, rank: int, payload, meta: dict):
+        """Deposit one rank's buffer. If this contribution completes the op,
+        returns this rank's result immediately (saves one fetch RPC);
+        otherwise the rank polls fetch()."""
+        ent = self.ops.setdefault(
+            key, {"parts": {}, "meta": meta, "result": None, "error": None, "fetched": set()}
+        )
+        ent["parts"][rank] = payload
+        if len(ent["parts"]) == self.world_size:
+            try:
+                ent["result"] = self._complete(ent["parts"], ent["meta"])
+            except Exception as e:  # surface to EVERY rank, not just the last
+                ent["error"] = e
+            ent["parts"] = {}
+            return self.fetch(key, rank)
+        return ("pending", None)
+
+    def _complete(self, parts: Dict[int, Any], meta: dict):
+        kind = meta["kind"]
+        ordered = [parts[r] for r in range(self.world_size)]
+        if kind == "allreduce":
+            return _reduce(ordered, ReduceOp(meta["op"]))
+        if kind == "allgather":
+            return ordered
+        if kind == "reducescatter":
+            red = _reduce(ordered, ReduceOp(meta["op"]))
+            return np.array_split(red, self.world_size, axis=0)
+        if kind == "broadcast":
+            return parts[meta["src_rank"]]
+        if kind == "alltoall":
+            return [[ordered[j][i] for j in range(self.world_size)] for i in range(self.world_size)]
+        if kind == "barrier":
+            return True
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    def fetch(self, key, rank: int):
+        ent = self.ops.get(key)
+        if ent is None or (ent["result"] is None and ent["error"] is None):
+            return ("pending", None)
+        if ent["error"] is not None:
+            ent["fetched"].add(rank)
+            if len(ent["fetched"]) == self.world_size:
+                err = ent["error"]
+                del self.ops[key]
+                return ("error", err)
+            return ("error", ent["error"])
+        kind = ent["meta"]["kind"]
+        if kind in ("reducescatter", "alltoall"):
+            out = ent["result"][rank]
+        elif kind == "allgather":
+            out = list(ent["result"])
+        else:
+            out = ent["result"]
+        ent["fetched"].add(rank)
+        if len(ent["fetched"]) == self.world_size:
+            del self.ops[key]
+        return ("ready", out)
+
+    def p2p_send(self, src: int, dst: int, seq: int, payload):
+        self.p2p[(src, dst, seq)] = payload
+
+    def p2p_recv(self, src: int, dst: int, seq: int):
+        if (src, dst, seq) in self.p2p:
+            return ("ready", self.p2p.pop((src, dst, seq)))
+        return ("pending", None)
+
+
+class _GroupClient:
+    def __init__(self, group_name: str, world_size: int, rank: int, actor):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.actor = actor
+        self.seq = 0
+        self.send_seq: Dict[int, int] = {}
+        self.recv_seq: Dict[int, int] = {}
+
+    def run(self, payload, meta: dict, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        key = self.seq
+        self.seq += 1
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
+        state, out = ray_tpu.get(self.actor.contribute.remote(key, self.rank, payload, meta))
+        sleep = _POLL_S
+        while state == "pending":
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {meta['kind']!r} op {key} on group "
+                    f"{self.group_name!r} timed out waiting for peers "
+                    f"(rank {self.rank}/{self.world_size}); a peer likely "
+                    "died or diverged in collective-call order"
+                )
+            time.sleep(sleep)
+            sleep = min(sleep * 2, _POLL_MAX_S)  # back off: serial actor
+            state, out = ray_tpu.get(self.actor.fetch.remote(key, self.rank))
+        if state == "error":
+            raise RuntimeError(
+                f"collective {meta['kind']!r} op {key} on group "
+                f"{self.group_name!r} failed on the rendezvous: {out!r}"
+            ) from (out if isinstance(out, Exception) else None)
+        return out
+
+
+_GROUPS: Dict[str, _GroupClient] = {}
+
+
+def _rendezvous_actor(group_name: str, world_size: int):
+    import ray_tpu
+
+    name = f"_ray_tpu_collective:{group_name}"
+    try:
+        return (
+            ray_tpu.remote(_Rendezvous)
+            .options(name=name, lifetime="detached")
+            .remote(world_size)
+        )
+    except ValueError:
+        return ray_tpu.get_actor(name)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Initialize this process's membership in a named collective group
+    (reference: collective.py:120)."""
+    import ray_tpu
+
+    Backend.resolve(backend)
+    if group_name in _GROUPS:
+        raise RuntimeError(f"collective group {group_name!r} already initialized")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    actor = _rendezvous_actor(group_name, world_size)
+    desc = ray_tpu.get(actor.describe.remote())
+    if desc["world_size"] != world_size:
+        raise ValueError(
+            f"group {group_name!r} already exists with world_size "
+            f"{desc['world_size']}, not {world_size}; destroy it on every "
+            "rank first"
+        )
+    _GROUPS[group_name] = _GroupClient(group_name, world_size, rank, actor)
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: List[int],
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Driver-side declarative setup (reference: collective.py:170): tells
+    each actor to join the group. Requires each actor to expose an
+    `init_collective_group(world_size, rank, backend, group_name)` method
+    (typically by calling this module's init_collective_group)."""
+    import ray_tpu
+
+    ray_tpu.get(
+        [
+            a.init_collective_group.remote(world_size, r, backend, group_name)
+            for a, r in zip(actors, ranks)
+        ]
+    )
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _GROUPS
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Leave the group; the last rank to leave kills the rendezvous actor so
+    the group name can be re-created with a fresh world_size."""
+    import ray_tpu
+
+    g = _GROUPS.pop(group_name, None)
+    if g is None:
+        return
+    remaining = ray_tpu.get(g.actor.leave.remote(g.rank))
+    if remaining == 0:
+        ray_tpu.kill(g.actor)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _GROUPS.get(group_name)
+    return g.rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _GROUPS.get(group_name)
+    return g.world_size if g else -1
+
+
+def _group(group_name: str) -> _GroupClient:
+    g = _GROUPS.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group first"
+        )
+    return g
+
+
+def _to_np(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    """All-reduce across the group; returns the reduced array
+    (reference: collective.py:258 mutates in place; we are functional)."""
+    g = _group(group_name)
+    return g.run(_to_np(tensor), {"kind": "allreduce", "op": op.value})
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    """Returns the list of per-rank tensors, rank-ordered (reference:
+    collective.py:423 fills a preallocated tensor_list)."""
+    g = _group(group_name)
+    return g.run(_to_np(tensor), {"kind": "allgather"})
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    """Reduce across ranks then scatter along axis 0; returns this rank's
+    shard (reference: collective.py:472)."""
+    g = _group(group_name)
+    return g.run(_to_np(tensor), {"kind": "reducescatter", "op": op.value})
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Broadcast src_rank's tensor to all ranks (reference: collective.py:373)."""
+    g = _group(group_name)
+    payload = _to_np(tensor) if g.rank == src_rank else None
+    return g.run(payload, {"kind": "broadcast", "src_rank": src_rank})
+
+
+def alltoall(tensor_list: List[Any], group_name: str = "default") -> List[np.ndarray]:
+    """Each rank provides world_size chunks; receives chunk[rank] from every
+    rank, rank-ordered."""
+    g = _group(group_name)
+    if len(tensor_list) != g.world_size:
+        raise ValueError(f"need {g.world_size} chunks, got {len(tensor_list)}")
+    return g.run([_to_np(t) for t in tensor_list], {"kind": "alltoall"})
+
+
+def barrier(group_name: str = "default") -> None:
+    """Block until every rank reaches the barrier."""
+    _group(group_name).run(None, {"kind": "barrier"})
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (reference: collective.py:531)."""
+    import ray_tpu
+
+    g = _group(group_name)
+    seq = g.send_seq.get(dst_rank, 0)
+    g.send_seq[dst_rank] = seq + 1
+    ray_tpu.get(g.actor.p2p_send.remote(g.rank, dst_rank, seq, _to_np(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", timeout_s: Optional[float] = None):
+    """Point-to-point receive (reference: collective.py:594). Returns the
+    received array (the reference writes into a preallocated tensor)."""
+    import ray_tpu
+
+    g = _group(group_name)
+    seq = g.recv_seq.get(src_rank, 0)
+    g.recv_seq[src_rank] = seq + 1
+    deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
+    sleep = _POLL_S
+    while True:
+        state, out = ray_tpu.get(g.actor.p2p_recv.remote(src_rank, g.rank, seq))
+        if state == "ready":
+            return out
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"recv from rank {src_rank} on group {group_name!r} timed out"
+            )
+        time.sleep(sleep)
+        sleep = min(sleep * 2, _POLL_MAX_S)

@@ -1,0 +1,100 @@
+"""In-graph collectives: the ICI data plane.
+
+These are the TPU-native replacement for the reference's NCCL calls
+(SURVEY.md §5.9): thin, name-stable wrappers over `jax.lax` collectives,
+usable inside shard_map/pjit over a mesh axis. XLA lowers them onto ICI
+links and overlaps them with compute — nothing to bootstrap, no process
+groups (the reference needed dist.init_process_group,
+train/torch/config.py:113; here the mesh IS the group).
+
+Every function takes `axis_name` (a mesh axis or tuple of axes) instead of
+the out-of-graph API's `group_name`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .types import ReduceOp
+
+
+def allreduce(x, axis_name, op: ReduceOp = ReduceOp.SUM):
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis_name)
+    if op == ReduceOp.AVERAGE:
+        return lax.pmean(x, axis_name)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis_name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis_name)
+    if op == ReduceOp.PRODUCT:
+        return jnp.prod(lax.all_gather(x, axis_name, axis=0, tiled=False), axis=0)
+    raise ValueError(op)
+
+
+def allgather(x, axis_name, *, axis: int = 0, tiled: bool = True):
+    """Gather shards along `axis` from every device on the mesh axis."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reducescatter(x, axis_name, *, axis: int = 0, op: ReduceOp = ReduceOp.SUM):
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM/AVERAGE")
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / lax.psum(1, axis_name)
+    return out
+
+
+def broadcast(x, axis_name, *, src_index: int = 0):
+    """Every device gets device src_index's value (one all_gather + index;
+    XLA folds this into a collective-broadcast)."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=False)[src_index]
+
+
+def alltoall(x, axis_name, *, split_axis: int = 0, concat_axis: int = 0):
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def permute(x, axis_name, perm):
+    """ppermute: perm is a list of (source_index, destination_index)."""
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def shift(x, axis_name, *, offset: int = 1):
+    """Ring shift by `offset` along the axis (the ring-attention primitive)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def rank(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def world_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def barrier(axis_name):
+    """In-graph barrier: a trivial psum forces a synchronizing collective."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)
+
+
+__all__ = [
+    "allreduce",
+    "allgather",
+    "reducescatter",
+    "broadcast",
+    "alltoall",
+    "permute",
+    "shift",
+    "rank",
+    "world_size",
+    "barrier",
+    "ReduceOp",
+]

@@ -1,0 +1,42 @@
+"""Collective op types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    AVERAGE = "average"
+
+
+class Backend:
+    """Backend names. HOST is the object-store rendezvous backend (the
+    reference's GLOO role); XLA means "use in_graph on a mesh axis" and is
+    rejected by the out-of-graph API with a pointer to in_graph."""
+
+    HOST = "host"
+    GLOO = "host"  # alias: accept reference spelling
+    NCCL = "host"  # alias: no NVIDIA path on TPU; host rendezvous instead
+    XLA = "xla"
+
+    _ALIASES = {"host": "host", "gloo": "host", "nccl": "host"}
+
+    @classmethod
+    def resolve(cls, name: str) -> str:
+        """Map a backend spelling to its implementation; raise on unknown."""
+        if name == cls.XLA:
+            raise ValueError(
+                "backend='xla' collectives are in-graph: use "
+                "ray_tpu.util.collective.in_graph inside shard_map/pjit"
+            )
+        try:
+            return cls._ALIASES[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown collective backend {name!r}; expected one of "
+                f"{sorted(cls._ALIASES)}"
+            ) from None

@@ -1,0 +1,181 @@
+"""Collective group API: host (out-of-graph) + in-graph XLA collectives.
+
+Reference behavior: python/ray/util/collective/collective.py and
+tests under python/ray/util/collective/tests/.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.collective import ReduceOp
+
+
+@ray_tpu.remote
+class Rank:
+    def init_collective_group(self, world_size, rank, backend="host", group_name="default"):
+        from ray_tpu.util import collective as col
+
+        self.rank = rank
+        col.init_collective_group(world_size, rank, backend, group_name)
+
+    def do(self, op, *args, **kwargs):
+        from ray_tpu.util import collective as col
+
+        return getattr(col, op)(*args, **kwargs)
+
+    def rank_info(self, group_name="default"):
+        from ray_tpu.util import collective as col
+
+        return (col.get_rank(group_name), col.get_collective_group_size(group_name))
+
+    def sendrecv(self, peer, value):
+        from ray_tpu.util import collective as col
+
+        if self.rank == 0:
+            col.send(np.full((2,), value, np.float32), peer, "default")
+            return None
+        return col.recv(peer, "default")
+
+
+@pytest.fixture
+def group(ray_start_regular):
+    from ray_tpu.util import collective as col
+
+    world = 4
+    actors = [Rank.remote() for _ in range(world)]
+    col.create_collective_group(actors, world, list(range(world)), "host", "default")
+    return actors
+
+
+def test_allreduce_and_rank(group):
+    actors = group
+    outs = ray_tpu.get(
+        [a.do.remote("allreduce", np.full((3,), r + 1.0)) for r, a in enumerate(actors)]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((3,), 10.0))
+    assert ray_tpu.get(actors[2].rank_info.remote()) == (2, 4)
+
+
+def test_allreduce_ops(group):
+    actors = group
+    outs = ray_tpu.get(
+        [
+            a.do.remote("allreduce", np.array([float(r + 1)]), "default", ReduceOp.MAX)
+            for r, a in enumerate(actors)
+        ]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, [4.0])
+
+
+def test_allgather_broadcast(group):
+    actors = group
+    gathered = ray_tpu.get(
+        [a.do.remote("allgather", np.array([r, r])) for r, a in enumerate(actors)]
+    )
+    for per_rank in gathered:
+        assert len(per_rank) == 4
+        np.testing.assert_array_equal(per_rank[3], [3, 3])
+    outs = ray_tpu.get(
+        [
+            a.do.remote("broadcast", np.array([7.0]) if r == 1 else np.zeros(1), 1)
+            for r, a in enumerate(actors)
+        ]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, [7.0])
+
+
+def test_reducescatter_alltoall_barrier(group):
+    actors = group
+    outs = ray_tpu.get(
+        [a.do.remote("reducescatter", np.ones((8, 2)) * (r + 1)) for r, a in enumerate(actors)]
+    )
+    for o in outs:
+        assert o.shape == (2, 2)
+        np.testing.assert_allclose(o, 10.0)
+    chunks = ray_tpu.get(
+        [
+            a.do.remote("alltoall", [np.array([r * 10 + i]) for i in range(4)])
+            for r, a in enumerate(actors)
+        ]
+    )
+    # rank i receives chunk i from every rank j: [j*10 + i for j in range(4)]
+    for i, per_rank in enumerate(chunks):
+        np.testing.assert_array_equal(np.concatenate(per_rank), [j * 10 + i for j in range(4)])
+    ray_tpu.get([a.do.remote("barrier") for a in actors])
+
+
+def test_error_propagates_to_all_ranks(group):
+    # mismatched shapes: _reduce raises on the rendezvous; EVERY rank must
+    # get an error (not hang in the poll loop)
+    actors = group
+    refs = [
+        a.do.remote("allreduce", np.ones(3 if r == 0 else 4)) for r, a in enumerate(actors)
+    ]
+    for ref in refs:
+        with pytest.raises(Exception):
+            ray_tpu.get(ref)
+
+
+def test_backend_validation(ray_start_regular):
+    from ray_tpu.util import collective as col
+
+    with pytest.raises(ValueError, match="in-graph"):
+        col.init_collective_group(2, 0, "xla", "gx")
+    with pytest.raises(ValueError, match="unknown collective backend"):
+        col.init_collective_group(2, 0, "hots", "gx")
+
+
+def test_destroy_and_reinit(ray_start_regular):
+    from ray_tpu.util import collective as col
+
+    actors = [Rank.remote() for _ in range(2)]
+    col.create_collective_group(actors, 2, [0, 1], "host", "g2")
+    ray_tpu.get([a.do.remote("allreduce", np.ones(2), "g2") for a in actors])
+    ray_tpu.get([a.do.remote("destroy_collective_group", "g2") for a in actors])
+    # name must be reusable with a different world size
+    trio = [Rank.remote() for _ in range(3)]
+    col.create_collective_group(trio, 3, [0, 1, 2], "host", "g2")
+    outs = ray_tpu.get([a.do.remote("allreduce", np.ones(2), "g2") for a in trio])
+    for o in outs:
+        np.testing.assert_allclose(o, 3.0)
+
+
+def test_send_recv(group):
+    actors = group
+    r0 = actors[0].sendrecv.remote(1, 42.0)
+    r1 = actors[1].sendrecv.remote(0, 0.0)
+    assert ray_tpu.get(r0) is None
+    np.testing.assert_allclose(ray_tpu.get(r1), np.full((2,), 42.0))
+
+
+def test_in_graph_collectives():
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ray_tpu.util.collective import in_graph as cg
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("x",))
+
+    def body(v):
+        s = cg.allreduce(v, "x")
+        g = cg.allgather(v, "x")
+        sc = cg.reducescatter(g, "x")
+        b = cg.broadcast(v, "x", src_index=2)
+        sh = cg.shift(v, "x", offset=1)
+        return s, g, sc, b, sh
+
+    x = jnp.arange(4.0).reshape(4, 1)
+    f = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=(P("x"), P("x"), P("x"), P("x"), P("x")))
+    s, g, sc, b, sh = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(s).ravel(), [6, 6, 6, 6])  # psum
+    np.testing.assert_allclose(np.asarray(g)[:4].ravel(), [0, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(sc).ravel(), [0, 4, 8, 12])  # psum_scatter of gathered
+    np.testing.assert_allclose(np.asarray(b).ravel(), [2, 2, 2, 2])
+    np.testing.assert_allclose(np.asarray(sh).ravel(), [3, 0, 1, 2])  # ring shift
