@@ -1,0 +1,15 @@
+"""Lazy task/actor DAGs (reference: python/ray/dag/ — DAGNode
+dag_node.py:23, FunctionNode, ClassNode, InputNode; executed via
+.execute(); used by Serve deployment graphs and Workflow).
+
+A DAG is built with .bind() on remote functions / actor classes / actor
+methods, then executed with node.execute(input). Execution submits the
+whole graph as tasks whose ObjectRef edges the scheduler resolves —
+breadth of the graph runs in parallel with no driver round-trips between
+levels.
+"""
+
+from .dag_node import DAGNode  # noqa: F401
+from .function_node import FunctionNode, bind_function  # noqa: F401
+from .class_node import ClassMethodNode, ClassNode, bind_class, bind_method  # noqa: F401
+from .input_node import InputAttributeNode, InputNode  # noqa: F401

@@ -1,0 +1,72 @@
+"""ClassNode / ClassMethodNode: actors in a DAG (reference:
+python/ray/dag/class_node.py).
+
+A ClassNode instantiates its actor once (first execution) and reuses it on
+subsequent .execute() calls — the Serve-graph semantics, where the DAG
+describes a long-lived composition of stateful deployments.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .dag_node import DAGNode
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_class, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self._actor_class = actor_class
+        self._actor_handle = None
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        if self._actor_handle is None:
+            args, kwargs = self._resolve_args(memo)
+            self._actor_handle = self._actor_class.remote(*args, **kwargs)
+        return self._actor_handle
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+    def options(self, **opts) -> "ClassNode":
+        return ClassNode(
+            self._actor_class.options(**opts), self._bound_args, self._bound_kwargs
+        )
+
+
+class _UnboundClassMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, receiver, method_name: str, args, kwargs):
+        # receiver: ClassNode (actor created at execute time) or a live
+        # ActorHandle (bind on an existing actor, actor.py ActorMethod.bind)
+        recv_args = (receiver,) if isinstance(receiver, DAGNode) else ()
+        super().__init__(args=recv_args + tuple(args), kwargs=kwargs)
+        self._receiver = receiver
+        self._method_name = method_name
+        self._n_recv = len(recv_args)
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        args, kwargs = self._resolve_args(memo)
+        if self._n_recv:
+            handle, *args = args
+        else:
+            handle = self._receiver
+        return getattr(handle, self._method_name).remote(*args, **kwargs)
+
+
+def bind_class(actor_class, *args, **kwargs) -> ClassNode:
+    return ClassNode(actor_class, args, kwargs)
+
+
+def bind_method(actor_handle, method_name: str, *args, **kwargs) -> ClassMethodNode:
+    return ClassMethodNode(actor_handle, method_name, args, kwargs)

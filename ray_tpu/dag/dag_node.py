@@ -1,0 +1,83 @@
+"""DAGNode base (reference: python/ray/dag/dag_node.py:23).
+
+Nodes hold bound args (which may contain other DAGNodes); execute() walks
+the graph once per call with a per-execution memo so diamond dependencies
+submit each node exactly once.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Tuple
+
+_node_counter = itertools.count()
+
+
+def _map_structure(obj, fn):
+    """Apply fn to every DAGNode found in (possibly nested) args."""
+    if isinstance(obj, DAGNode):
+        return fn(obj)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_map_structure(o, fn) for o in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(o, fn) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _map_structure(v, fn) for k, v in obj.items()}
+    return obj
+
+
+class DAGNode:
+    def __init__(self, args: Tuple[Any, ...] = (), kwargs: Dict[str, Any] = None):
+        self._bound_args = args
+        self._bound_kwargs = kwargs or {}
+        self._stable_uuid = next(_node_counter)
+
+    # -- traversal ----------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out: List[DAGNode] = []
+        _map_structure((self._bound_args, self._bound_kwargs), out.append)
+        return out
+
+    def topo_sort(self) -> List["DAGNode"]:
+        """All reachable nodes, dependencies before dependents; order is
+        deterministic (by creation id within a level's discovery walk)."""
+        seen: Dict[int, DAGNode] = {}
+        order: List[DAGNode] = []
+
+        def visit(node: "DAGNode"):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for c in node._children():
+                visit(c)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- execution ----------------------------------------------------------
+    def _resolve_args(self, memo: Dict[int, Any]):
+        resolve = lambda n: n._execute_impl(memo)  # noqa: E731
+        args = _map_structure(self._bound_args, resolve)
+        kwargs = _map_structure(self._bound_kwargs, resolve)
+        return args, kwargs
+
+    def _execute_impl(self, memo: Dict[int, Any]):
+        if id(self) in memo:
+            return memo[id(self)]
+        out = self._execute_node(memo)
+        memo[id(self)] = out
+        return out
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        raise NotImplementedError
+
+    def execute(self, *input_args, **input_kwargs):
+        """Execute the DAG rooted here. Returns whatever the root produces
+        (an ObjectRef for function/method roots). The single positional
+        input feeds InputNode, extras feed InputNode attribute access."""
+        memo: Dict[int, Any] = {"__input__": (input_args, input_kwargs)}
+        return self._execute_impl(memo)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(id={self._stable_uuid})"

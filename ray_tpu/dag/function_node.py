@@ -1,0 +1,32 @@
+"""FunctionNode: a task invocation in a DAG (reference:
+python/ray/dag/function_node.py).
+
+Execution submits the task with child results as args — child ObjectRefs
+are passed straight through so the scheduler chains dependencies without
+materializing intermediates on the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .dag_node import DAGNode
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_function, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self._remote_function = remote_function
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        args, kwargs = self._resolve_args(memo)
+        return self._remote_function.remote(*args, **kwargs)
+
+    def options(self, **opts) -> "FunctionNode":
+        return FunctionNode(
+            self._remote_function.options(**opts), self._bound_args, self._bound_kwargs
+        )
+
+
+def bind_function(remote_function, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_function, args, kwargs)

@@ -1,0 +1,79 @@
+"""InputNode: the DAG's runtime argument (reference: python/ray/dag/input_node.py).
+
+    with InputNode() as inp:
+        out = f.bind(inp)            # whole input
+        out2 = g.bind(inp.field)     # attribute access
+        out3 = h.bind(inp[0])        # index access
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .dag_node import DAGNode
+
+
+class InputNode(DAGNode):
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        args, kwargs = memo["__input__"]
+        if kwargs or len(args) > 1:
+            return _MultiInput(args, kwargs)
+        if len(args) == 1:
+            return args[0]
+        return None
+
+    def __getattr__(self, key: str):
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key, "getattr")
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key, "getitem")
+
+
+class _MultiInput:
+    """Wrapper when execute() got several args/kwargs: positional access via
+    inp[i], keyword via inp.name (reference: DAGInputData)."""
+
+    def __init__(self, args, kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+
+class InputAttributeNode(DAGNode):
+    """inp[i] / inp.key — resolved against the RAW execute() arguments:
+    integer getitem prefers indexing a single input object, falling back to
+    positional args; names read kwargs, falling back to attributes/keys of a
+    single input object."""
+
+    def __init__(self, parent: InputNode, key, accessor: str):
+        super().__init__(args=(parent,))
+        self._key = key
+        self._accessor = accessor
+
+    def _execute_node(self, memo: Dict[int, Any]):
+        args, kwargs = memo["__input__"]
+        single = args[0] if len(args) == 1 and not kwargs else None
+        if self._accessor == "getitem" and isinstance(self._key, int):
+            if single is not None:
+                try:
+                    return single[self._key]
+                except TypeError:
+                    return args[self._key]
+            return args[self._key]
+        if self._accessor == "getattr":
+            if single is not None and hasattr(single, self._key):
+                return getattr(single, self._key)
+            return kwargs[self._key]
+        if single is not None and hasattr(single, "__getitem__"):
+            return single[self._key]
+        return kwargs[self._key]

@@ -24,6 +24,9 @@ from .types import Backend, ReduceOp
 _POLL_S = 0.002
 _POLL_MAX_S = 0.05
 DEFAULT_TIMEOUT_S = 300.0
+# Rendezvous entries older than this are garbage-collected: any rank still
+# interested has long since hit its own timeout. Keep > DEFAULT_TIMEOUT_S.
+_GC_TTL_S = 900.0
 
 
 def _reduce(arrs: List[np.ndarray], op: ReduceOp) -> np.ndarray:
@@ -60,12 +63,24 @@ class _Rendezvous:
         self.members.discard(rank)
         return len(self.members)
 
+    def _gc(self):
+        """Drop op/p2p state no live rank will ever collect: entries older
+        than _GC_TTL_S (every interested rank has timed out by then). Keeps
+        the detached rendezvous actor's memory bounded across failures."""
+        now = time.monotonic()
+        for key in [k for k, e in self.ops.items() if now - e["ts"] > _GC_TTL_S]:
+            del self.ops[key]
+        for key in [k for k, (ts, _) in self.p2p.items() if now - ts > _GC_TTL_S]:
+            del self.p2p[key]
+
     def contribute(self, key, rank: int, payload, meta: dict):
         """Deposit one rank's buffer. If this contribution completes the op,
         returns this rank's result immediately (saves one fetch RPC);
         otherwise the rank polls fetch()."""
+        self._gc()
         ent = self.ops.setdefault(
-            key, {"parts": {}, "meta": meta, "result": None, "error": None, "fetched": set()}
+            key,
+            {"parts": {}, "meta": meta, "result": None, "error": None, "fetched": set(), "ts": time.monotonic()},
         )
         ent["parts"][rank] = payload
         if len(ent["parts"]) == self.world_size:
@@ -86,6 +101,12 @@ class _Rendezvous:
             return ordered
         if kind == "reducescatter":
             red = _reduce(ordered, ReduceOp(meta["op"]))
+            if red.shape[0] % self.world_size != 0:
+                raise ValueError(
+                    f"reducescatter axis-0 size {red.shape[0]} is not divisible "
+                    f"by world_size {self.world_size} (matching in_graph/"
+                    "psum_scatter semantics)"
+                )
             return np.array_split(red, self.world_size, axis=0)
         if kind == "broadcast":
             return parts[meta["src_rank"]]
@@ -119,11 +140,12 @@ class _Rendezvous:
         return ("ready", out)
 
     def p2p_send(self, src: int, dst: int, seq: int, payload):
-        self.p2p[(src, dst, seq)] = payload
+        self._gc()
+        self.p2p[(src, dst, seq)] = (time.monotonic(), payload)
 
     def p2p_recv(self, src: int, dst: int, seq: int):
         if (src, dst, seq) in self.p2p:
-            return ("ready", self.p2p.pop((src, dst, seq)))
+            return ("ready", self.p2p.pop((src, dst, seq))[1])
         return ("pending", None)
 
 
@@ -136,10 +158,19 @@ class _GroupClient:
         self.seq = 0
         self.send_seq: Dict[int, int] = {}
         self.recv_seq: Dict[int, int] = {}
+        # set after a collective timeout: the group's op counters can no
+        # longer be assumed aligned across ranks, so further use is an error
+        self.broken = False
 
     def run(self, payload, meta: dict, timeout_s: Optional[float] = None):
         import ray_tpu
 
+        if self.broken:
+            raise RuntimeError(
+                f"collective group {self.group_name!r} is broken after a "
+                "timeout (op counters may be desynchronized); destroy and "
+                "re-init the group on every rank"
+            )
         key = self.seq
         self.seq += 1
         deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
@@ -147,11 +178,13 @@ class _GroupClient:
         sleep = _POLL_S
         while state == "pending":
             if time.monotonic() > deadline:
+                self.broken = True
                 raise TimeoutError(
                     f"collective {meta['kind']!r} op {key} on group "
                     f"{self.group_name!r} timed out waiting for peers "
                     f"(rank {self.rank}/{self.world_size}); a peer likely "
-                    "died or diverged in collective-call order"
+                    "died or diverged in collective-call order. The group is "
+                    "now marked broken; destroy and re-init to continue"
                 )
             time.sleep(sleep)
             sleep = min(sleep * 2, _POLL_MAX_S)  # back off: serial actor
@@ -328,12 +361,14 @@ def recv(src_rank: int, group_name: str = "default", timeout_s: Optional[float] 
 
     g = _group(group_name)
     seq = g.recv_seq.get(src_rank, 0)
-    g.recv_seq[src_rank] = seq + 1
     deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
     sleep = _POLL_S
     while True:
         state, out = ray_tpu.get(g.actor.p2p_recv.remote(src_rank, g.rank, seq))
         if state == "ready":
+            # consume the seq only on success so a timed-out recv can be
+            # retried without desynchronizing from the sender
+            g.recv_seq[src_rank] = seq + 1
             return out
         if time.monotonic() > deadline:
             raise TimeoutError(

@@ -1,0 +1,23 @@
+"""Durable workflows (reference: python/ray/workflow/ — api.py,
+workflow_executor.py, workflow_state_from_dag.py, storage/).
+
+A workflow is a task DAG (ray_tpu.dag) executed with per-step durability:
+every step's output is persisted to storage before dependents run, the
+DAG itself is persisted at submission, and `resume(workflow_id)` re-runs
+only steps that have not yet succeeded. Step identity is positional in
+the deterministic topo-sort, so resume after process death matches steps
+to their checkpoints without relying on Python object ids.
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    resume_async,
+    run,
+    run_async,
+    WorkflowStatus,
+)

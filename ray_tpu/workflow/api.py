@@ -1,0 +1,285 @@
+"""Workflow public API + executor.
+
+Reference surface: python/ray/workflow/api.py (run/run_async/resume/
+get_status/get_output/list_all/delete); durability model from
+workflow_executor.py + storage/ (every step output checkpointed).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..dag.class_node import ClassMethodNode, ClassNode
+from ..dag.dag_node import DAGNode, _map_structure
+from ..dag.function_node import FunctionNode
+from ..dag.input_node import InputAttributeNode, InputNode
+
+_STORAGE_ROOT: Optional[str] = None
+
+
+class WorkflowStatus(str, enum.Enum):
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference: workflow.init)."""
+    global _STORAGE_ROOT
+    _STORAGE_ROOT = storage or _STORAGE_ROOT or _default_root()
+    os.makedirs(_STORAGE_ROOT, exist_ok=True)
+
+
+def _default_root() -> str:
+    return os.environ.get("RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows")
+
+
+def _root() -> str:
+    if _STORAGE_ROOT is None:
+        init()
+    return _STORAGE_ROOT
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_root(), workflow_id)
+
+
+def _meta_path(wf: str) -> str:
+    return os.path.join(_wf_dir(wf), "meta.json")
+
+
+def _write_meta(wf: str, **updates) -> dict:
+    path = _meta_path(wf)
+    meta = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            meta = json.load(f)
+    meta.update(updates)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+    return meta
+
+
+def _step_plan(dag: DAGNode) -> List[Tuple[str, DAGNode]]:
+    """Deterministic (step_key, node) list: positional topo order."""
+    plan = []
+    for i, node in enumerate(dag.topo_sort()):
+        if isinstance(node, (ClassNode, ClassMethodNode)):
+            raise ValueError(
+                "workflows support task DAGs only (actors are not durable); "
+                "got a ClassNode/ClassMethodNode"
+            )
+        name = ""
+        if isinstance(node, FunctionNode):
+            name = getattr(
+                getattr(node._remote_function, "_function", None), "__name__", "fn"
+            )
+        plan.append((f"{i:04d}_{type(node).__name__}_{name}", node))
+    return plan
+
+
+def _step_path(wf: str, key: str) -> str:
+    return os.path.join(_wf_dir(wf), "steps", key + ".pkl")
+
+
+def _execute_workflow(workflow_id: str) -> Any:
+    """(Re)drive a persisted workflow to completion. Steps already
+    checkpointed are loaded, everything else runs as tasks."""
+    wdir = _wf_dir(workflow_id)
+    with open(os.path.join(wdir, "dag.pkl"), "rb") as f:
+        dag: DAGNode = pickle.loads(f.read())
+    with open(os.path.join(wdir, "inputs.pkl"), "rb") as f:
+        input_args, input_kwargs = pickle.loads(f.read())
+
+    _write_meta(workflow_id, status=WorkflowStatus.RUNNING.value, driver_pid=os.getpid())
+    results: Dict[int, Any] = {}  # node id -> materialized value
+    memo = {"__input__": (input_args, input_kwargs)}
+
+    import ray_tpu
+
+    def persist(key: str, value: Any):
+        spath = _step_path(workflow_id, key)
+        os.makedirs(os.path.dirname(spath), exist_ok=True)
+        tmp = spath + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(pickle.dumps(value))
+        os.replace(tmp, spath)
+
+    try:
+        plan = _step_plan(dag)
+        key_of = {id(node): key for key, node in plan}
+        remaining: List[DAGNode] = []
+        for key, node in plan:
+            spath = _step_path(workflow_id, key)
+            if os.path.exists(spath):
+                with open(spath, "rb") as f:
+                    results[id(node)] = pickle.loads(f.read())
+            else:
+                remaining.append(node)
+
+        # Frontier executor: every ready FunctionNode is submitted as a task
+        # immediately, so independent branches run in parallel; each result
+        # is checkpointed as its ref resolves (durability stays per-step).
+        in_flight: Dict[Any, DAGNode] = {}  # ObjectRef -> node
+        while remaining or in_flight:
+            progressed = True
+            while progressed:
+                progressed = False
+                for node in list(remaining):
+                    if not all(id(c) in results for c in node._children()):
+                        continue
+                    if isinstance(node, (InputNode, InputAttributeNode)):
+                        value = node._execute_node(memo)
+                        persist(key_of[id(node)], value)
+                        results[id(node)] = value
+                    elif isinstance(node, FunctionNode):
+                        args = _map_structure(node._bound_args, lambda n: results[id(n)])
+                        kwargs = _map_structure(node._bound_kwargs, lambda n: results[id(n)])
+                        in_flight[node._remote_function.remote(*args, **kwargs)] = node
+                    else:
+                        raise ValueError(
+                            f"unsupported node type in workflow: {type(node).__name__}"
+                        )
+                    remaining.remove(node)
+                    progressed = True
+            if in_flight:
+                done, _ = ray_tpu.wait(list(in_flight), num_returns=1)
+                node = in_flight.pop(done[0])
+                value = ray_tpu.get(done[0])
+                persist(key_of[id(node)], value)
+                results[id(node)] = value
+        out = results[id(dag)]
+        with open(os.path.join(wdir, "result.pkl"), "wb") as f:
+            f.write(pickle.dumps(out))
+        _write_meta(
+            workflow_id, status=WorkflowStatus.SUCCESSFUL.value, finished_at=time.time()
+        )
+        return out
+    except Exception as e:
+        _write_meta(workflow_id, status=WorkflowStatus.FAILED.value, error=repr(e))
+        raise
+
+
+def run(
+    dag: DAGNode,
+    *args,
+    workflow_id: Optional[str] = None,
+    **kwargs,
+) -> Any:
+    """Run a DAG durably; blocks and returns the result
+    (reference: workflow.run, api.py)."""
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
+    wdir = _wf_dir(workflow_id)
+    if os.path.exists(os.path.join(wdir, "dag.pkl")):
+        raise ValueError(
+            f"workflow id {workflow_id!r} already exists; use resume()"
+        )
+    os.makedirs(os.path.join(wdir, "steps"), exist_ok=True)
+    import cloudpickle
+
+    with open(os.path.join(wdir, "dag.pkl"), "wb") as f:
+        f.write(cloudpickle.dumps(dag))
+    with open(os.path.join(wdir, "inputs.pkl"), "wb") as f:
+        f.write(cloudpickle.dumps((args, kwargs)))
+    _write_meta(
+        workflow_id,
+        status=WorkflowStatus.RUNNING.value,
+        created_at=time.time(),
+        workflow_id=workflow_id,
+    )
+    return _execute_workflow(workflow_id)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs) -> Future:
+    """Like run() but returns a concurrent.futures.Future immediately. The
+    (possibly auto-generated) id is exposed as `future.workflow_id` so the
+    caller can resume()/get_status() after a crash."""
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
+    fut: Future = Future()
+    fut.workflow_id = workflow_id
+
+    def target():
+        try:
+            fut.set_result(run(dag, *args, workflow_id=workflow_id, **kwargs))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True, name="workflow-run").start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Resume a failed/interrupted workflow from its step checkpoints."""
+    if not os.path.exists(os.path.join(_wf_dir(workflow_id), "dag.pkl")):
+        raise ValueError(f"no such workflow {workflow_id!r}")
+    return _execute_workflow(workflow_id)
+
+
+def resume_async(workflow_id: str) -> Future:
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(resume(workflow_id))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True, name="workflow-resume").start()
+    return fut
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, TypeError):
+        return False
+
+
+def get_status(workflow_id: str) -> WorkflowStatus:
+    path = _meta_path(workflow_id)
+    if not os.path.exists(path):
+        raise ValueError(f"no such workflow {workflow_id!r}")
+    with open(path) as f:
+        meta = json.load(f)
+    status = WorkflowStatus(meta["status"])
+    if status == WorkflowStatus.RUNNING and not _pid_alive(meta.get("driver_pid")):
+        # driver died mid-run: checkpoints are on disk, resume() will finish it
+        return WorkflowStatus.RESUMABLE
+    return status
+
+
+def get_output(workflow_id: str) -> Any:
+    path = os.path.join(_wf_dir(workflow_id), "result.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no result (not finished?)")
+    with open(path, "rb") as f:
+        return pickle.loads(f.read())
+
+
+def list_all() -> List[Tuple[str, WorkflowStatus]]:
+    root = _root()
+    out = []
+    for wf in sorted(os.listdir(root)) if os.path.exists(root) else []:
+        try:
+            out.append((wf, get_status(wf)))
+        except (ValueError, KeyError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)

@@ -120,6 +120,14 @@ def test_error_propagates_to_all_ranks(group):
             ray_tpu.get(ref)
 
 
+def test_reducescatter_indivisible_raises(group):
+    actors = group
+    refs = [a.do.remote("reducescatter", np.ones((10, 2))) for a in actors]
+    for ref in refs:
+        with pytest.raises(Exception, match="divisible"):
+            ray_tpu.get(ref)
+
+
 def test_backend_validation(ray_start_regular):
     from ray_tpu.util import collective as col
 

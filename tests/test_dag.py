@@ -1,0 +1,183 @@
+"""DAG API (reference: python/ray/dag/ tests) + durable workflows
+(reference: python/ray/workflow/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, k):
+        self.v += k
+        return self.v
+
+
+def test_function_dag(ray_start_regular):
+    with InputNode() as inp:
+        d = double.bind(inp)
+        out = add.bind(d, double.bind(d))
+    # (2x) + (2*2x) = 6x
+    assert ray_tpu.get(out.execute(5)) == 30
+    assert ray_tpu.get(out.execute(7)) == 42
+
+
+def test_diamond_submits_once(ray_start_regular):
+    # the shared `d` node must produce ONE task per execute; verify by side
+    # effect through an actor
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(x):
+        ray_tpu.get(c.inc.remote(1))
+        return x
+
+    with InputNode() as inp:
+        d = bump.bind(inp)
+        out = add.bind(d, d)
+    assert ray_tpu.get(out.execute(3)) == 6
+    assert ray_tpu.get(c.inc.remote(0)) == 1  # bump ran exactly once
+
+
+def test_class_node_dag(ray_start_regular):
+    with InputNode() as inp:
+        counter = Counter.bind(10)
+        out = counter.inc.bind(inp)
+    assert ray_tpu.get(out.execute(5)) == 15
+    # same actor across executions (stateful composition)
+    assert ray_tpu.get(out.execute(1)) == 16
+
+
+def test_input_attribute_access(ray_start_regular):
+    with InputNode() as inp:
+        out = add.bind(inp[0], inp.k)
+    assert ray_tpu.get(out.execute(3, k=4)) == 7
+
+
+def test_namedtuple_args(ray_start_regular):
+    from collections import namedtuple
+
+    Pair = namedtuple("Pair", "a b")
+
+    @ray_tpu.remote
+    def total(p):
+        # Ray parity: ObjectRefs nested inside structures arrive as refs
+        return ray_tpu.get(p.a) + p.b
+
+    with InputNode() as inp:
+        out = total.bind(Pair(double.bind(inp), 3))
+    assert ray_tpu.get(out.execute(2)) == 7
+
+
+def test_bind_on_live_actor(ray_start_regular):
+    c = Counter.remote(100)
+    node = c.inc.bind(5)
+    assert ray_tpu.get(node.execute()) == 105
+
+
+class TestWorkflow:
+    def test_run_and_output(self, ray_start_regular, tmp_path):
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        with InputNode() as inp:
+            out = add.bind(double.bind(inp), 1)
+        assert workflow.run(out, 10, workflow_id="w1") == 21
+        assert workflow.get_status("w1") == workflow.WorkflowStatus.SUCCESSFUL
+        assert workflow.get_output("w1") == 21
+        assert ("w1", workflow.WorkflowStatus.SUCCESSFUL) in workflow.list_all()
+
+    def test_resume_skips_done_steps(self, ray_start_regular, tmp_path):
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        marker = tmp_path / "fail"
+        marker.write_text("1")
+
+        @ray_tpu.remote
+        def flaky(x):
+            import os
+
+            if os.path.exists(str(marker)):
+                raise RuntimeError("injected")
+            return x + 1
+
+        @ray_tpu.remote
+        def record(x):
+            (tmp_path / "count").write_text(
+                str(int((tmp_path / "count").read_text() or 0) + 1)
+                if (tmp_path / "count").exists()
+                else "1"
+            )
+            return x
+
+        with InputNode() as inp:
+            out = flaky.bind(record.bind(inp))
+        with pytest.raises(Exception):
+            workflow.run(out, 5, workflow_id="w2")
+        assert workflow.get_status("w2") == workflow.WorkflowStatus.FAILED
+        marker.unlink()
+        assert workflow.resume("w2") == 6
+        # record step must NOT re-run on resume (its checkpoint existed)
+        assert (tmp_path / "count").read_text() == "1"
+
+    def test_async_and_delete(self, ray_start_regular, tmp_path):
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        with InputNode() as inp:
+            out = double.bind(inp)
+        fut = workflow.run_async(out, 8, workflow_id="w3")
+        assert fut.result(timeout=60) == 16
+        workflow.delete("w3")
+        with pytest.raises(ValueError):
+            workflow.get_status("w3")
+
+    def test_resumable_status_on_dead_driver(self, ray_start_regular, tmp_path):
+        import json
+
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        with InputNode() as inp:
+            out = double.bind(inp)
+        workflow.run(out, 2, workflow_id="w5")
+        meta_path = tmp_path / "w5" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        meta.update(status="RUNNING", driver_pid=2**22 + 12345)  # dead pid
+        meta_path.write_text(json.dumps(meta))
+        assert workflow.get_status("w5") == workflow.WorkflowStatus.RESUMABLE
+
+    def test_run_async_exposes_workflow_id(self, ray_start_regular, tmp_path):
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        with InputNode() as inp:
+            out = double.bind(inp)
+        fut = workflow.run_async(out, 4)
+        assert fut.result(timeout=60) == 8
+        assert workflow.get_output(fut.workflow_id) == 8
+
+    def test_rejects_actors(self, ray_start_regular, tmp_path):
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+        counter = Counter.bind(0)
+        node = counter.inc.bind(1)
+        with pytest.raises(ValueError, match="not durable"):
+            workflow.run(node, workflow_id="w4")
