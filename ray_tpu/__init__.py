@@ -37,6 +37,7 @@ __all__ = [
     "ActorHandle",
     "get_runtime_context",
     "method",
+    "timeline",
 ]
 
 
@@ -161,6 +162,19 @@ def available_resources() -> Dict[str, float]:
 
 def nodes() -> List[dict]:
     return global_worker.request({"t": "nodes"})
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-tracing timeline of task execution (reference: ray.timeline,
+    python/ray/_private/profiling.py). Returns the event list; writes JSON
+    to `filename` if given (load in chrome://tracing or Perfetto)."""
+    import json
+
+    events = global_worker.request({"t": "timeline"})
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
 
 
 class RuntimeContext:

@@ -81,6 +81,14 @@ class TaskRecord:
     node_id: Optional[str] = None
     state: str = "pending"  # pending|waiting_deps|scheduled|running|done|failed
     deps_remaining: int = 0
+    worker_id: Optional[str] = None
+    # (state, wall-time) transitions — feeds the state API + `timeline()`
+    # (reference: core_worker/task_event_buffer.h -> gcs_task_manager.h:61)
+    events: List = field(default_factory=list)
+
+    def mark(self, state: str):
+        self.state = state
+        self.events.append((state, time.time()))
 
 
 @dataclass
@@ -228,6 +236,8 @@ class Head:
         self.job_config: Dict[str, Any] = {}
         self._shm = None
         self._shm_tried = False
+        # per-process metric snapshots: proc key -> {metric key -> snapshot}
+        self.metrics_store: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -441,10 +451,10 @@ class Head:
         asyncio.get_running_loop().create_task(self._resolve_and_enqueue(rec))
 
     async def _resolve_and_enqueue(self, rec: TaskRecord):
-        rec.state = "waiting_deps"
+        rec.mark("waiting_deps")
         for oid in rec.spec.get("deps", []):
             await self.objects.wait_available(oid)
-        rec.state = "pending"
+        rec.mark("pending")
         self.pending_queue.append(rec)
         self._pump()
 
@@ -754,6 +764,98 @@ class Head:
         return "pong"
 
     # ------------------------------------------------------------------
+    # state API + observability (reference: dashboard/state_aggregator.py,
+    # experimental/state/api.py; task events: gcs_task_manager.h:61)
+    # ------------------------------------------------------------------
+
+    async def _h_list_tasks(self, conn, msg):
+        limit = msg.get("limit", 1000)
+        out = []
+        for tid, t in list(self.tasks.items())[-limit:]:
+            out.append(
+                {
+                    "task_id": tid,
+                    "name": t.spec.get("name") or t.spec.get("fn_key", ""),
+                    "state": t.state,
+                    "node_id": t.node_id,
+                    "worker_id": t.worker_id,
+                    "events": list(t.events),
+                    "retries_left": t.retries_left,
+                }
+            )
+        return out
+
+    async def _h_list_objects(self, conn, msg):
+        limit = msg.get("limit", 1000)
+        out = []
+        from .serialization import shm_buffer_names
+
+        for oid, env in list(self.objects.objects.items())[:limit]:
+            try:
+                size = env.total_bytes()
+            except Exception:
+                size = 0
+            try:
+                in_shm = bool(shm_buffer_names(env))
+            except Exception:
+                in_shm = False
+            out.append(
+                {
+                    "object_id": oid,
+                    "size_bytes": size,
+                    "refcount": int(self.objects.refcounts.get(oid, 0)),
+                    "pins": int(self.objects.task_pins.get(oid, 0)),
+                    "is_error": bool(getattr(env, "is_error", False)),
+                    "in_shm": in_shm,
+                }
+            )
+        return out
+
+    async def _h_list_workers(self, conn, msg):
+        return [
+            {
+                "worker_id": w.worker_id,
+                "node_id": w.node_id,
+                "state": w.state,
+                "actor_id": w.actor_id,
+                "pid": w.proc.pid if w.proc else None,
+            }
+            for w in self.workers.values()
+        ]
+
+    async def _h_timeline(self, conn, msg):
+        """Chrome-tracing events (reference: python/ray/_private/profiling.py
+        `ray timeline`): one complete event per task run + instant events
+        for failures."""
+        events = []
+        for tid, t in self.tasks.items():
+            times = dict(t.events)
+            start = times.get("running")
+            if start is None:
+                continue
+            end = times.get("done") or times.get("failed") or time.time()
+            events.append(
+                {
+                    "name": t.spec.get("name") or t.spec.get("fn_key", "task"),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": (end - start) * 1e6,
+                    "pid": t.node_id or "?",
+                    "tid": t.worker_id or "?",
+                    "args": {"task_id": tid, "state": t.state},
+                }
+            )
+        return events
+
+    async def _h_push_metrics(self, conn, msg):
+        # snapshots merged per (process, metric); aggregation happens at read
+        self.metrics_store[msg["proc"]] = msg["metrics"]
+
+    async def _h_get_metrics(self, conn, msg):
+        return dict(self.metrics_store)
+
+    # ------------------------------------------------------------------
     # scheduling + worker pool
     # ------------------------------------------------------------------
 
@@ -834,7 +936,7 @@ class Head:
                 still_pending.append(rec)
                 continue
             rec.node_id = nid
-            rec.state = "scheduled"
+            rec.mark("scheduled")
             asyncio.get_running_loop().create_task(self._dispatch_task(rec))
         self.pending_queue = still_pending
 
@@ -848,7 +950,8 @@ class Head:
             self._release_node(rec.node_id, rec.resources, rec.spec.get("scheduling_strategy"))
             await self._retry_or_fail(rec, RuntimeError("failed to lease a worker"))
             return
-        rec.state = "running"
+        rec.worker_id = w.worker_id
+        rec.mark("running")
         spec = rec.spec
         try:
             reply = await w.conn.request(
@@ -875,7 +978,7 @@ class Head:
         for oid in spec.get("deps", []):
             self.objects.unpin(oid)
         self._store_task_results(spec, reply)
-        rec.state = "done"
+        rec.mark("done")
 
     async def _retry_or_fail(self, rec: TaskRecord, error: Exception):
         from ..exceptions import WorkerCrashedError
@@ -883,11 +986,11 @@ class Head:
         if rec.retries_left > 0 and not self._shutdown:
             rec.retries_left -= 1
             await asyncio.sleep(cfg.task_retry_delay_ms / 1000.0)
-            rec.state = "pending"
+            rec.mark("pending")
             self.pending_queue.append(rec)
             self._pump()
             return
-        rec.state = "failed"
+        rec.mark("failed")
         for oid in rec.spec.get("deps", []):
             self.objects.unpin(oid)
         self._fail_task_returns(rec.spec, WorkerCrashedError(f"task failed: {error!r}"))

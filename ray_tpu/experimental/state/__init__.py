@@ -1,0 +1,9 @@
+from .api import (  # noqa: F401
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+)

@@ -1,0 +1,74 @@
+"""State API: live-cluster introspection.
+
+Reference parity: python/ray/experimental/state/api.py +
+dashboard/state_aggregator.py (the `ray list tasks/actors/objects/...`
+surface). Each call is one head request; filters are (key, predicate,
+value) triples like the reference's CLI filters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+Filter = Tuple[str, str, Any]  # (key, "="|"!=", value)
+
+
+def _request(msg: dict):
+    from ..._private.worker import global_worker
+
+    return global_worker.request(msg)
+
+
+def _apply_filters(rows: List[dict], filters: Optional[List[Filter]]) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            got = row.get(key)
+            if op in ("=", "=="):
+                ok = got == value
+            elif op == "!=":
+                ok = got != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def list_tasks(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:
+    return _apply_filters(_request({"t": "list_tasks", "limit": limit}), filters)
+
+
+def list_actors(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:
+    return _apply_filters(_request({"t": "list_actors"}), filters)[:limit]
+
+
+def list_objects(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:
+    return _apply_filters(_request({"t": "list_objects", "limit": limit}), filters)
+
+
+def list_nodes(filters: Optional[List[Filter]] = None) -> List[dict]:
+    return _apply_filters(_request({"t": "nodes"}), filters)
+
+
+def list_workers(filters: Optional[List[Filter]] = None) -> List[dict]:
+    return _apply_filters(_request({"t": "list_workers"}), filters)
+
+
+def list_placement_groups(filters: Optional[List[Filter]] = None) -> List[dict]:
+    table = _request({"t": "pg_table"})
+    rows = list(table.values()) if isinstance(table, dict) else table
+    return _apply_filters(rows, filters)
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Counts by state (reference: `ray summary tasks`)."""
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts

@@ -1,0 +1,161 @@
+"""CLI: `python -m ray_tpu.scripts <cmd>`.
+
+Reference parity: python/ray/scripts/scripts.py (`ray status` :1947) and
+python/ray/experimental/state/state_cli.py (`ray list ...`), plus
+`ray timeline` and a Prometheus-text metrics dump. Attaches to a RUNNING
+session's head socket as an observer (no driver registration), so it can
+inspect a live cluster from another terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import sys
+import threading
+from typing import Any, Optional
+
+
+def _find_session(session_dir: Optional[str]) -> str:
+    if session_dir:
+        return session_dir
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    candidates = sorted(
+        glob.glob(os.path.join(cfg.session_dir_root, "session_*")), key=os.path.getmtime
+    )
+    live = [d for d in candidates if os.path.exists(os.path.join(d, "head.sock"))]
+    if not live:
+        sys.exit(
+            f"no live ray_tpu session under {cfg.session_dir_root} "
+            "(sessions are removed on shutdown)"
+        )
+    return live[-1]
+
+
+class _Observer:
+    """Minimal request client on the head socket (no driver registration)."""
+
+    def __init__(self, session_dir: str):
+        from ray_tpu._private import protocol
+
+        self._protocol = protocol
+        self.socket_path = os.path.join(session_dir, "head.sock")
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._open(), self.loop)
+        self.conn = fut.result(timeout=10)
+
+    async def _open(self):
+        reader, writer = await asyncio.open_unix_connection(self.socket_path)
+
+        async def handler(msg):
+            return None
+
+        return self._protocol.Connection(reader, writer, handler).start()
+
+    def request(self, msg: dict, timeout: float = 30.0) -> Any:
+        fut = asyncio.run_coroutine_threadsafe(self.conn.request(msg, timeout), self.loop)
+        return fut.result(timeout + 5)
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+def _fmt_table(rows, columns) -> str:
+    if not rows:
+        return "(empty)"
+    widths = [
+        max(len(str(c)), max(len(str(r.get(c, ""))) for r in rows)) for c in columns
+    ]
+    out = ["  ".join(str(c).ljust(w) for c, w in zip(columns, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).ljust(w) for c, w in zip(columns, widths)))
+    return "\n".join(out)
+
+
+def cmd_status(obs: _Observer, args) -> None:
+    res = obs.request({"t": "cluster_resources"})
+    nodes = obs.request({"t": "nodes"})
+    tasks = obs.request({"t": "list_tasks", "limit": 100000})
+    states = {}
+    for t in tasks:
+        states[t["state"]] = states.get(t["state"], 0) + 1
+    print(f"nodes: {len(nodes)} alive={sum(1 for n in nodes if n.get('alive', True))}")
+    print("resources:")
+    for k in sorted(res["total"]):
+        print(f"  {k}: {res['available'].get(k, 0.0):g}/{res['total'][k]:g} available")
+    if states:
+        print("tasks:", " ".join(f"{k}={v}" for k, v in sorted(states.items())))
+
+
+_LIST_SPECS = {
+    "tasks": ({"t": "list_tasks"}, ["task_id", "name", "state", "node_id", "worker_id"]),
+    "actors": ({"t": "list_actors"}, ["actor_id", "class_name", "state", "name", "worker_id"]),
+    "objects": ({"t": "list_objects"}, ["object_id", "size_bytes", "refcount", "pins", "in_shm"]),
+    "nodes": ({"t": "nodes"}, ["node_id", "alive", "resources"]),
+    "workers": ({"t": "list_workers"}, ["worker_id", "node_id", "state", "actor_id", "pid"]),
+    "placement-groups": ({"t": "pg_table"}, ["pg_id", "state", "strategy", "name"]),
+}
+
+
+def cmd_list(obs: _Observer, args) -> None:
+    msg, columns = _LIST_SPECS[args.kind]
+    rows = obs.request(dict(msg))
+    if isinstance(rows, dict):
+        rows = list(rows.values())
+    if args.json:
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        print(_fmt_table(rows, columns))
+
+
+def cmd_timeline(obs: _Observer, args) -> None:
+    events = obs.request({"t": "timeline"})
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} (open in chrome://tracing)")
+
+
+def cmd_metrics(obs: _Observer, args) -> None:
+    store = obs.request({"t": "get_metrics"})
+    # per-process dump (export_prometheus's cluster merge needs a connected
+    # worker; the CLI is a detached observer)
+    merged_lines = []
+    for proc in sorted(store):
+        for name, snap in sorted(store[proc].items()):
+            for tags, v in snap["values"].items():
+                tag_s = ",".join(f'{k}="{val}"' for k, val in tags)
+                val = v if not isinstance(v, dict) else v.get("count")
+                merged_lines.append(f'{name}{{proc="{proc}"{"," + tag_s if tag_s else ""}}} {val}')
+    print("\n".join(merged_lines) if merged_lines else "(no metrics)")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    parser.add_argument("--session-dir", help="session dir (default: newest live session)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="cluster resources + task summary")
+    p_list = sub.add_parser("list", help="list cluster state")
+    p_list.add_argument("kind", choices=sorted(_LIST_SPECS))
+    p_list.add_argument("--json", action="store_true")
+    p_tl = sub.add_parser("timeline", help="dump chrome-tracing timeline")
+    p_tl.add_argument("-o", "--output", default="timeline.json")
+    sub.add_parser("metrics", help="dump metrics (prometheus-ish text)")
+    args = parser.parse_args(argv)
+
+    obs = _Observer(_find_session(args.session_dir))
+    try:
+        {
+            "status": cmd_status,
+            "list": cmd_list,
+            "timeline": cmd_timeline,
+            "metrics": cmd_metrics,
+        }[args.cmd](obs, args)
+    finally:
+        obs.close()

@@ -82,6 +82,7 @@ class _Rendezvous:
             key,
             {"parts": {}, "meta": meta, "result": None, "error": None, "fetched": set(), "ts": time.monotonic()},
         )
+        ent["ts"] = time.monotonic()  # staggered arrivals keep the op live
         ent["parts"][rank] = payload
         if len(ent["parts"]) == self.world_size:
             try:
@@ -171,9 +172,15 @@ class _GroupClient:
                 "timeout (op counters may be desynchronized); destroy and "
                 "re-init the group on every rank"
             )
+        timeout_s = timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S
+        if timeout_s > _GC_TTL_S:
+            raise ValueError(
+                f"timeout_s {timeout_s} exceeds the rendezvous GC TTL "
+                f"({_GC_TTL_S}s); state would be collected before the wait ends"
+            )
         key = self.seq
         self.seq += 1
-        deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
+        deadline = time.monotonic() + timeout_s
         state, out = ray_tpu.get(self.actor.contribute.remote(key, self.rank, payload, meta))
         sleep = _POLL_S
         while state == "pending":
@@ -360,8 +367,13 @@ def recv(src_rank: int, group_name: str = "default", timeout_s: Optional[float] 
     import ray_tpu
 
     g = _group(group_name)
+    timeout_s = timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S
+    if timeout_s > _GC_TTL_S:
+        raise ValueError(
+            f"timeout_s {timeout_s} exceeds the rendezvous GC TTL ({_GC_TTL_S}s)"
+        )
     seq = g.recv_seq.get(src_rank, 0)
-    deadline = time.monotonic() + (timeout_s if timeout_s is not None else DEFAULT_TIMEOUT_S)
+    deadline = time.monotonic() + timeout_s
     sleep = _POLL_S
     while True:
         state, out = ray_tpu.get(g.actor.p2p_recv.remote(src_rank, g.rank, seq))

@@ -1,0 +1,239 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Reference parity: python/ray/util/metrics.py (Counter :150, Histogram :215,
+Gauge :290) + the per-node MetricsAgent (python/ray/_private/metrics_agent.py)
+that converts to Prometheus. Here every process keeps a local registry and
+pushes throttled snapshots to the head over the control socket (the
+reference's opencensus export path); `export_prometheus()` renders the
+cluster-wide aggregate in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_FLUSH_INTERVAL_S = 0.5
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.metrics: Dict[str, "Metric"] = {}
+        self._last_flush = 0.0
+
+    def register(self, m: "Metric"):
+        """Same-name re-creation ALIASES the existing metric (shared values/
+        lock) instead of replacing it — a task body re-declaring a Counter in
+        a reused worker process keeps accumulating, never resets."""
+        with self.lock:
+            existing = self.metrics.get(m.name)
+            if existing is not None:
+                if type(existing) is not type(m):
+                    raise ValueError(
+                        f"metric {m.name!r} already registered as {type(existing).__name__}"
+                    )
+                m._values = existing._values
+                m._lock = existing._lock
+                return
+            self.metrics[m.name] = m
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self.lock:
+            return {name: m._snapshot() for name, m in self.metrics.items()}
+
+    def maybe_flush(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_flush < _FLUSH_INTERVAL_S:
+            return
+        self._last_flush = now
+        try:
+            from .._private.worker import global_worker
+
+            if global_worker.connected:
+                # node id disambiguates same-pid workers on different hosts
+                node = getattr(global_worker, "node_id", None) or "node"
+                global_worker.send(
+                    {
+                        "t": "push_metrics",
+                        "proc": f"{node}:pid-{os.getpid()}",
+                        "metrics": self.snapshot(),
+                    }
+                )
+        except Exception:
+            pass  # metrics must never break the workload
+
+
+_REGISTRY = _Registry()
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name or not isinstance(name, str):
+            raise ValueError("metric name must be a non-empty string")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        _REGISTRY.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        extra = set(out) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"unknown tag keys {sorted(extra)} for metric {self.name!r}")
+        return out
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "type": type(self).__name__.lower(),
+                "description": self.description,
+                "values": {_tags_key(dict(k)): v for k, v in self._values.items()},
+            }
+
+
+class Counter(Metric):
+    """Monotonic counter (reference: util/metrics.py:150)."""
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc value must be >= 0")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        _REGISTRY.maybe_flush()
+
+
+class Gauge(Metric):
+    """Last-value gauge (reference: util/metrics.py:290)."""
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+        _REGISTRY.maybe_flush()
+
+
+class Histogram(Metric):
+    """Bucketed histogram (reference: util/metrics.py:215)."""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[List[float]] = None,
+        tag_keys: Sequence[str] = (),
+    ):
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        if any(b <= 0 for b in self.boundaries):
+            raise ValueError("histogram boundaries must be positive")
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            ent = self._values.get(key)
+            if not isinstance(ent, dict):
+                ent = self._values[key] = {
+                    "buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            ent["buckets"][idx] += 1
+            ent["sum"] += value
+            ent["count"] += 1
+        _REGISTRY.maybe_flush()
+
+    def _snapshot(self) -> dict:
+        snap = super()._snapshot()
+        snap["boundaries"] = list(self.boundaries)
+        return snap
+
+
+def flush():
+    """Force-push this process's metrics to the head."""
+    _REGISTRY.maybe_flush(force=True)
+
+
+def _fmt_tags(tags: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def export_prometheus() -> str:
+    """Render the cluster-wide metric aggregate (all processes) as
+    Prometheus text (reference: metrics_agent.py opencensus->prometheus)."""
+    from .._private.worker import global_worker
+
+    flush()
+    store = global_worker.request({"t": "get_metrics"})
+    # merge: counters/histograms sum across processes; gauges take last write
+    merged: Dict[str, dict] = {}
+    for proc in sorted(store):
+        for name, snap in store[proc].items():
+            m = merged.setdefault(
+                name,
+                {
+                    "type": snap["type"],
+                    "description": snap["description"],
+                    "boundaries": snap.get("boundaries"),
+                    "values": {},
+                },
+            )
+            for tags, v in snap["values"].items():
+                if m["type"] == "histogram":
+                    ent = m["values"].setdefault(
+                        tags, {"buckets": [0] * (len(m["boundaries"]) + 1), "sum": 0.0, "count": 0}
+                    )
+                    ent["buckets"] = [a + b for a, b in zip(ent["buckets"], v["buckets"])]
+                    ent["sum"] += v["sum"]
+                    ent["count"] += v["count"]
+                elif m["type"] == "counter":
+                    m["values"][tags] = m["values"].get(tags, 0.0) + v
+                else:
+                    m["values"][tags] = v
+    lines = []
+    for name, m in sorted(merged.items()):
+        if m["description"]:
+            lines.append(f"# HELP {name} {m['description']}")
+        ptype = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}[m["type"]]
+        lines.append(f"# TYPE {name} {ptype}")
+        for tags, v in sorted(m["values"].items()):
+            if m["type"] == "histogram":
+                cum = 0
+                for b, n in zip(m["boundaries"], v["buckets"]):
+                    cum += n
+                    le = f'le="{b}"'
+                    lines.append(f"{name}_bucket{_fmt_tags(tags, le)} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_fmt_tags(tags, inf)} {v['count']}")
+                lines.append(f"{name}_sum{_fmt_tags(tags)} {v['sum']}")
+                lines.append(f"{name}_count{_fmt_tags(tags)} {v['count']}")
+            else:
+                lines.append(f"{name}{_fmt_tags(tags)} {v}")
+    return "\n".join(lines) + "\n"

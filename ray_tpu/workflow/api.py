@@ -144,8 +144,19 @@ def _execute_workflow(workflow_id: str) -> Any:
                         persist(key_of[id(node)], value)
                         results[id(node)] = value
                     elif isinstance(node, FunctionNode):
-                        args = _map_structure(node._bound_args, lambda n: results[id(n)])
-                        kwargs = _map_structure(node._bound_kwargs, lambda n: results[id(n)])
+                        # Parity with DAGNode.execute(): a node that IS a
+                        # top-level arg materializes to its value inside the
+                        # task; a node NESTED in a structure arrives as an
+                        # ObjectRef (the runtime only resolves top level)
+                        def sub(obj):
+                            if isinstance(obj, DAGNode):
+                                return results[id(obj)]
+                            return _map_structure(
+                                obj, lambda n: ray_tpu.put(results[id(n)])
+                            )
+
+                        args = tuple(sub(a) for a in node._bound_args)
+                        kwargs = {k: sub(v) for k, v in node._bound_kwargs.items()}
                         in_flight[node._remote_function.remote(*args, **kwargs)] = node
                     else:
                         raise ValueError(
@@ -243,6 +254,8 @@ def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
         return True
+    except PermissionError:
+        return True  # exists, owned by another uid
     except (OSError, TypeError):
         return False
 

@@ -173,6 +173,22 @@ class TestWorkflow:
         assert fut.result(timeout=60) == 8
         assert workflow.get_output(fut.workflow_id) == 8
 
+    def test_nested_ref_parity_with_execute(self, ray_start_regular, tmp_path):
+        # a DAG whose task expects a nested ObjectRef must behave the same
+        # under workflow.run as under .execute()
+        from ray_tpu import workflow
+
+        workflow.init(str(tmp_path))
+
+        @ray_tpu.remote
+        def consume(pair):
+            return ray_tpu.get(pair[0]) + pair[1]
+
+        with InputNode() as inp:
+            out = consume.bind([double.bind(inp), 5])
+        assert ray_tpu.get(out.execute(3)) == 11
+        assert workflow.run(out, 3, workflow_id="w6") == 11
+
     def test_rejects_actors(self, ray_start_regular, tmp_path):
         from ray_tpu import workflow
 

@@ -1,0 +1,146 @@
+"""State API, metrics, timeline, CLI (reference: experimental/state/api.py,
+util/metrics.py, ray timeline, scripts.py)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def work(ms):
+    time.sleep(ms / 1000)
+    return ms
+
+
+@ray_tpu.remote
+class Stateful:
+    def ping(self):
+        return "pong"
+
+
+def test_list_tasks_and_objects(ray_start_regular):
+    from ray_tpu.experimental.state import list_objects, list_tasks, summarize_tasks
+
+    refs = [work.remote(5) for _ in range(4)]
+    ray_tpu.get(refs)
+    tasks = list_tasks()
+    assert len(tasks) >= 4
+    done = [t for t in tasks if t["state"] == "done"]
+    assert len(done) >= 4
+    assert all(t["worker_id"] for t in done)
+    # events carry monotonic-ordered transitions ending in done
+    ev = dict(done[0]["events"])
+    assert "running" in ev and "done" in ev and ev["done"] >= ev["running"]
+    assert summarize_tasks()["done"] >= 4
+
+    objs = list_objects()
+    assert len(objs) >= 4  # results still referenced by `refs`
+    assert all(o["refcount"] >= 1 for o in objs)
+
+    # filters
+    assert list_tasks(filters=[("state", "=", "done")])
+    assert list_tasks(filters=[("state", "=", "nope")]) == []
+
+
+def test_list_actors_workers_nodes(ray_start_regular):
+    from ray_tpu.experimental.state import list_actors, list_nodes, list_workers
+
+    a = Stateful.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    actors = list_actors(filters=[("state", "=", "alive")])
+    assert any(x["class_name"] == "Stateful" for x in actors)
+    assert any(w["state"] == "actor" for w in list_workers())
+    assert list_nodes()
+
+
+def test_timeline(ray_start_regular, tmp_path):
+    ray_tpu.get([work.remote(20) for _ in range(3)])
+    out = tmp_path / "tl.json"
+    events = ray_tpu.timeline(str(out))
+    assert len(events) >= 3
+    loaded = json.loads(out.read_text())
+    assert loaded == events
+    e = events[0]
+    assert e["ph"] == "X" and e["dur"] > 0 and e["ts"] > 0
+
+
+def test_metrics_roundtrip(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(2, {"route": "a"})
+    c.inc(3, {"route": "a"})
+    g = metrics.Gauge("test_queue_depth", "depth")
+    g.set(7)
+    h = metrics.Histogram("test_latency_s", "lat", boundaries=[0.01, 0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    metrics.flush()
+    time.sleep(0.1)
+
+    text = metrics.export_prometheus()
+    assert 'test_requests_total{route="a"} 5.0' in text
+    assert "test_queue_depth 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert "test_latency_s_count 2" in text
+    assert "# TYPE test_latency_s histogram" in text
+
+
+def test_metrics_from_workers(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def record(i):
+        from ray_tpu.util import metrics as wm
+
+        c = wm.Counter("test_worker_events", "events")
+        c.inc()
+        wm.flush()
+        return i
+
+    ray_tpu.get([record.remote(i) for i in range(3)])
+    time.sleep(0.2)
+    text = metrics.export_prometheus()
+    # counters sum across worker processes
+    assert "test_worker_events" in text
+    total = [l for l in text.splitlines() if l.startswith("test_worker_events")]
+    assert sum(float(l.split()[-1]) for l in total) == 3.0
+
+
+def test_metric_validation(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_val_counter", "x", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(1, {"bad_key": "v"})
+    with pytest.raises(ValueError):
+        metrics.Gauge("test_val_counter", "now a gauge")  # type clash
+
+
+def test_cli(ray_start_regular, tmp_path, capsys):
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.scripts.cli import main
+
+    ray_tpu.get([work.remote(5) for _ in range(2)])
+    sd = global_worker.session_dir
+    main(["--session-dir", sd, "status"])
+    out = capsys.readouterr().out
+    assert "nodes: 1" in out and "CPU" in out
+
+    main(["--session-dir", sd, "list", "tasks"])
+    out = capsys.readouterr().out
+    assert "done" in out
+
+    main(["--session-dir", sd, "list", "workers", "--json"])
+    out = capsys.readouterr().out
+    assert json.loads(out)
+
+    tl = tmp_path / "t.json"
+    main(["--session-dir", sd, "timeline", "-o", str(tl)])
+    capsys.readouterr()
+    assert json.loads(tl.read_text())
