@@ -769,9 +769,13 @@ class Head:
     # ------------------------------------------------------------------
 
     async def _h_list_tasks(self, conn, msg):
+        # limit=0 means "all" (client-side filters need the full set)
         limit = msg.get("limit", 1000)
+        items = list(self.tasks.items())
+        if limit:
+            items = items[-limit:]
         out = []
-        for tid, t in list(self.tasks.items())[-limit:]:
+        for tid, t in items:
             out.append(
                 {
                     "task_id": tid,
@@ -850,7 +854,7 @@ class Head:
 
     async def _h_push_metrics(self, conn, msg):
         # snapshots merged per (process, metric); aggregation happens at read
-        self.metrics_store[msg["proc"]] = msg["metrics"]
+        self.metrics_store[msg["proc"]] = {"ts": time.time(), "metrics": msg["metrics"]}
 
     async def _h_get_metrics(self, conn, msg):
         return dict(self.metrics_store)
@@ -1082,10 +1086,17 @@ class Head:
         w.proc = subprocess.Popen(argv, env=env, cwd=os.getcwd())
         return w
 
+    def _prune_worker_metrics(self, w: WorkerRecord):
+        """Dead processes must stop contributing to the metric aggregate
+        (stale gauges would otherwise be reported forever)."""
+        if w.proc is not None:
+            self.metrics_store.pop(f"{w.node_id}:pid-{w.proc.pid}", None)
+
     async def _kill_worker(self, w: WorkerRecord, reason: str = ""):
         if w.state == "dead":
             return
         w.state = "dead"
+        self._prune_worker_metrics(w)
         if w.conn is not None:
             await w.conn.close()
         if w.proc is not None and w.proc.poll() is None:
@@ -1099,6 +1110,7 @@ class Head:
     async def _on_worker_death(self, w: WorkerRecord, reason: str):
         was_actor = w.actor_id
         w.state = "dead"
+        self._prune_worker_metrics(w)
         if w.worker_id in self.idle_workers[w.node_id]:
             self.idle_workers[w.node_id].remove(w.worker_id)
         # actor restart path

@@ -119,10 +119,13 @@ class Worker:
         self.node_id = info["node_id"]
         self.connected = True
 
-    def connect_worker(self, socket_path: str, worker_id: str, io: EventLoopThread, conn):
+    def connect_worker(
+        self, socket_path: str, worker_id: str, io: EventLoopThread, conn, node_id=None
+    ):
         self.mode = MODE_WORKER
         self.io = io
         self.conn = conn
+        self.node_id = node_id
         self.connected = True
 
     async def _open_conn(self, socket_path: str) -> protocol.Connection:

@@ -53,7 +53,9 @@ class WorkerServer:
         io.loop = self._loop
         io.thread = threading.current_thread()
         global_worker.session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
-        global_worker.connect_worker(self.socket_path, self.worker_id, io, self.conn)
+        global_worker.connect_worker(
+            self.socket_path, self.worker_id, io, self.conn, node_id=self.node_id
+        )
 
         await self.conn.request(
             {

@@ -41,7 +41,10 @@ def _apply_filters(rows: List[dict], filters: Optional[List[Filter]]) -> List[di
 
 
 def list_tasks(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:
-    return _apply_filters(_request({"t": "list_tasks", "limit": limit}), filters)
+    # fetch everything when filtering so the limit truncates MATCHES, not
+    # the pre-filter record stream (limit=0 -> no server-side cap)
+    rows = _request({"t": "list_tasks", "limit": 0 if filters else limit})
+    return _apply_filters(rows, filters)[-limit:]
 
 
 def list_actors(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:

@@ -47,7 +47,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    attention: str = "dense"  # dense | ring | ulysses
+    attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = True
     # MoE (expert parallel); n_experts=0 -> dense MLP
     n_experts: int = 0
@@ -255,6 +255,10 @@ def make_forward(
 
     def attend(q, k, v):
         if inner_attn is None or mesh is None:
+            if cfg.attention == "flash":
+                from ..ops.flash_attention import flash_attention
+
+                return flash_attention(q, k, v)
             return causal_attention(q, k, v)
         from jax.sharding import PartitionSpec as P
 

@@ -1,0 +1,210 @@
+"""FlashAttention for TPU in Pallas.
+
+The reference has no fused attention of its own (torch SDPA/CUDA kernels
+arrive via integrations; SURVEY.md §2.4 sequence parallel row). This is the
+TPU-native equivalent: a Pallas kernel that never materializes the [L, L]
+score matrix — online softmax over KV blocks held in VMEM, both matmuls on
+the MXU in f32 accumulation.
+
+Layout convention matches ray_tpu.ops.attention: q/k/v are [B, L, H, D].
+
+Grid: (batch, head, q_block, kv_block); the kv axis is innermost, so the
+f32 accumulator/max/denominator scratch persists across kv iterations of
+one q block (the sequential-last-dim contract of Pallas TPU grids). Causal
+skipping is predicated per block pair — fully-masked pairs never touch the
+MXU.
+
+Backward is a custom VJP: the kernel saves the log-sum-exp row statistics;
+gradients are recomputed blockwise (a lax.scan over KV blocks) so backward
+memory is O(L * BLOCK_K) instead of O(L^2) — same rematerialization trade
+FlashAttention makes on GPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_i[:] = jnp.full_like(m_i, NEG_INF)
+        l_i[:] = jnp.zeros_like(l_i)
+
+    # causal: the whole block pair is masked out iff its lowest q position
+    # is below its lowest k position
+    run = (not causal) or (qi * block_q + block_q - 1 >= kj * block_k)
+
+    @pl.when(run)
+    def _attend():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_i[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_i[:] = alpha * l_i[:] + jnp.sum(p, axis=1, keepdims=True)
+        m_i[:] = m_new
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        # fully-masked q rows (never occur under causal q>=k layouts, but do
+        # with padding) get l=0: emit zeros, not NaNs
+        l = l_i[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_i[:] + jnp.log(safe_l)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q/k/v in [B, L, H, D]; kernel runs in [B, H, L, D] (Mosaic requires
+    the last two BLOCK dims to tile (8, 128) or equal the array dims, so L
+    and D must be innermost). Returns out [B, Lq, H, D], lse [B, H, Lq]."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    nq = lq // block_q
+    nk = lk // block_k
+    grid = (b, h, nq, nk)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def _flash_backward(scale, causal, block_k, res, do):
+    """Blockwise recompute backward (plain JAX, O(L*BLOCK_K) live memory)."""
+    q, k, v, out, lse = res
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # Delta_i = rowsum(dO * O)  [B, L, H]
+    delta = jnp.einsum("blhd,blhd->blh", dof, out.astype(jnp.float32))
+    qpos = jnp.arange(lq)
+
+    nk = lk // block_k
+    kfb = kf.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vfb = vf.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
+
+    def kv_step(dq_acc, inp):
+        j, k_j, v_j = inp  # [B, BK, H, D]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_j) * scale
+        if causal:
+            kpos = j * block_k + jnp.arange(block_k)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, :, None])  # [B, H, L, BK]
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, v_j)
+        ds = p * (dp - delta.transpose(0, 2, 1)[:, :, :, None])  # [B,H,L,BK]
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, k_j) * scale
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        kv_step, jnp.zeros_like(qf), (jnp.arange(nk), kfb, vfb)
+    )
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    return _flash_backward(scale, causal, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, Lq, H, D]
+    k: jnp.ndarray,  # [B, Lk, Hkv, D]
+    v: jnp.ndarray,  # [B, Lk, Hkv, D]
+    *,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops.attention.causal_attention on block-
+    aligned shapes; GQA handled by repeating KV heads outside the kernel
+    (gradients flow through the broadcast). Falls back to the dense einsum
+    path when the sequence doesn't tile evenly."""
+    from .attention import causal_attention, _repeat_kv
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        return causal_attention(q, k, v, scale=scale, causal=causal)
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

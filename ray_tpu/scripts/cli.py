@@ -128,7 +128,7 @@ def cmd_metrics(obs: _Observer, args) -> None:
     # worker; the CLI is a detached observer)
     merged_lines = []
     for proc in sorted(store):
-        for name, snap in sorted(store[proc].items()):
+        for name, snap in sorted(store[proc].get("metrics", {}).items()):
             for tags, v in snap["values"].items():
                 tag_s = ",".join(f'{k}="{val}"' for k, val in tags)
                 val = v if not isinstance(v, dict) else v.get("count")

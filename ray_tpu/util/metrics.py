@@ -39,6 +39,11 @@ class _Registry:
                     raise ValueError(
                         f"metric {m.name!r} already registered as {type(existing).__name__}"
                     )
+                if isinstance(m, Histogram) and m.boundaries != existing.boundaries:
+                    raise ValueError(
+                        f"histogram {m.name!r} already registered with boundaries "
+                        f"{existing.boundaries}, got {m.boundaries}"
+                    )
                 m._values = existing._values
                 m._lock = existing._lock
                 return
@@ -102,11 +107,15 @@ class Metric:
         return out
 
     def _snapshot(self) -> dict:
+        import copy
+
         with self._lock:
             return {
                 "type": type(self).__name__.lower(),
                 "description": self.description,
-                "values": {_tags_key(dict(k)): v for k, v in self._values.items()},
+                # deep-copy: histogram value dicts must not be mutated after
+                # the lock is released (pickling happens later on the IO thread)
+                "values": {k: copy.deepcopy(v) for k, v in self._values.items()},
             }
 
 
@@ -192,10 +201,13 @@ def export_prometheus() -> str:
 
     flush()
     store = global_worker.request({"t": "get_metrics"})
-    # merge: counters/histograms sum across processes; gauges take last write
+    # merge: counters/histograms sum across processes; gauges take the most
+    # recent process write (push timestamp order)
     merged: Dict[str, dict] = {}
-    for proc in sorted(store):
-        for name, snap in store[proc].items():
+    gauge_ts: Dict[Tuple[str, Tuple], float] = {}
+    for proc in sorted(store, key=lambda p: store[p].get("ts", 0.0)):
+        ts = store[proc].get("ts", 0.0)
+        for name, snap in store[proc].get("metrics", {}).items():
             m = merged.setdefault(
                 name,
                 {
@@ -205,6 +217,9 @@ def export_prometheus() -> str:
                     "values": {},
                 },
             )
+            if snap["type"] != m["type"] or snap.get("boundaries") != m["boundaries"]:
+                # cross-process schema clash: skip rather than crash the export
+                continue
             for tags, v in snap["values"].items():
                 if m["type"] == "histogram":
                     ent = m["values"].setdefault(
@@ -215,8 +230,10 @@ def export_prometheus() -> str:
                     ent["count"] += v["count"]
                 elif m["type"] == "counter":
                     m["values"][tags] = m["values"].get(tags, 0.0) + v
-                else:
-                    m["values"][tags] = v
+                else:  # gauge: most recent push wins
+                    if ts >= gauge_ts.get((name, tags), -1.0):
+                        gauge_ts[(name, tags)] = ts
+                        m["values"][tags] = v
     lines = []
     for name, m in sorted(merged.items()):
         if m["description"]:
