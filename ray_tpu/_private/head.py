@@ -309,6 +309,11 @@ class Head:
         conn.start()
 
     async def _on_conn_closed(self, conn):
+        # prune metric snapshots pushed over this connection (drivers AND
+        # workers); doing it at conn-close means a racing in-flight push
+        # can't resurrect the entry after an earlier prune
+        for proc in getattr(conn, "_metric_procs", ()):
+            self.metrics_store.pop(proc, None)
         for w in list(self.workers.values()):
             if w.conn is conn and w.state != "dead":
                 await self._on_worker_death(w, reason="connection closed")
@@ -790,11 +795,14 @@ class Head:
         return out
 
     async def _h_list_objects(self, conn, msg):
-        limit = msg.get("limit", 1000)
+        limit = msg.get("limit", 1000)  # 0 = all
         out = []
         from .serialization import shm_buffer_names
 
-        for oid, env in list(self.objects.objects.items())[:limit]:
+        items = list(self.objects.objects.items())
+        if limit:
+            items = items[:limit]
+        for oid, env in items:
             try:
                 size = env.total_bytes()
             except Exception:
@@ -854,6 +862,11 @@ class Head:
 
     async def _h_push_metrics(self, conn, msg):
         # snapshots merged per (process, metric); aggregation happens at read
+        if conn.closed:
+            return  # connection already torn down: don't resurrect pruned state
+        if not hasattr(conn, "_metric_procs"):
+            conn._metric_procs = set()
+        conn._metric_procs.add(msg["proc"])
         self.metrics_store[msg["proc"]] = {"ts": time.time(), "metrics": msg["metrics"]}
 
     async def _h_get_metrics(self, conn, msg):
@@ -1086,17 +1099,10 @@ class Head:
         w.proc = subprocess.Popen(argv, env=env, cwd=os.getcwd())
         return w
 
-    def _prune_worker_metrics(self, w: WorkerRecord):
-        """Dead processes must stop contributing to the metric aggregate
-        (stale gauges would otherwise be reported forever)."""
-        if w.proc is not None:
-            self.metrics_store.pop(f"{w.node_id}:pid-{w.proc.pid}", None)
-
     async def _kill_worker(self, w: WorkerRecord, reason: str = ""):
         if w.state == "dead":
             return
         w.state = "dead"
-        self._prune_worker_metrics(w)
         if w.conn is not None:
             await w.conn.close()
         if w.proc is not None and w.proc.poll() is None:
@@ -1110,7 +1116,6 @@ class Head:
     async def _on_worker_death(self, w: WorkerRecord, reason: str):
         was_actor = w.actor_id
         w.state = "dead"
-        self._prune_worker_metrics(w)
         if w.worker_id in self.idle_workers[w.node_id]:
             self.idle_workers[w.node_id].remove(w.worker_id)
         # actor restart path

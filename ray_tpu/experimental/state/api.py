@@ -52,7 +52,8 @@ def list_actors(filters: Optional[List[Filter]] = None, limit: int = 1000) -> Li
 
 
 def list_objects(filters: Optional[List[Filter]] = None, limit: int = 1000) -> List[dict]:
-    return _apply_filters(_request({"t": "list_objects", "limit": limit}), filters)
+    rows = _request({"t": "list_objects", "limit": 0 if filters else limit})
+    return _apply_filters(rows, filters)[:limit]
 
 
 def list_nodes(filters: Optional[List[Filter]] = None) -> List[dict]:

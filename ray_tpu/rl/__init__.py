@@ -20,9 +20,13 @@ actors.
 
 from .algorithm import Algorithm, WorkerSet  # noqa: F401
 from .config import AlgorithmConfig  # noqa: F401
+from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
+from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace  # noqa: F401
 from .learner import Learner, LearnerGroup  # noqa: F401
 from .models import ac_apply, init_ac_params  # noqa: F401
 from .policy import Policy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay_buffer import ReplayBuffer  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
+from .sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401

@@ -19,26 +19,41 @@ from .sample_batch import SampleBatch, concat_samples
 
 
 class WorkerSet:
-    """N remote rollout actors, or one inline local worker when N == 0."""
+    """N remote rollout actors, or one inline local worker when N == 0.
 
-    def __init__(self, config: AlgorithmConfig):
+    `worker_cls`/`worker_kwargs` let algorithms substitute their own
+    sampling actor (DQN epsilon-greedy, SAC continuous) while keeping the
+    fan-out/weight-sync/metrics plumbing (reference: worker_set.py:80 is
+    likewise class-parameterized via cls=RolloutWorker)."""
+
+    def __init__(
+        self,
+        config: AlgorithmConfig,
+        worker_cls=None,
+        worker_kwargs: Optional[Dict[str, Any]] = None,
+    ):
         self.config = config
-        self._local: Optional[RolloutWorker] = None
+        self._local: Optional[Any] = None
         self._remote_workers: List[Any] = []
-        kwargs = dict(
-            env_spec=config.env,
-            num_envs=config.num_envs_per_worker,
-            rollout_fragment_length=config.rollout_fragment_length,
-            gamma=config.gamma,
-            lam=config.lambda_,
-            policy_hidden=tuple(config.model.get("hidden", (64, 64))),
+        worker_cls = worker_cls or RolloutWorker
+        kwargs = (
+            dict(worker_kwargs)
+            if worker_kwargs is not None
+            else dict(
+                env_spec=config.env,
+                num_envs=config.num_envs_per_worker,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma,
+                lam=config.lambda_,
+                policy_hidden=tuple(config.model.get("hidden", (64, 64))),
+            )
         )
         if config.num_rollout_workers == 0:
-            self._local = RolloutWorker(seed=config.seed, **kwargs)
+            self._local = worker_cls(seed=config.seed, **kwargs)
         else:
             import ray_tpu
 
-            cls = ray_tpu.remote(RolloutWorker)
+            cls = ray_tpu.remote(worker_cls)
             self._remote_workers = [
                 cls.options(num_cpus=config.num_cpus_per_worker).remote(
                     seed=config.seed + 1000 * (i + 1), **kwargs
@@ -127,7 +142,9 @@ class Algorithm(Trainable):
     # -- Trainable API --
 
     def setup(self, config: Dict[str, Any]) -> None:
-        self.workers = WorkerSet(self.algo_config)
+        self.workers = WorkerSet(
+            self.algo_config, self._worker_cls(), self._worker_kwargs()
+        )
         self.learner_group = self._build_learner()
         # push initial learner weights so all rollout policies start equal
         self.workers.set_weights(self.learner_group.get_weights())
@@ -162,6 +179,13 @@ class Algorithm(Trainable):
     stop = cleanup
 
     # -- to implement --
+
+    def _worker_cls(self):
+        """Override to use an algorithm-specific sampling actor."""
+        return None
+
+    def _worker_kwargs(self) -> Optional[Dict[str, Any]]:
+        return None
 
     def _build_learner(self):
         raise NotImplementedError

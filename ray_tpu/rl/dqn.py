@@ -1,0 +1,288 @@
+"""DQN: epsilon-greedy rollouts -> replay buffer -> jitted double-Q updates.
+
+Reference parity: rllib/algorithms/dqn/dqn.py (training_step: sample,
+store_to_replay_buffer, sample_from_replay_buffer, train, target-net sync)
+and dqn_torch_policy.py loss. TPU-first: the K gradient steps of one
+training iteration run as ONE jitted lax.scan over presampled minibatches,
+and the target network lives inside the same params pytree (a scan carry),
+so iteration cost is a single dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .config import AlgorithmConfig
+from .learner import Learner, LearnerGroup, TrainState
+from .models import init_q_params, q_apply
+from .replay_buffer import ReplayBuffer
+from .rollout_worker import EnvLoopWorker, _make_env
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.buffer_size: int = 50_000
+        self.learning_starts: int = 1_000
+        self.target_update_freq: int = 500  # gradient steps between syncs
+        self.num_sgd_iter: int = 32  # gradient steps per training iteration
+        self.double_q: bool = True
+        self.epsilon_start: float = 1.0
+        self.epsilon_end: float = 0.05
+        self.epsilon_decay_steps: int = 10_000
+        self.lr = 1e-3
+        self.minibatch_size = 64
+        self.train_batch_size = 512  # env steps collected per iteration
+
+
+class _EpsilonGreedyWorker(EnvLoopWorker):
+    """Sampling actor: steps envs with eps-greedy Q policy, returns raw
+    transitions (reference: rollout side of dqn.py + EpsilonGreedy
+    exploration)."""
+
+    def __init__(
+        self,
+        env_spec,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 64,
+        policy_hidden=(64, 64),
+        seed: int = 0,
+    ):
+        super().__init__(env_spec, num_envs, seed)
+        self.T = rollout_fragment_length
+        self.num_actions = int(self.envs[0].action_space.n)
+        self.params = init_q_params(
+            jax.random.PRNGKey(seed), self.obs_dim, self.num_actions, policy_hidden
+        )
+        self._apply = jax.jit(q_apply)
+        self._rng = np.random.default_rng(seed)
+        self.epsilon = 1.0
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = weights
+
+    def set_epsilon(self, eps: float):
+        self.epsilon = float(eps)
+
+    def sample(self, epsilon: Optional[float] = None) -> SampleBatch:
+        if epsilon is not None:
+            self.epsilon = float(epsilon)
+        E = self.num_envs
+        cols = {
+            OBS: np.empty((self.T, E, self.obs_dim), np.float32),
+            ACTIONS: np.empty((self.T, E), np.int64),
+            REWARDS: np.empty((self.T, E), np.float32),
+            NEXT_OBS: np.empty((self.T, E, self.obs_dim), np.float32),
+            DONES: np.empty((self.T, E), np.float32),
+        }
+        for t in range(self.T):
+            q = np.asarray(jax.device_get(self._apply(self.params, self._obs)))
+            greedy = q.argmax(axis=-1)
+            explore = self._rng.random(E) < self.epsilon
+            actions = np.where(explore, self._rng.integers(0, self.num_actions, E), greedy)
+            cols[OBS][t] = self._obs
+            cols[ACTIONS][t] = actions
+            for e in range(E):
+                rew, term, _trunc, final = self._step_and_track(e, int(actions[e]))
+                cols[REWARDS][t, e] = rew
+                cols[NEXT_OBS][t, e] = final
+                # time-limit truncation is NOT a terminal for bootstrapping
+                cols[DONES][t, e] = float(term)
+        return SampleBatch({k: v.reshape((self.T * E,) + v.shape[2:]) for k, v in cols.items()})
+
+
+class DQNLearner(Learner):
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        hidden=(64, 64),
+        lr: float = 1e-3,
+        gamma: float = 0.99,
+        double_q: bool = True,
+        target_update_freq: int = 500,
+        num_sgd_iter: int = 32,
+        minibatch_size: int = 64,
+        seed: int = 0,
+    ):
+        super().__init__(config=None)
+        self.gamma = gamma
+        self.double_q = double_q
+        self.target_update_freq = target_update_freq
+        self.num_sgd_iter = num_sgd_iter
+        self.minibatch_size = minibatch_size
+        self.optimizer = optax.adam(lr)
+        params = init_q_params(jax.random.PRNGKey(seed), obs_dim, num_actions, hidden)
+        self.state = TrainState(
+            params={"online": params, "target": jax.tree_util.tree_map(jnp.copy, params)},
+            opt_state=self.optimizer.init(params),
+            rng=jax.random.PRNGKey(seed + 1),
+        )
+        self._grad_steps = 0
+        self._update_fn = None
+
+    def loss(self, online, target, mb):
+        q = q_apply(online, mb[OBS])
+        q_sel = jnp.take_along_axis(q, mb[ACTIONS][:, None], axis=-1)[:, 0]
+        q_next_t = q_apply(target, mb[NEXT_OBS])
+        if self.double_q:
+            a_star = jnp.argmax(q_apply(online, mb[NEXT_OBS]), axis=-1)
+            q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=-1)[:, 0]
+        else:
+            q_next = jnp.max(q_next_t, axis=-1)
+        y = mb[REWARDS] + self.gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
+        td = q_sel - y
+        # Huber loss (the reference's default)
+        loss = jnp.mean(jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5))
+        return loss, {"loss": loss, "mean_q": jnp.mean(q_sel), "mean_td": jnp.mean(jnp.abs(td))}
+
+    def _build_update(self):
+        optimizer = self.optimizer
+        loss_fn = self.loss
+
+        def step(carry, mb):
+            online, target, opt_state = carry
+            (_, metrics), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, target, mb), has_aux=True
+            )(online)
+            updates, opt_state = optimizer.update(grads, opt_state, online)
+            online = optax.apply_updates(online, updates)
+            return (online, target, opt_state), metrics
+
+        def update(state: TrainState, minibatches):
+            params = state.params
+            (online, target, opt_state), metrics = jax.lax.scan(
+                step, (params["online"], params["target"], state.opt_state), minibatches
+            )
+            metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+            new_state = TrainState(
+                {"online": online, "target": target}, opt_state, state.rng
+            )
+            return new_state, metrics
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def update(self, buffer: Union[ReplayBuffer, SampleBatch]) -> Dict[str, float]:
+        """Run num_sgd_iter gradient steps on minibatches presampled from
+        the buffer — one compiled dispatch for the whole scan."""
+        if isinstance(buffer, SampleBatch):  # remote-learner path gets a batch
+            mbs = {k: np.asarray(v) for k, v in buffer.items()}
+            n_iter = mbs[OBS].shape[0] // self.minibatch_size
+            minibatches = {
+                k: jnp.asarray(
+                    v[: n_iter * self.minibatch_size].reshape(
+                        (n_iter, self.minibatch_size) + v.shape[1:]
+                    )
+                )
+                for k, v in mbs.items()
+            }
+        else:
+            samples = [buffer.sample(self.minibatch_size) for _ in range(self.num_sgd_iter)]
+            minibatches = {
+                k: jnp.asarray(np.stack([s[k] for s in samples]))
+                for k in samples[0].keys()
+            }
+            n_iter = self.num_sgd_iter
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        self.state, metrics = self._update_fn(self.state, minibatches)
+        self._grad_steps += n_iter
+        if self._grad_steps % self.target_update_freq < n_iter:
+            p = self.state.params
+            self.state = self.state._replace(
+                params={
+                    "online": p["online"],
+                    "target": jax.tree_util.tree_map(jnp.copy, p["online"]),
+                }
+            )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.state.params["online"])
+
+    def set_weights(self, weights):
+        p = dict(self.state.params)
+        p["online"] = jax.device_put(weights)
+        self.state = self.state._replace(params=p)
+
+
+class DQN(Algorithm):
+    _config_class = DQNConfig
+
+    def _worker_cls(self):
+        return _EpsilonGreedyWorker
+
+    def _worker_kwargs(self):
+        cfg = self.algo_config
+        return dict(
+            env_spec=cfg.env,
+            num_envs=cfg.num_envs_per_worker,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            policy_hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+
+    def _build_learner(self) -> LearnerGroup:
+        cfg = self.algo_config
+        env = _make_env(cfg.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+        self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+
+        def factory():
+            return DQNLearner(
+                obs_dim=obs_dim,
+                num_actions=num_actions,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                lr=cfg.lr,
+                gamma=cfg.gamma,
+                double_q=cfg.double_q,
+                target_update_freq=cfg.target_update_freq,
+                num_sgd_iter=cfg.num_sgd_iter,
+                minibatch_size=cfg.minibatch_size,
+                seed=cfg.seed,
+            )
+
+        return LearnerGroup(factory, remote=False)
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._timesteps_total / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        collected = 0
+        while collected < cfg.train_batch_size:
+            if self.workers._local is not None:
+                batch = self.workers._local.sample(eps)
+            else:
+                import ray_tpu
+
+                from .sample_batch import concat_samples
+
+                batch = concat_samples(
+                    ray_tpu.get(
+                        [w.sample.remote(eps) for w in self.workers._remote_workers]
+                    )
+                )
+            self.replay.add(batch)
+            collected += len(batch)
+            self._timesteps_total += len(batch)
+        metrics: Dict[str, Any] = {"epsilon": eps, "replay_size": len(self.replay)}
+        if len(self.replay) >= cfg.learning_starts:
+            metrics.update(self.learner_group._learner.update(self.replay))
+            self.workers.set_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled_this_iter"] = collected
+        return metrics

@@ -54,3 +54,69 @@ def ac_apply(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp
     logits = _mlp(params["pi"], obs)
     value = _mlp(params["vf"], obs)[..., 0]
     return logits, value
+
+
+def _tower_init(rng, dims: Sequence[int], out_scale: float) -> list:
+    layers = []
+    for i in range(len(dims) - 2):
+        rng, sub = jax.random.split(rng)
+        layers.append(_dense_init(sub, dims[i], dims[i + 1], np.sqrt(2)))
+    rng, sub = jax.random.split(rng)
+    layers.append(_dense_init(sub, dims[-2], dims[-1], out_scale))
+    return layers
+
+
+def init_q_params(
+    rng: jax.Array, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)
+) -> Dict[str, Any]:
+    """Discrete Q-network (DQN; reference: rllib dqn_torch_model)."""
+    return {"q": _tower_init(rng, [obs_dim, *hidden, num_actions], 1.0)}
+
+
+def q_apply(params: Dict[str, Any], obs: jnp.ndarray) -> jnp.ndarray:
+    """Returns Q-values [B, A]."""
+    return _mlp(params["q"], obs)
+
+
+def init_sac_params(
+    rng: jax.Array, obs_dim: int, act_dim: int, hidden: Sequence[int] = (256, 256)
+) -> Dict[str, Any]:
+    """Squashed-Gaussian actor + twin Q critics (SAC; reference:
+    rllib/algorithms/sac/sac_torch_model.py)."""
+    r1, r2, r3 = jax.random.split(rng, 3)
+    return {
+        "pi": _tower_init(r1, [obs_dim, *hidden, 2 * act_dim], 0.01),
+        "q1": _tower_init(r2, [obs_dim + act_dim, *hidden, 1], 1.0),
+        "q2": _tower_init(r3, [obs_dim + act_dim, *hidden, 1], 1.0),
+    }
+
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def sac_pi_apply(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (mean [B, A], log_std [B, A]) of the pre-squash Gaussian."""
+    out = _mlp(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def sac_q_apply(params: Dict[str, Any], obs: jnp.ndarray, act: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (q1 [B], q2 [B]) for squashed actions in [-1, 1]."""
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp(params["q1"], x)[..., 0], _mlp(params["q2"], x)[..., 0]
+
+
+def sample_squashed_gaussian(rng, mean, log_std):
+    """Reparameterized tanh-squashed sample; returns (action, logp)."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    # log-prob with tanh change of variables (SAC appendix C)
+    logp = jnp.sum(
+        -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - jnp.log(1.0 - act**2 + 1e-6),
+        axis=-1,
+    )
+    return act, logp

@@ -1,0 +1,52 @@
+"""Uniform replay buffer for off-policy algorithms (DQN/SAC).
+
+Reference parity: rllib/utils/replay_buffers/replay_buffer.py (ring storage,
+uniform sample). Columns are preallocated numpy rings sized at first add, so
+sampling is a single fancy-index per column — no per-transition Python
+objects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = int(capacity)
+        self._cols: Optional[Dict[str, np.ndarray]] = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: SampleBatch) -> None:
+        n = len(batch)
+        if self._cols is None:
+            self._cols = {
+                k: np.empty((self.capacity,) + np.asarray(v).shape[1:], np.asarray(v).dtype)
+                for k, v in batch.items()
+            }
+        end = self._idx + n
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if end <= self.capacity:
+                self._cols[k][self._idx : end] = v
+            else:  # wrap
+                split = self.capacity - self._idx
+                self._cols[k][self._idx :] = v[:split]
+                self._cols[k][: end - self.capacity] = v[split:]
+        self._idx = end % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty replay buffer")
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return SampleBatch({k: v[idx] for k, v in self._cols.items()})

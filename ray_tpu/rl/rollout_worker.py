@@ -37,7 +37,73 @@ def _make_env(env_spec: Union[str, Callable[[], Any]]):
     return gymnasium.make(env_spec)
 
 
-class RolloutWorker:
+class EnvLoopWorker:
+    """Shared env-fleet plumbing for every sampling actor (PPO/IMPALA's
+    RolloutWorker, DQN's epsilon-greedy worker, SAC's continuous worker):
+    env construction, per-env return/length tracking, reset-on-done, and
+    drained episode metrics. Keeping this in ONE place is what keeps
+    episodes_this_iter semantics identical across algorithms."""
+
+    def __init__(self, env_spec: Union[str, Callable[[], Any]], num_envs: int, seed: int):
+        self.envs = [_make_env(env_spec) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.obs_dim = int(np.prod(self.envs[0].observation_space.shape))
+        self._obs = np.stack(
+            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
+        ).astype(np.float32).reshape(num_envs, self.obs_dim)
+        self._episode_returns = np.zeros(num_envs, np.float32)
+        self._episode_lens = np.zeros(num_envs, np.int64)
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+        self._episodes_since_drain = 0
+
+    def ready(self) -> bool:
+        return True
+
+    def _step_and_track(self, e: int, action):
+        """Step env e, track episode stats, reset on episode end.
+        Returns (reward, terminated, truncated, final_obs) where final_obs
+        is the PRE-reset next observation (what off-policy buffers store
+        and truncation bootstrapping evaluates); self._obs[e] is advanced
+        to the post-reset observation."""
+        nobs, rew, terminated, truncated, _ = self.envs[e].step(action)
+        final_obs = np.asarray(nobs, np.float32).reshape(self.obs_dim)
+        self._episode_returns[e] += rew
+        self._episode_lens[e] += 1
+        obs_next = final_obs
+        if terminated or truncated:
+            self._completed_returns.append(float(self._episode_returns[e]))
+            self._completed_lens.append(int(self._episode_lens[e]))
+            self._episodes_since_drain += 1
+            self._episode_returns[e] = 0.0
+            self._episode_lens[e] = 0
+            robs, _ = self.envs[e].reset()
+            obs_next = np.asarray(robs, np.float32).reshape(self.obs_dim)
+        self._obs[e] = obs_next
+        return rew, terminated, truncated, final_obs
+
+    def episode_metrics(self, window: int = 100) -> Dict[str, Any]:
+        """Drain completed-episode stats (rllib metrics.py collect_episodes)."""
+        returns = self._completed_returns[-window:]
+        lens = self._completed_lens[-window:]
+        out = {
+            "episodes_this_iter": self._episodes_since_drain,
+            "episode_reward_mean": float(np.mean(returns)) if returns else float("nan"),
+            "episode_reward_max": float(np.max(returns)) if returns else float("nan"),
+            "episode_reward_min": float(np.min(returns)) if returns else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        }
+        self._completed_returns = self._completed_returns[-window:]
+        self._completed_lens = self._completed_lens[-window:]
+        self._episodes_since_drain = 0
+        return out
+
+    def stop(self) -> None:
+        for env in self.envs:
+            env.close()
+
+
+class RolloutWorker(EnvLoopWorker):
     """One sampling actor; also usable inline (local mode, num_workers=0)."""
 
     def __init__(
@@ -50,27 +116,12 @@ class RolloutWorker:
         seed: int = 0,
         policy_hidden=(64, 64),
     ):
-        self.envs = [_make_env(env_spec) for _ in range(num_envs)]
-        self.num_envs = num_envs
+        super().__init__(env_spec, num_envs, seed)
         self.T = rollout_fragment_length
         self.gamma = gamma
         self.lam = lam
-        obs_space = self.envs[0].observation_space
-        act_space = self.envs[0].action_space
-        self.obs_dim = int(np.prod(obs_space.shape))
-        self.num_actions = int(act_space.n)
+        self.num_actions = int(self.envs[0].action_space.n)
         self.policy = Policy(self.obs_dim, self.num_actions, policy_hidden, seed=seed)
-        self._obs = np.stack(
-            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
-        ).astype(np.float32).reshape(num_envs, self.obs_dim)
-        self._episode_returns = np.zeros(num_envs, np.float32)
-        self._episode_lens = np.zeros(num_envs, np.int64)
-        self._completed_returns: List[float] = []
-        self._completed_lens: List[int] = []
-        self._episodes_since_drain = 0
-
-    def ready(self) -> bool:
-        return True
 
     # -- weight sync (rollout_worker.py get/set_weights) --
 
@@ -103,24 +154,12 @@ class RolloutWorker:
             act_buf[t] = actions
             val_buf[t] = values
             logp_buf[t] = logp
-            for e, env in enumerate(self.envs):
-                nobs, rew, terminated, truncated, _ = env.step(int(actions[e]))
-                nobs = np.asarray(nobs, np.float32).reshape(self.obs_dim)
-                self._episode_returns[e] += rew
-                self._episode_lens[e] += 1
+            for e in range(self.num_envs):
+                rew, terminated, truncated, final = self._step_and_track(e, int(actions[e]))
                 rew_buf[t, e] = rew
                 done_buf[t, e] = float(terminated or truncated)
                 if truncated and not terminated:
-                    truncations.append((t, e, nobs))
-                if terminated or truncated:
-                    self._completed_returns.append(float(self._episode_returns[e]))
-                    self._completed_lens.append(int(self._episode_lens[e]))
-                    self._episodes_since_drain += 1
-                    self._episode_returns[e] = 0.0
-                    self._episode_lens[e] = 0
-                    nobs, _ = env.reset()
-                    nobs = np.asarray(nobs, np.float32).reshape(self.obs_dim)
-                self._obs[e] = nobs
+                    truncations.append((t, e, final))
 
         if truncations:
             # bootstrap through time-limit truncation: fold gamma * V(s_final)
@@ -146,22 +185,48 @@ class RolloutWorker:
             }
         )
 
-    def episode_metrics(self, window: int = 100) -> Dict[str, Any]:
-        """Drain completed-episode stats (rllib metrics.py collect_episodes)."""
-        returns = self._completed_returns[-window:]
-        lens = self._completed_lens[-window:]
-        out = {
-            "episodes_this_iter": self._episodes_since_drain,
-            "episode_reward_mean": float(np.mean(returns)) if returns else float("nan"),
-            "episode_reward_max": float(np.max(returns)) if returns else float("nan"),
-            "episode_reward_min": float(np.min(returns)) if returns else float("nan"),
-            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
-        }
-        self._completed_returns = self._completed_returns[-window:]
-        self._completed_lens = self._completed_lens[-window:]
-        self._episodes_since_drain = 0
-        return out
+    def sample_time_major(self) -> SampleBatch:
+        """Collect T steps from each env, keeping the [T, E] time structure
+        and the behavior-policy logp — the input v-trace needs (IMPALA;
+        reference: rllib impala sample batches keep time_major=True).
 
-    def stop(self) -> None:
-        for env in self.envs:
-            env.close()
+        Columns: obs [T,E,D], actions/rewards/dones/logp [T,E], plus
+        'bootstrap_value' [E] = V(s_T) for the truncated tail.
+        """
+        T, E = self.T, self.num_envs
+        obs_buf = np.empty((T, E, self.obs_dim), np.float32)
+        act_buf = np.empty((T, E), np.int64)
+        rew_buf = np.empty((T, E), np.float32)
+        done_buf = np.empty((T, E), np.float32)
+        logp_buf = np.empty((T, E), np.float32)
+        truncations: List[tuple] = []
+
+        for t in range(T):
+            actions, logp, _values = self.policy.compute_actions(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            for e in range(self.num_envs):
+                rew, terminated, truncated, final = self._step_and_track(e, int(actions[e]))
+                rew_buf[t, e] = rew
+                done_buf[t, e] = float(terminated or truncated)
+                if truncated and not terminated:
+                    truncations.append((t, e, final))
+
+        if truncations:
+            final_obs = np.stack([o for _, _, o in truncations])
+            final_vals = self.policy.compute_values(final_obs)
+            for (t, e, _), v in zip(truncations, final_vals):
+                rew_buf[t, e] += self.gamma * v
+
+        bootstrap = self.policy.compute_values(self._obs) * (1.0 - done_buf[-1])
+        return SampleBatch(
+            {
+                OBS: obs_buf,
+                ACTIONS: act_buf,
+                REWARDS: rew_buf,
+                DONES: done_buf,
+                LOGP: logp_buf,
+                "bootstrap_value": bootstrap,
+            }
+        )

@@ -1,0 +1,291 @@
+"""SAC: continuous control with twin Q critics and entropy auto-tuning.
+
+Reference parity: rllib/algorithms/sac/sac.py + sac_torch_policy.py
+(squashed-Gaussian actor, twin Q, polyak targets, learned alpha). Like DQN
+here, the num_sgd_iter gradient steps of an iteration run as one jitted
+lax.scan; target networks and log_alpha ride in the scan carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .config import AlgorithmConfig
+from .learner import Learner, LearnerGroup, TrainState
+from .models import init_sac_params, sac_pi_apply, sac_q_apply, sample_squashed_gaussian
+from .replay_buffer import ReplayBuffer
+from .rollout_worker import EnvLoopWorker, _make_env
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.buffer_size: int = 100_000
+        self.learning_starts: int = 1_000
+        self.tau: float = 0.005  # polyak coefficient
+        self.num_sgd_iter: int = 32
+        self.initial_alpha: float = 0.1
+        self.target_entropy: Optional[float] = None  # default: -act_dim
+        self.lr = 3e-4
+        self.minibatch_size = 256
+        self.train_batch_size = 256  # env steps per iteration
+        self.model = {"hidden": (256, 256)}
+
+
+class _ContinuousWorker(EnvLoopWorker):
+    """Sampling actor for Box action spaces; actions stored squashed in
+    [-1, 1], scaled to the env's bounds only at step time."""
+
+    def __init__(
+        self,
+        env_spec,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 64,
+        policy_hidden=(256, 256),
+        seed: int = 0,
+    ):
+        super().__init__(env_spec, num_envs, seed)
+        self.T = rollout_fragment_length
+        space = self.envs[0].action_space
+        self.act_dim = int(np.prod(space.shape))
+        self.act_low = np.asarray(space.low, np.float32)
+        self.act_high = np.asarray(space.high, np.float32)
+        self.params = init_sac_params(
+            jax.random.PRNGKey(seed), self.obs_dim, self.act_dim, policy_hidden
+        )
+        self._pi = jax.jit(sac_pi_apply)
+        self._rng = np.random.default_rng(seed)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = weights
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self.act_low + (a + 1.0) * 0.5 * (self.act_high - self.act_low)
+
+    def sample(self) -> SampleBatch:
+        E = self.num_envs
+        cols = {
+            OBS: np.empty((self.T, E, self.obs_dim), np.float32),
+            ACTIONS: np.empty((self.T, E, self.act_dim), np.float32),
+            REWARDS: np.empty((self.T, E), np.float32),
+            NEXT_OBS: np.empty((self.T, E, self.obs_dim), np.float32),
+            DONES: np.empty((self.T, E), np.float32),
+        }
+        for t in range(self.T):
+            mean, log_std = jax.device_get(self._pi(self.params, self._obs))
+            noise = self._rng.standard_normal(mean.shape).astype(np.float32)
+            act = np.tanh(mean + np.exp(log_std) * noise)
+            cols[OBS][t] = self._obs
+            cols[ACTIONS][t] = act
+            for e in range(E):
+                rew, term, _trunc, final = self._step_and_track(e, self._scale(act[e]))
+                cols[REWARDS][t, e] = rew
+                cols[NEXT_OBS][t, e] = final
+                cols[DONES][t, e] = float(term)
+        return SampleBatch(
+            {k: v.reshape((self.T * E,) + v.shape[2:]) for k, v in cols.items()}
+        )
+
+
+class SACLearner(Learner):
+    def __init__(
+        self,
+        obs_dim: int,
+        act_dim: int,
+        hidden=(256, 256),
+        lr: float = 3e-4,
+        gamma: float = 0.99,
+        tau: float = 0.005,
+        initial_alpha: float = 0.1,
+        target_entropy: Optional[float] = None,
+        num_sgd_iter: int = 32,
+        minibatch_size: int = 256,
+        seed: int = 0,
+    ):
+        super().__init__(config=None)
+        self.gamma = gamma
+        self.tau = tau
+        self.num_sgd_iter = num_sgd_iter
+        self.minibatch_size = minibatch_size
+        self.target_entropy = (
+            float(target_entropy) if target_entropy is not None else -float(act_dim)
+        )
+        self.optimizer = optax.adam(lr)
+        nets = init_sac_params(jax.random.PRNGKey(seed), obs_dim, act_dim, hidden)
+        params = {
+            "nets": nets,
+            "target_q": {"q1": jax.tree_util.tree_map(jnp.copy, nets["q1"]),
+                         "q2": jax.tree_util.tree_map(jnp.copy, nets["q2"])},
+            "log_alpha": jnp.asarray(np.log(initial_alpha), jnp.float32),
+        }
+        trainable = {"nets": nets, "log_alpha": params["log_alpha"]}
+        self.state = TrainState(
+            params=params,
+            opt_state=self.optimizer.init(trainable),
+            rng=jax.random.PRNGKey(seed + 1),
+        )
+        self._update_fn = None
+
+    def _losses(self, trainable, target_q, mb, rng):
+        nets = trainable["nets"]
+        alpha = jnp.exp(trainable["log_alpha"])
+        r1, r2 = jax.random.split(rng)
+
+        # -- critic target --
+        mean_n, log_std_n = sac_pi_apply(nets, mb[NEXT_OBS])
+        a_next, logp_next = sample_squashed_gaussian(r1, mean_n, log_std_n)
+        q1t, q2t = sac_q_apply({"q1": target_q["q1"], "q2": target_q["q2"]},
+                               mb[NEXT_OBS], a_next)
+        q_next = jnp.minimum(q1t, q2t) - jax.lax.stop_gradient(alpha) * logp_next
+        y = mb[REWARDS] + self.gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
+
+        q1, q2 = sac_q_apply(nets, mb[OBS], mb[ACTIONS])
+        critic_loss = 0.5 * (jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2))
+
+        # -- actor --
+        mean, log_std = sac_pi_apply(nets, mb[OBS])
+        a_pi, logp_pi = sample_squashed_gaussian(r2, mean, log_std)
+        q1p, q2p = sac_q_apply(jax.lax.stop_gradient(nets), mb[OBS], a_pi)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp_pi - jnp.minimum(q1p, q2p)
+        )
+
+        # -- temperature --
+        alpha_loss = -jnp.mean(
+            trainable["log_alpha"]
+            * jax.lax.stop_gradient(logp_pi + self.target_entropy)
+        )
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "mean_q": jnp.mean(q1),
+            "entropy": -jnp.mean(logp_pi),
+        }
+
+    def _build_update(self):
+        optimizer = self.optimizer
+        tau = self.tau
+        losses = self._losses
+
+        def step(carry, inp):
+            trainable, target_q, opt_state = carry
+            mb, rng = inp
+            (_, metrics), grads = jax.value_and_grad(losses, has_aux=True)(
+                trainable, target_q, mb, rng
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, trainable)
+            trainable = optax.apply_updates(trainable, updates)
+            # polyak target update
+            target_q = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                target_q,
+                {"q1": trainable["nets"]["q1"], "q2": trainable["nets"]["q2"]},
+            )
+            return (trainable, target_q, opt_state), metrics
+
+        def update(state: TrainState, minibatches):
+            p = state.params
+            rng, sub = jax.random.split(state.rng)
+            n = jax.tree_util.tree_leaves(minibatches)[0].shape[0]
+            rngs = jax.random.split(sub, n)
+            trainable = {"nets": p["nets"], "log_alpha": p["log_alpha"]}
+            (trainable, target_q, opt_state), metrics = jax.lax.scan(
+                step, (trainable, p["target_q"], state.opt_state), (minibatches, rngs)
+            )
+            metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+            params = {
+                "nets": trainable["nets"],
+                "target_q": target_q,
+                "log_alpha": trainable["log_alpha"],
+            }
+            return TrainState(params, opt_state, rng), metrics
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def update(self, buffer: ReplayBuffer) -> Dict[str, float]:
+        samples = [buffer.sample(self.minibatch_size) for _ in range(self.num_sgd_iter)]
+        minibatches = {
+            k: jnp.asarray(np.stack([s[k] for s in samples])) for k in samples[0].keys()
+        }
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        self.state, metrics = self._update_fn(self.state, minibatches)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.state.params["nets"])
+
+    def set_weights(self, weights):
+        p = dict(self.state.params)
+        p["nets"] = jax.device_put(weights)
+        self.state = self.state._replace(params=p)
+
+
+class SAC(Algorithm):
+    _config_class = SACConfig
+
+    def _worker_cls(self):
+        return _ContinuousWorker
+
+    def _worker_kwargs(self):
+        cfg = self.algo_config
+        return dict(
+            env_spec=cfg.env,
+            num_envs=cfg.num_envs_per_worker,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            policy_hidden=tuple(cfg.model.get("hidden", (256, 256))),
+        )
+
+    def _build_learner(self) -> LearnerGroup:
+        cfg = self.algo_config
+        env = _make_env(cfg.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(np.prod(env.action_space.shape))
+        env.close()
+        self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+
+        def factory():
+            return SACLearner(
+                obs_dim=obs_dim,
+                act_dim=act_dim,
+                hidden=tuple(cfg.model.get("hidden", (256, 256))),
+                lr=cfg.lr,
+                gamma=cfg.gamma,
+                tau=cfg.tau,
+                initial_alpha=cfg.initial_alpha,
+                target_entropy=cfg.target_entropy,
+                num_sgd_iter=cfg.num_sgd_iter,
+                minibatch_size=cfg.minibatch_size,
+                seed=cfg.seed,
+            )
+
+        return LearnerGroup(factory, remote=False)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        collected = 0
+        while collected < cfg.train_batch_size:
+            batch = self.workers.sample()
+            self.replay.add(batch)
+            collected += len(batch)
+            self._timesteps_total += len(batch)
+        metrics: Dict[str, Any] = {"replay_size": len(self.replay)}
+        if len(self.replay) >= cfg.learning_starts:
+            metrics.update(self.learner_group._learner.update(self.replay))
+            self.workers.set_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled_this_iter"] = collected
+        return metrics

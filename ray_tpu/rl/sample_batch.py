@@ -14,6 +14,7 @@ from typing import Dict, Iterator, List, Sequence
 import numpy as np
 
 OBS = "obs"
+NEXT_OBS = "next_obs"
 ACTIONS = "actions"
 REWARDS = "rewards"
 DONES = "dones"
