@@ -102,6 +102,9 @@ class ActorRecord:
     death_reason: str = ""
     # queued calls submitted while (re)starting
     backlog: List[dict] = field(default_factory=list)
+    # set once the scheduler has reserved node resources for this actor
+    # (autoscaler demand accounting: acquired != unmet)
+    node_acquired: bool = False
     # serializes dep-resolution + send so per-caller submission order is
     # preserved (reference: actor_scheduling_queue.cc sequence numbers)
     send_lock: Optional[asyncio.Lock] = None
@@ -486,11 +489,13 @@ class Head:
 
     async def _start_actor(self, rec: ActorRecord):
         rec.state = "starting"
+        rec.node_acquired = False
         spec = rec.spec
         for oid in spec.get("deps", []):
             await self.objects.wait_available(oid)
         resources = dict(spec.get("resources") or {})
         node_id = await self._acquire_node(resources, spec.get("scheduling_strategy"))
+        rec.node_acquired = True  # stop counting as unmet autoscaler demand
         w = await self._spawn_worker(
             node_id,
             dedicated_actor_id=rec.actor_id,
@@ -729,6 +734,24 @@ class Head:
             if w.node_id == rec.node_id:
                 await self._kill_worker(w, reason="node removed")
         return True
+
+    async def _h_pending_demands(self, conn, msg):
+        """Unfulfilled resource demands: queued tasks + unscheduled actors +
+        pending placement-group bundles (reference: LoadMetrics fed to the
+        autoscaler from GCS resource reports, autoscaler.py:172)."""
+        demands: List[Dict[str, float]] = []
+        for rec in self.pending_queue:
+            demands.append(dict(rec.resources))
+        for a in self.actors.values():
+            if a.state in ("pending", "starting") and not a.node_acquired:
+                res = dict(a.spec.get("resources") or {})
+                if res:  # zero-resource actors place anywhere: no demand
+                    demands.append(res)
+        bundles = []
+        for pg in self.placement_groups.values():
+            if pg.state == "pending":
+                bundles.append([dict(b.resources) for b in pg.bundles])
+        return {"demands": demands, "pg_bundles": bundles}
 
     async def _h_cluster_resources(self, conn, msg):
         total: Dict[str, float] = collections.Counter()

@@ -1,0 +1,23 @@
+"""Autoscaler: demand-driven node scale-up, idle-timeout scale-down.
+
+Reference parity: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update :172,374), resource_demand_scheduler.py
+(get_nodes_to_launch :101 bin-packing), node_provider plugins, and the
+fake_multi_node provider used for tests (node_provider.py:237).
+
+TPU-first: node types are pod-slice shaped — a "node" is a TPU VM host
+carrying a fixed chip count, and slices scale in topology-legal quanta
+(you can't add half a v5e-16), which the TPUPodProvider encodes.
+"""
+
+from .autoscaler import (  # noqa: F401
+    Monitor,
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from .node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+    TPUPodProvider,
+)

@@ -1,0 +1,208 @@
+"""StandardAutoscaler: the update loop + bin-packing demand scheduler.
+
+Reference parity: autoscaler/_private/autoscaler.py (StandardAutoscaler.
+update :172,374 — read load, launch for unmet demand, terminate idle) and
+resource_demand_scheduler.py (get_nodes_to_launch :101,169 — first-fit
+bin-packing of pending demands onto hypothetical nodes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .node_provider import NodeProvider
+
+
+@dataclass
+class NodeTypeConfig:
+    """One scalable node shape (reference: available_node_types YAML)."""
+
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _take(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    """Bin-pack unmet demands onto hypothetical new nodes
+    (reference: resource_demand_scheduler.py:101 get_nodes_to_launch)."""
+
+    def __init__(self, node_types: Dict[str, NodeTypeConfig]):
+        self.node_types = node_types
+
+    def get_nodes_to_launch(
+        self,
+        demands: List[Dict[str, float]],
+        existing_available: List[Dict[str, float]],
+        current_counts: Dict[str, int],
+    ) -> Dict[str, int]:
+        """demands: pending resource requests. existing_available: per-live-
+        node available resources. current_counts: live nodes per type."""
+        virtual = [dict(a) for a in existing_available]
+        to_launch: Dict[str, int] = {}
+        counts = dict(current_counts)
+        # biggest demands first: classic first-fit-decreasing
+        for demand in sorted(demands, key=lambda d: -sum(d.values())):
+            placed = False
+            for slot in virtual:
+                if _fits(slot, demand):
+                    _take(slot, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # need a new node: smallest type that fits the demand
+            candidates = [
+                (sum(cfg.resources.values()), name, cfg)
+                for name, cfg in self.node_types.items()
+                if _fits(cfg.resources, demand)
+                and counts.get(name, 0) < cfg.max_workers
+            ]
+            if not candidates:
+                continue  # infeasible demand: nothing this cluster can do
+            _, name, cfg = min(candidates)
+            counts[name] = counts.get(name, 0) + 1
+            to_launch[name] = to_launch.get(name, 0) + 1
+            slot = dict(cfg.resources)
+            _take(slot, demand)
+            virtual.append(slot)
+        return to_launch
+
+
+class StandardAutoscaler:
+    """Reads pending demand from the head, launches nodes through the
+    provider, terminates nodes idle past the timeout."""
+
+    def __init__(
+        self,
+        provider: NodeProvider,
+        node_types: Dict[str, NodeTypeConfig],
+        idle_timeout_s: float = 60.0,
+        upscaling_speed: float = 1.0,
+    ):
+        self.provider = provider
+        self.node_types = node_types
+        self.scheduler = ResourceDemandScheduler(node_types)
+        self.idle_timeout_s = idle_timeout_s
+        self.upscaling_speed = max(upscaling_speed, 1e-3)
+        self._idle_since: Dict[str, float] = {}
+
+    def _request(self, msg):
+        from .._private.worker import global_worker
+
+        return global_worker.request(msg)
+
+    def update(self) -> Dict[str, int]:
+        """One reconciliation pass; returns {launched: n, terminated: n}."""
+        load = self._request({"t": "pending_demands"})
+        demands: List[Dict[str, float]] = list(load["demands"])
+        for bundle_set in load["pg_bundles"]:
+            demands.extend(bundle_set)
+        nodes = self._request({"t": "nodes"})
+        by_id = {n["node_id"]: n for n in nodes}
+
+        managed = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for nid in managed:
+            t = self.provider.node_type_of(nid)
+            counts[t] = counts.get(t, 0) + 1
+
+        launched = 0
+        # min_workers floor
+        for name, cfg in self.node_types.items():
+            while counts.get(name, 0) < cfg.min_workers:
+                self.provider.create_node(name, dict(cfg.resources))
+                counts[name] = counts.get(name, 0) + 1
+                launched += 1
+
+        if demands:
+            existing_avail = [
+                dict(n.get("available", {})) for n in nodes if n.get("alive", True)
+            ]
+            plan = self.scheduler.get_nodes_to_launch(demands, existing_avail, counts)
+            # one launch budget for the whole tick, shared across node types
+            budget = max(1, int(self.upscaling_speed * max(1, len(managed))))
+            for name, n in plan.items():
+                for _ in range(n):
+                    if budget <= 0:
+                        break
+                    self.provider.create_node(name, dict(self.node_types[name].resources))
+                    counts[name] = counts.get(name, 0) + 1
+                    launched += 1
+                    budget -= 1
+
+        # idle scale-down: a managed node is idle when its available ==
+        # total resources AND it hosts no live actor/busy worker (a
+        # zero-resource actor consumes nothing but must not be killed)
+        workers = self._request({"t": "list_workers"})
+        occupied = {
+            w["node_id"]
+            for w in workers
+            if w["state"] in ("actor", "busy", "starting")
+        }
+        terminated = 0
+        now = time.monotonic()
+        for nid in list(managed):
+            info = by_id.get(nid)
+            if info is None or not info.get("alive", True):
+                self._idle_since.pop(nid, None)
+                continue
+            total, avail = info.get("resources", {}), info.get("available", {})
+            idle = nid not in occupied and all(
+                abs(avail.get(k, 0.0) - v) < 1e-9 for k, v in total.items()
+            )
+            # a pending demand only protects nodes that could actually serve
+            # it — an infeasible demand must not pin idle nodes forever
+            wanted = any(_fits(total, d) for d in demands)
+            if not idle or wanted:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            name = self.provider.node_type_of(nid)
+            floor = self.node_types.get(name, NodeTypeConfig({})).min_workers
+            if now - first >= self.idle_timeout_s and counts.get(name, 0) > floor:
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                counts[name] = counts.get(name, 0) - 1
+                terminated += 1
+        return {"launched": launched, "terminated": terminated}
+
+
+class Monitor:
+    """Background thread driving StandardAutoscaler.update (reference:
+    monitor.py:126 — the head-side process hosting the autoscaler)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler, interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True, name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass  # autoscaling must not kill the driver; retry next tick
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

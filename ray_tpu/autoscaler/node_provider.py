@@ -1,0 +1,118 @@
+"""Node providers: how the autoscaler actually adds/removes capacity.
+
+Reference parity: python/ray/autoscaler/node_provider.py (the interface
+every cloud implements) + _private/fake_multi_node/node_provider.py:237
+(FakeMultiNodeProvider — in-process nodes for tests) + the TPU wiring in
+autoscaler/_private/gcp/node_provider.py (GCPTPU, SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional
+
+_fake_counter = itertools.count(1)
+
+
+class NodeProvider:
+    """Minimal provider surface (reference: node_provider.py)."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Adds real in-process nodes to the running head (the moral equivalent
+    of the reference's fake_multi_node provider: full scheduling fidelity,
+    zero cloud)."""
+
+    def __init__(self):
+        self._nodes: Dict[str, str] = {}  # node_id -> node_type
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        from .._private.worker import global_worker
+
+        node_id = f"autoscaled-{node_type}-{next(_fake_counter)}"
+        global_worker.request(
+            {
+                "t": "add_node",
+                "node_id": node_id,
+                "resources": dict(resources),
+                "labels": {"autoscaled": "1", "node_type": node_type},
+            }
+        )
+        self._nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        from .._private.worker import global_worker
+
+        if node_id in self._nodes:
+            global_worker.request({"t": "remove_node", "node_id": node_id})
+            del self._nodes[node_id]
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        return self._nodes.get(node_id)
+
+
+# chips per host for the standard pod-slice accelerator types
+TPU_SLICE_TOPOLOGIES: Dict[str, Dict[str, float]] = {
+    "v4-8": {"TPU": 4.0, "CPU": 120.0},
+    "v5e-4": {"TPU": 4.0, "CPU": 112.0},
+    "v5e-8": {"TPU": 8.0, "CPU": 224.0},
+    "v5p-8": {"TPU": 4.0, "CPU": 208.0},
+}
+
+
+class TPUPodProvider(NodeProvider):
+    """TPU-VM provider shell: knows slice topologies (scale quanta) but
+    delegates actual provisioning to an injected launcher — cloud APIs are
+    deployment-specific (reference: gcp/node_provider.py GCPTPU wiring).
+
+    launch_fn(node_type, resources) -> node_id;
+    terminate_fn(node_id) -> None.
+    """
+
+    def __init__(
+        self,
+        launch_fn: Optional[Callable[[str, Dict[str, float]], str]] = None,
+        terminate_fn: Optional[Callable[[str], None]] = None,
+    ):
+        self._launch_fn = launch_fn
+        self._terminate_fn = terminate_fn
+        self._nodes: Dict[str, str] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        if self._launch_fn is None:
+            raise RuntimeError(
+                "TPUPodProvider needs a launch_fn wired to your TPU VM "
+                "provisioning API (gcloud/queued resources)"
+            )
+        merged = dict(TPU_SLICE_TOPOLOGIES.get(node_type, {}))
+        merged.update(resources)
+        node_id = self._launch_fn(node_type, merged)
+        self._nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        if self._terminate_fn is not None and node_id in self._nodes:
+            self._terminate_fn(node_id)
+            del self._nodes[node_id]
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        return self._nodes.get(node_id)

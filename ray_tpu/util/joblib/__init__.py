@@ -1,0 +1,18 @@
+"""Joblib backend over ray_tpu actors.
+
+Reference parity: python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend): after `register_ray()`,
+`joblib.parallel_backend("ray")` routes scikit-learn/joblib work through
+the cluster's multiprocessing Pool shim.
+"""
+
+from __future__ import annotations
+
+
+def register_ray() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    from ._backend import RayTpuBackend
+
+    register_parallel_backend("ray", RayTpuBackend)
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,44 @@
+"""Joblib ParallelBackend running batches on pool actors (reference:
+ray/util/joblib/ray_backend.py — MultiprocessingBackend subclass whose
+pool is actor-backed)."""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import MultiprocessingBackend
+
+from ..multiprocessing import Pool
+
+
+class RayTpuBackend(MultiprocessingBackend):
+    supports_timeout = True
+
+    def effective_n_jobs(self, n_jobs):
+        import os
+
+        if n_jobs == 1:
+            return 1
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            total = int(ray_tpu.cluster_resources().get("CPU", os.cpu_count() or 1))
+        else:
+            total = os.cpu_count() or 1
+        if n_jobs is None:
+            return total
+        if n_jobs < 0:  # joblib convention: -1 = all, -2 = all minus one, ...
+            return max(1, total + 1 + n_jobs)
+        return n_jobs
+
+    def configure(self, n_jobs=1, parallel=None, prefer=None, require=None, **kwargs):
+        n_jobs = self.effective_n_jobs(n_jobs)
+        self._pool = Pool(processes=n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def _get_pool(self):
+        return self._pool
+
+    def terminate(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.terminate()
+            self._pool = None

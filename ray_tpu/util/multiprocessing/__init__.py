@@ -1,0 +1,182 @@
+"""Drop-in multiprocessing.Pool over cluster actors.
+
+Reference parity: python/ray/util/multiprocessing/pool.py (Pool whose
+workers are actors, so `map` fans out across the cluster instead of local
+forks). Supported surface: apply/apply_async/map/map_async/starmap/
+imap/imap_unordered/close/terminate/join + context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class _PoolWorker:
+    def run(self, fn, args, kwargs):
+        return fn(*args, **(kwargs or {}))
+
+    def run_batch(self, fn, chunk):
+        return [fn(*args) for args in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, ray_remote_args: Optional[dict] = None):
+        import os
+
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._n = processes or os.cpu_count() or 4
+        cls = ray_tpu.remote(_PoolWorker)
+        if ray_remote_args:
+            cls = cls.options(**ray_remote_args)
+        self._workers = [cls.remote() for _ in range(self._n)]
+        self._rr = itertools.cycle(range(self._n))
+        self._closed = False
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _submit(self, fn, args, kwargs=None):
+        w = self._workers[next(self._rr)]
+        return w.run.remote(fn, tuple(args), kwargs or {})
+
+    # -- apply --
+
+    def apply(self, fn: Callable, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(
+        self, fn: Callable, args=(), kwds=None, callback=None, error_callback=None
+    ) -> AsyncResult:
+        self._check()
+        result = AsyncResult([self._submit(fn, args, kwds)], single=True)
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def _notify():
+                try:
+                    value = result.get()
+                except Exception as e:  # noqa: BLE001 - forwarded to error_callback
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(value)
+
+            threading.Thread(target=_notify, daemon=True).start()
+        return result
+
+    # -- map family --
+
+    def _starmap_refs(self, fn, items: List[tuple], chunksize: Optional[int]):
+        self._check()
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        refs = []
+        for i in range(0, len(items), chunksize):
+            w = self._workers[next(self._rr)]
+            refs.append(w.run_batch.remote(fn, items[i : i + chunksize]))
+        return refs
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize: Optional[int] = None) -> List[Any]:
+        import ray_tpu
+
+        items = [(x,) for x in iterable]
+        chunks = ray_tpu.get(self._starmap_refs(fn, items, chunksize))
+        return [x for chunk in chunks for x in chunk]
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        refs = self._starmap_refs(fn, [(x,) for x in iterable], chunksize)
+        return _FlatAsyncResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple], chunksize=None) -> List[Any]:
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._starmap_refs(fn, list(iterable), chunksize))
+        return [x for chunk in chunks for x in chunk]
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        import ray_tpu
+
+        refs = self._starmap_refs(fn, [(x,) for x in iterable], chunksize)
+        for ref in refs:  # ordered
+            for x in ray_tpu.get(ref):
+                yield x
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        import ray_tpu
+
+        pending = list(self._starmap_refs(fn, [(x,) for x in iterable], chunksize))
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:  # wait may surface several completions at once
+                for x in ray_tpu.get(ref):
+                    yield x
+
+    # -- lifecycle --
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        import ray_tpu
+
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False
+
+
+class _FlatAsyncResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for chunk in chunks for x in chunk]

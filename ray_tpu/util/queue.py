@@ -1,0 +1,111 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference parity: python/ray/util/queue.py (Queue on a _QueueActor;
+blocking semantics via polling, Empty/Full exceptions re-exported).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from queue import Empty, Full  # re-export the stdlib exception types
+from typing import Any, List, Optional
+
+_POLL_S = 0.01
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.items: collections.deque = collections.deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get(self):
+        if not self.items:
+            return (False, None)
+        return (True, self.items.popleft())
+
+    def put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing (matching the reference's capacity pre-check) so a
+        caller can retry a rejected batch without duplicating items."""
+        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
+            return False
+        self.items.extend(items)
+        return True
+
+
+class Queue:
+    """A FIFO queue usable from any driver/task/actor in the cluster."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        import ray_tpu
+
+        self.maxsize = maxsize
+        cls = ray_tpu.remote(_QueueActor)
+        if actor_options:
+            cls = cls.options(**actor_options)
+        self._actor = cls.remote(maxsize)
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full
+            time.sleep(_POLL_S)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty
+            time.sleep(_POLL_S)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        import ray_tpu
+
+        items = list(items)
+        if not ray_tpu.get(self._actor.put_batch.remote(items)):
+            raise Full(f"batch of {len(items)} does not fit (maxsize={self.maxsize})")
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        ray_tpu.kill(self._actor)

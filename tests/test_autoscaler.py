@@ -1,0 +1,180 @@
+"""Autoscaler tests (reference: autoscaler/_private tests + fake_multi_node
+fixtures, SURVEY §4.1/§5.5)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeMultiNodeProvider,
+    Monitor,
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+    TPUPodProvider,
+)
+
+
+class TestDemandScheduler:
+    def setup_method(self):
+        self.sched = ResourceDemandScheduler(
+            {
+                "cpu4": NodeTypeConfig({"CPU": 4.0}, max_workers=5),
+                "tpu8": NodeTypeConfig({"CPU": 8.0, "TPU": 8.0}, max_workers=2),
+            }
+        )
+
+    def test_packs_onto_existing(self):
+        plan = self.sched.get_nodes_to_launch(
+            [{"CPU": 1.0}] * 3, existing_available=[{"CPU": 4.0}], current_counts={}
+        )
+        assert plan == {}
+
+    def test_launches_smallest_fitting_type(self):
+        plan = self.sched.get_nodes_to_launch(
+            [{"CPU": 2.0}] * 4, existing_available=[], current_counts={}
+        )
+        assert plan == {"cpu4": 2}
+        plan = self.sched.get_nodes_to_launch(
+            [{"TPU": 8.0}], existing_available=[], current_counts={}
+        )
+        assert plan == {"tpu8": 1}
+
+    def test_respects_max_workers(self):
+        plan = self.sched.get_nodes_to_launch(
+            [{"TPU": 8.0}] * 5, existing_available=[], current_counts={}
+        )
+        assert plan == {"tpu8": 2}
+
+    def test_infeasible_demand_skipped(self):
+        plan = self.sched.get_nodes_to_launch(
+            [{"GPU": 1.0}], existing_available=[], current_counts={}
+        )
+        assert plan == {}
+
+
+def test_scale_up_unblocks_tasks(ray_start_regular):
+    # head has 4 CPUs; demand 6 concurrent 1-CPU slots via an 8-CPU ask
+    provider = FakeMultiNodeProvider()
+    scaler = StandardAutoscaler(
+        provider,
+        {"cpu4": NodeTypeConfig({"CPU": 4.0}, max_workers=4)},
+        idle_timeout_s=9999,
+    )
+
+    @ray_tpu.remote(num_cpus=4)
+    def big(x):
+        time.sleep(1.5)
+        return x * 2
+
+    # two 4-CPU tasks can't run together on a 4-CPU head
+    refs = [big.remote(i) for i in range(3)]
+    time.sleep(0.3)  # let them queue
+    result = scaler.update()
+    assert result["launched"] >= 1
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 2, 4]
+
+
+def test_min_workers_floor_and_idle_scale_down(ray_start_regular):
+    provider = FakeMultiNodeProvider()
+    scaler = StandardAutoscaler(
+        provider,
+        {"cpu2": NodeTypeConfig({"CPU": 2.0}, min_workers=1, max_workers=3)},
+        idle_timeout_s=0.3,
+    )
+    r1 = scaler.update()
+    assert r1["launched"] == 1  # min_workers floor
+    # grow beyond the floor
+    provider.create_node("cpu2", {"CPU": 2.0})
+    assert len(provider.non_terminated_nodes()) == 2
+    time.sleep(0.4)
+    scaler.update()  # marks idle
+    time.sleep(0.4)
+    r3 = scaler.update()
+    # scale down to the floor but never below it
+    total_term = r3["terminated"]
+    time.sleep(0.4)
+    total_term += scaler.update()["terminated"]
+    assert total_term == 1
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_zero_resource_actor_blocks_scale_down(ray_start_regular):
+    provider = FakeMultiNodeProvider()
+    scaler = StandardAutoscaler(
+        provider, {"cpu2": NodeTypeConfig({"CPU": 2.0}, max_workers=2)}, idle_timeout_s=0.2
+    )
+    nid = provider.create_node("cpu2", {"CPU": 2.0})
+
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(num_cpus=0)
+    class Pinned:
+        def ping(self):
+            return "up"
+
+    a = Pinned.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=nid, soft=False)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote()) == "up"
+    time.sleep(0.4)
+    scaler.update()
+    time.sleep(0.4)
+    r = scaler.update()
+    # the zero-resource actor must keep its node alive
+    assert r["terminated"] == 0
+    assert nid in provider.non_terminated_nodes()
+    assert ray_tpu.get(a.ping.remote()) == "up"
+
+
+def test_infeasible_demand_does_not_pin_idle_nodes(ray_start_regular):
+    provider = FakeMultiNodeProvider()
+    scaler = StandardAutoscaler(
+        provider, {"cpu2": NodeTypeConfig({"CPU": 2.0}, max_workers=2)}, idle_timeout_s=0.2
+    )
+    provider.create_node("cpu2", {"CPU": 2.0})
+
+    @ray_tpu.remote(resources={"GPU": 1.0})
+    def impossible():
+        return 1
+
+    _ref = impossible.remote()  # queues forever: no GPU anywhere
+    time.sleep(0.3)
+    scaler.update()
+    time.sleep(0.3)
+    total = scaler.update()["terminated"]
+    time.sleep(0.3)
+    total += scaler.update()["terminated"]
+    assert total == 1  # idle node terminated despite the pending GPU ask
+    assert provider.non_terminated_nodes() == []
+
+
+def test_monitor_thread(ray_start_regular):
+    provider = FakeMultiNodeProvider()
+    scaler = StandardAutoscaler(
+        provider, {"cpu1": NodeTypeConfig({"CPU": 1.0}, min_workers=1)}, idle_timeout_s=9999
+    )
+    mon = Monitor(scaler, interval_s=0.1).start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not provider.non_terminated_nodes():
+            time.sleep(0.05)
+        assert provider.non_terminated_nodes()
+    finally:
+        mon.stop()
+
+
+def test_tpu_pod_provider_stub():
+    launched = []
+    provider = TPUPodProvider(
+        launch_fn=lambda t, r: (launched.append((t, r)) or f"tpu-{len(launched)}"),
+        terminate_fn=lambda nid: None,
+    )
+    nid = provider.create_node("v5e-8", {})
+    assert launched[0][1]["TPU"] == 8.0
+    assert provider.node_type_of(nid) == "v5e-8"
+    provider.terminate_node(nid)
+    assert provider.non_terminated_nodes() == []
+    with pytest.raises(RuntimeError, match="launch_fn"):
+        TPUPodProvider().create_node("v5e-8", {})

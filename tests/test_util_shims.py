@@ -1,0 +1,108 @@
+"""Queue / multiprocessing Pool / joblib backend shims
+(reference: python/ray/util/{queue,multiprocessing,joblib})."""
+
+import queue as stdlib_queue
+
+import pytest
+
+import ray_tpu
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_queue_fifo_and_blocking(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    assert stdlib_queue.Empty is Empty  # exception types interoperate
+
+
+def test_queue_across_actors(ray_start_regular):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5))
+    assert [q.get(timeout=10) for _ in range(5)] == [0, 1, 2, 3, 4]
+
+
+def test_pool_map_family(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(8)) == [x * x for x in range(8)]
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(_add, (5, 6)) == 11
+        r = pool.apply_async(_square, (9,))
+        assert r.get(timeout=30) == 81
+        assert list(pool.imap(_square, range(5), chunksize=2)) == [0, 1, 4, 9, 16]
+        assert sorted(pool.imap_unordered(_square, range(5), chunksize=2)) == [0, 1, 4, 9, 16]
+
+
+def test_pool_lifecycle(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool(processes=2)
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(_square, [1])
+    pool.join()
+    pool.terminate()
+
+
+def test_queue_batch_all_or_nothing(ray_start_regular):
+    from ray_tpu.util.queue import Full, Queue
+
+    q = Queue(maxsize=3)
+    q.put(0)
+    with pytest.raises(Full):
+        q.put_nowait_batch([1, 2, 3])  # would exceed: must enqueue NOTHING
+    assert q.qsize() == 1
+    q.put_nowait_batch([1, 2])
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+
+
+def test_joblib_negative_n_jobs(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=-2):
+        out = joblib.Parallel()(joblib.delayed(_square)(i) for i in range(3))
+    assert out == [0, 1, 4]
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_square)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
