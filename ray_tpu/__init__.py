@@ -52,18 +52,50 @@ def init(
     _system_config: Optional[Dict[str, Any]] = None,
     **_kwargs,
 ):
-    """Start (or connect to) a ray_tpu runtime."""
+    """Start (or connect to) a ray_tpu runtime.
+
+    address=None starts a new local runtime; address="auto" (or the
+    RAY_TPU_ADDRESS env var, set for submitted jobs) attaches to a running
+    head's socket as an additional driver (reference: worker.py:1186
+    address resolution)."""
+    import os as _os
+
     if global_worker.connected:
         if ignore_reinit_error:
             return _ctx()
         raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
     if _system_config:
         GLOBAL_CONFIG.apply(_system_config)
+    address = address or _os.environ.get("RAY_TPU_ADDRESS")
+    if address:
+        socket_path = _resolve_address(address)
+        global_worker.connect_existing(socket_path, namespace=namespace)
+        return _ctx()
     from ._private.node import Node, default_resources
 
     node = Node(default_resources(num_cpus, num_tpus, resources))
     global_worker.connect_driver(node, namespace=namespace)
     return _ctx()
+
+
+def _resolve_address(address: str) -> str:
+    import glob as _glob
+    import os as _os
+
+    if address != "auto":
+        return address  # an explicit head socket path
+    # 'auto' prefers the cluster that spawned us (jobs get the exact socket)
+    if _os.environ.get("RAY_TPU_ADDRESS"):
+        return _os.environ["RAY_TPU_ADDRESS"]
+    candidates = sorted(
+        _glob.glob(_os.path.join(GLOBAL_CONFIG.session_dir_root, "session_*", "head.sock")),
+        key=_os.path.getmtime,
+    )
+    if not candidates:
+        raise ConnectionError(
+            f"address='auto' but no live session under {GLOBAL_CONFIG.session_dir_root}"
+        )
+    return candidates[-1]
 
 
 def _ctx():

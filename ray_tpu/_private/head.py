@@ -32,6 +32,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -241,6 +242,8 @@ class Head:
         self._shm_tried = False
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
         self.metrics_store: Dict[str, dict] = {}
+        # submitted jobs: submission_id -> record (entrypoint subprocess)
+        self.jobs: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -273,6 +276,10 @@ class Head:
 
     async def stop(self):
         self._shutdown = True
+        for job in self.jobs.values():
+            if job["status"] == "RUNNING":
+                job["status"] = "STOPPED"
+                self._terminate_job_proc(job["proc"])
         for w in list(self.workers.values()):
             await self._kill_worker(w, reason="shutdown")
         if self.server is not None:
@@ -883,6 +890,129 @@ class Head:
             )
         return events
 
+    # ------------------------------------------------------------------
+    # job submission (reference: dashboard/modules/job/job_manager.py —
+    # JobSupervisor subprocess per submission; collapsed onto the head)
+    # ------------------------------------------------------------------
+
+    async def _h_submit_job(self, conn, msg):
+        import uuid as _uuid
+
+        sid = msg.get("submission_id") or f"raysubmit_{_uuid.uuid4().hex[:16]}"
+        if sid in self.jobs:
+            raise ValueError(f"submission_id {sid!r} already exists")
+        runtime_env = msg.get("runtime_env") or {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"job-{sid}.log")
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = self.socket_path
+        env["RAY_TPU_SUBMISSION_ID"] = sid
+        if runtime_env:
+            # the job's runtime_env (env_vars included) is the DEFAULT for
+            # every task/actor the job driver submits (reference: job-level
+            # runtime_env semantics)
+            import json as _json
+
+            env["RAY_TPU_JOB_RUNTIME_ENV"] = _json.dumps(dict(runtime_env))
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            env[k] = str(v)
+        # the job runs a fresh interpreter: the cluster's code (this package)
+        # must stay importable, MERGED with any user-supplied PYTHONPATH
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        cwd = os.getcwd()
+        if runtime_env.get("working_dir"):
+            cwd = await asyncio.get_running_loop().run_in_executor(
+                None, self._stage_dir, runtime_env["working_dir"]
+            )
+            env["PYTHONPATH"] = cwd + os.pathsep + env["PYTHONPATH"]
+        logf = open(log_path, "ab")
+        # own session/process group: stop_job must reach grandchildren of the
+        # shell (compound entrypoints), not just /bin/sh
+        proc = subprocess.Popen(
+            msg["entrypoint"],
+            shell=True,
+            env=env,
+            cwd=cwd,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        self.jobs[sid] = {
+            "submission_id": sid,
+            "entrypoint": msg["entrypoint"],
+            "status": "RUNNING",
+            "proc": proc,
+            "log_path": log_path,
+            "start_time": time.time(),
+            "end_time": None,
+            "metadata": msg.get("metadata") or {},
+        }
+        asyncio.get_running_loop().create_task(self._watch_job(sid))
+        return sid
+
+    async def _watch_job(self, sid: str):
+        job = self.jobs[sid]
+        code = await asyncio.get_running_loop().run_in_executor(None, job["proc"].wait)
+        if job["status"] == "STOPPED":
+            pass  # stop_job already settled it
+        else:
+            job["status"] = "SUCCEEDED" if code == 0 else "FAILED"
+        job["end_time"] = time.time()
+        job["exit_code"] = code
+
+    def _job_view(self, job: dict) -> dict:
+        return {k: v for k, v in job.items() if k != "proc"}
+
+    async def _h_job_status(self, conn, msg):
+        job = self.jobs.get(msg["submission_id"])
+        if job is None:
+            raise ValueError(f"no such job {msg['submission_id']!r}")
+        return job["status"]
+
+    async def _h_job_info(self, conn, msg):
+        job = self.jobs.get(msg["submission_id"])
+        if job is None:
+            raise ValueError(f"no such job {msg['submission_id']!r}")
+        return self._job_view(job)
+
+    async def _h_list_jobs(self, conn, msg):
+        return [self._job_view(j) for j in self.jobs.values()]
+
+    async def _h_job_logs(self, conn, msg):
+        job = self.jobs.get(msg["submission_id"])
+        if job is None:
+            raise ValueError(f"no such job {msg['submission_id']!r}")
+        try:
+            with open(job["log_path"], "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    async def _h_stop_job(self, conn, msg):
+        job = self.jobs.get(msg["submission_id"])
+        if job is None:
+            raise ValueError(f"no such job {msg['submission_id']!r}")
+        if job["status"] == "RUNNING":
+            job["status"] = "STOPPED"
+            self._terminate_job_proc(job["proc"])
+        return True
+
+    @staticmethod
+    def _terminate_job_proc(proc):
+        import signal
+
+        try:  # whole process group (start_new_session at spawn)
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+
     async def _h_push_metrics(self, conn, msg):
         # snapshots merged per (process, metric); aggregation happens at read
         if conn.closed:
@@ -1102,6 +1232,33 @@ class Head:
         user_env_vars = (runtime_env or {}).get("env_vars") or {}
         for k, v in user_env_vars.items():
             env[k] = str(v)
+        # working_dir / py_modules: stage into the session dir (content-hash
+        # cached) and point the worker at the staged copies (reference:
+        # _private/runtime_env/working_dir.py + the per-node runtime-env
+        # agent, runtime_env_agent.py:161 — collapsed into spawn here)
+        cwd = os.getcwd()
+        extra_paths = []
+        if runtime_env:
+            loop = asyncio.get_running_loop()
+            if runtime_env.get("working_dir"):
+                # stage off-loop: a large copy must not stall cluster RPC
+                cwd = await loop.run_in_executor(
+                    None, self._stage_dir, runtime_env["working_dir"]
+                )
+                extra_paths.append(cwd)
+            for mod in runtime_env.get("py_modules") or []:
+                staged = await loop.run_in_executor(None, self._stage_dir, mod)
+                # a staged single-file module is importable via its parent
+                extra_paths.append(staged if os.path.isdir(staged) else os.path.dirname(staged))
+        if extra_paths:
+            # workers run -S, so PYTHONPATH must carry the full driver
+            # sys.path (site-packages included), with staged dirs first and
+            # any user-specified PYTHONPATH in between
+            parts = list(extra_paths)
+            if "PYTHONPATH" in user_env_vars:
+                parts.append(env["PYTHONPATH"])
+            parts.extend(p for p in sys.path if p)
+            env["PYTHONPATH"] = os.pathsep.join(parts)
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         if needs_tpu:
             # TPU workers get the full interpreter (site hooks may register
@@ -1116,11 +1273,51 @@ class Head:
             # driver's sys.path instead.
             if "JAX_PLATFORMS" not in user_env_vars:
                 env["JAX_PLATFORMS"] = "cpu"
-            if "PYTHONPATH" not in user_env_vars:
+            if "PYTHONPATH" not in user_env_vars and not extra_paths:
                 env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
             argv.insert(1, "-S")
-        w.proc = subprocess.Popen(argv, env=env, cwd=os.getcwd())
+        w.proc = subprocess.Popen(argv, env=env, cwd=cwd)
         return w
+
+    def _stage_dir(self, src: str) -> str:
+        """Copy a working_dir/py_module into the session dir, keyed by a
+        cheap content signature so identical envs share one copy."""
+        import hashlib
+        import shutil
+
+        h = hashlib.sha1(src.encode())
+        for root, _dirs, files in os.walk(src):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                try:
+                    st = os.stat(p)
+                    h.update(f"{os.path.relpath(p, src)}:{st.st_size}:{st.st_mtime_ns}".encode())
+                except OSError:
+                    continue
+        if os.path.isfile(src):
+            st = os.stat(src)
+            h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+        dest = os.path.join(
+            self.session_dir, "runtime_resources", h.hexdigest()[:16], os.path.basename(src)
+        )
+        if not os.path.exists(dest):
+            # stage to a temp path then atomically rename: concurrent stages
+            # of the same content (off-loop executor threads) never expose a
+            # half-copied tree
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
+            try:
+                if os.path.isdir(src):
+                    shutil.copytree(src, tmp)
+                else:
+                    shutil.copy2(src, tmp)
+                os.rename(tmp, dest)
+            except OSError:
+                if not os.path.exists(dest):
+                    raise
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        return dest
 
     async def _kill_worker(self, w: WorkerRecord, reason: str = ""):
         if w.state == "dead":

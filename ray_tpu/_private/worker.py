@@ -80,6 +80,9 @@ class Worker:
         self.current_actor_id: Optional[str] = None
         self.current_task_id: Optional[str] = None
         self.namespace: str = ""
+        # job-level default runtime_env (tasks/actors inherit it when they
+        # don't specify their own)
+        self.default_runtime_env: Optional[dict] = None
         self._lock = threading.RLock()
         self._shm = None
         self._shm_tried = False
@@ -117,6 +120,36 @@ class Worker:
         self.conn = self.io.run(self._open_conn(node.socket_path))
         info = self.request({"t": "register_driver"})
         self.node_id = info["node_id"]
+        self.connected = True
+
+    def connect_existing(self, socket_path: str, namespace: str = ""):
+        """Attach as an ADDITIONAL driver to a running head (the job-
+        submission / `ray.init(address="auto")` path — reference:
+        worker.py:1186 address resolution). Owns its own IO thread; the
+        head outlives this client."""
+        import os
+
+        self.mode = MODE_DRIVER
+        self._fn_exported.clear()
+        if self._shm is not None:
+            try:
+                self._shm.disconnect()
+            except Exception:
+                pass
+        self._shm = None
+        self._shm_tried = False
+        self.node = None
+        self.io = EventLoopThread()
+        self._owns_io = True
+        self.session_dir = os.path.dirname(socket_path)
+        self.namespace = namespace
+        self.conn = self.io.run(self._open_conn(socket_path))
+        info = self.request({"t": "register_driver"})
+        self.node_id = info["node_id"]
+        if os.environ.get("RAY_TPU_JOB_RUNTIME_ENV"):
+            import json
+
+            self.default_runtime_env = json.loads(os.environ["RAY_TPU_JOB_RUNTIME_ENV"])
         self.connected = True
 
     def connect_worker(
@@ -158,6 +191,13 @@ class Worker:
         self.connected = False
         self.mode = None
         self.conn = None
+        if getattr(self, "_owns_io", False) and self.io is not None:
+            try:
+                self.io.stop()
+            except Exception:
+                pass
+            self.io = None
+            self._owns_io = False
 
     # ------------------------------------------------------------------
     # refcounting (reference_count.h:61 — simplified owner-side counting)
@@ -289,7 +329,7 @@ class Worker:
             "resources": resources,
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
-            "runtime_env": runtime_env,
+            "runtime_env": runtime_env or self.default_runtime_env,
         }
         # head takes the initial +1 on each return id at submit time
         self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
@@ -331,7 +371,7 @@ class Worker:
             "max_concurrency": max_concurrency,
             "scheduling_strategy": scheduling_strategy,
             "lifetime": lifetime,
-            "runtime_env": runtime_env,
+            "runtime_env": runtime_env or self.default_runtime_env,
         }
         self.request({"t": "create_actor", "spec": spec})
         return actor_id

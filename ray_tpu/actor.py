@@ -11,7 +11,7 @@ import inspect
 from typing import Any, Dict, Optional
 
 from ._private.options import resolve_task_resources, validate_options
-from .remote_function import _strategy_to_wire
+from .remote_function import _strategy_to_wire, _validated_runtime_env
 
 
 class ActorMethod:
@@ -110,7 +110,7 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
             lifetime=opts.get("lifetime"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_validated_runtime_env(opts.get("runtime_env")),
         )
         return ActorHandle(actor_id, self._method_names(), self._cls.__name__)
 

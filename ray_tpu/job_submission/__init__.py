@@ -1,0 +1,96 @@
+"""Job submission: run an entrypoint command against a live cluster.
+
+Reference parity: python/ray/dashboard/modules/job/ (JobSubmissionClient
+sdk.py, JobStatus, job_manager.py JobSupervisor). A submitted job is a
+shell entrypoint spawned by the head with RAY_TPU_ADDRESS pointing at the
+cluster, so `ray_tpu.init(address="auto")` inside the job attaches to the
+SAME cluster; stdout/stderr stream to a per-job log in the session dir.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED)
+
+
+class JobSubmissionClient:
+    """Submits/inspects jobs. With no address, uses the current driver's
+    connection (ray_tpu.init must have run); with address, attaches to that
+    head socket ('auto' = newest live session)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        if not global_worker.connected:
+            ray_tpu.init(address=address or "auto")
+        self._worker = global_worker
+
+    def _request(self, msg: dict) -> Any:
+        return self._worker.request(msg)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        runtime_env: Optional[dict] = None,
+        submission_id: Optional[str] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        from ..runtime_env import RuntimeEnv
+
+        return self._request(
+            {
+                "t": "submit_job",
+                "entrypoint": entrypoint,
+                "runtime_env": RuntimeEnv.validate(runtime_env),
+                "submission_id": submission_id,
+                "metadata": metadata,
+            }
+        )
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        return JobStatus(self._request({"t": "job_status", "submission_id": submission_id}))
+
+    def get_job_info(self, submission_id: str) -> dict:
+        return self._request({"t": "job_info", "submission_id": submission_id})
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request({"t": "job_logs", "submission_id": submission_id})
+
+    def list_jobs(self) -> List[dict]:
+        return self._request({"t": "list_jobs"})
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request({"t": "stop_job", "submission_id": submission_id})
+
+    def wait_until_status(
+        self,
+        submission_id: str,
+        statuses=None,
+        timeout: float = 120.0,
+    ) -> JobStatus:
+        """Poll until the job reaches a terminal (or given) status."""
+        deadline = time.monotonic() + timeout
+        while True:
+            status = self.get_job_status(submission_id)
+            if statuses is not None:
+                if status in statuses:
+                    return status
+            elif status.is_terminal():
+                return status
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"job {submission_id} still {status} after {timeout}s")
+            time.sleep(0.2)

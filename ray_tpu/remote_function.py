@@ -10,6 +10,12 @@ import functools
 from typing import Any, Dict, Optional
 
 
+def _validated_runtime_env(env: Optional[dict]) -> Optional[dict]:
+    from .runtime_env import RuntimeEnv
+
+    return RuntimeEnv.validate(env)
+
+
 class RemoteFunction:
     def __init__(self, function, **default_options):
         self._function = function
@@ -44,7 +50,7 @@ class RemoteFunction:
             resources=resolve_task_resources(opts, is_actor=False),
             max_retries=opts.get("max_retries", 0),
             scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_validated_runtime_env(opts.get("runtime_env")),
         )
         if num_returns == 1:
             return refs[0]

@@ -1,0 +1,1 @@
+from .runtime_env import RuntimeEnv  # noqa: F401

@@ -1,0 +1,68 @@
+"""RuntimeEnv: per-task/actor/job process environment.
+
+Reference parity: python/ray/runtime_env/runtime_env.py (the typed dict)
++ _private/runtime_env plugins (working_dir.py, py_modules.py, conda/pip).
+Supported here: env_vars, working_dir, py_modules, config. pip/conda are
+rejected with a clear error — this deployment bakes dependencies into the
+image (no package installs on TPU hosts mid-job; the reference's conda
+builds cost minutes per env, SURVEY §2.2 runtime-envs row).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "config"}
+_REJECTED = {"pip", "conda", "container", "uv"}
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment; behaves as the plain dict the rest of
+    the runtime passes over the wire."""
+
+    def __init__(
+        self,
+        *,
+        env_vars: Optional[Dict[str, str]] = None,
+        working_dir: Optional[str] = None,
+        py_modules: Optional[List[str]] = None,
+        config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__()
+        rejected = _REJECTED & set(kwargs)
+        if rejected:
+            raise ValueError(
+                f"runtime_env fields {sorted(rejected)} are not supported: "
+                "dependencies must be baked into the host image"
+            )
+        unknown = set(kwargs) - _SUPPORTED
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields {sorted(unknown)}")
+        if env_vars is not None:
+            if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            if not os.path.isdir(working_dir):
+                raise ValueError(f"working_dir {working_dir!r} is not a directory")
+            self["working_dir"] = os.path.abspath(working_dir)
+        if py_modules is not None:
+            mods = []
+            for m in py_modules:
+                if not os.path.exists(m):
+                    raise ValueError(f"py_module path {m!r} does not exist")
+                mods.append(os.path.abspath(m))
+            self["py_modules"] = mods
+        if config is not None:
+            self["config"] = dict(config)
+
+    @classmethod
+    def validate(cls, env: Optional[dict]) -> Optional[dict]:
+        """Normalize a plain dict (the @remote(runtime_env=...) path)."""
+        if env is None:
+            return None
+        if isinstance(env, RuntimeEnv):
+            return env
+        return cls(**env)

@@ -1,0 +1,182 @@
+"""runtime_env (env_vars/working_dir/py_modules) + job submission + driver
+attach (reference: _private/runtime_env plugins, dashboard/modules/job)."""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv
+
+
+class TestRuntimeEnvValidation:
+    def test_env_vars_ok(self):
+        env = RuntimeEnv(env_vars={"A": "1"})
+        assert env["env_vars"] == {"A": "1"}
+
+    def test_rejects_pip_conda(self):
+        with pytest.raises(ValueError, match="baked into"):
+            RuntimeEnv(pip=["requests"])
+        with pytest.raises(ValueError, match="baked into"):
+            RuntimeEnv(conda={"dependencies": []})
+
+    def test_unknown_field(self):
+        with pytest.raises(ValueError, match="unknown"):
+            RuntimeEnv(working_dirs="/tmp")
+
+    def test_bad_types(self):
+        with pytest.raises(TypeError):
+            RuntimeEnv(env_vars={"A": 1})
+        with pytest.raises(ValueError):
+            RuntimeEnv(working_dir="/definitely/not/a/dir")
+
+
+def test_env_vars_reach_worker(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+
+
+def test_py_modules_importable(ray_start_regular, tmp_path):
+    mod_dir = tmp_path / "mypkg"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text("MAGIC = 41\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def use_module():
+        import mypkg
+
+        return mypkg.MAGIC + 1
+
+    assert ray_tpu.get(use_module.remote()) == 42
+
+
+def test_working_dir_staged(ray_start_regular, tmp_path):
+    (tmp_path / "data.txt").write_text("staged!")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_file():
+        return open("data.txt").read(), os.getcwd()
+
+    content, cwd = ray_tpu.get(read_file.remote())
+    assert content == "staged!"
+    assert cwd != str(tmp_path)  # a staged COPY, not the original
+
+
+class TestJobs:
+    def test_submit_and_succeed(self, ray_start_regular, tmp_path):
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        script = tmp_path / "job.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import ray_tpu
+                ray_tpu.init(address="auto")
+
+                @ray_tpu.remote
+                def f(x):
+                    return x * 3
+
+                print("job result:", ray_tpu.get(f.remote(7)))
+                ray_tpu.shutdown()
+                """
+            )
+        )
+        sid = client.submit_job(entrypoint=f"{sys.executable} {script}")
+        status = client.wait_until_status(sid, timeout=90)
+        logs = client.get_job_logs(sid)
+        assert status == JobStatus.SUCCEEDED, logs
+        assert "job result: 21" in logs
+        assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+    def test_failing_job(self, ray_start_regular):
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        sid = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        assert client.wait_until_status(sid, timeout=60) == JobStatus.FAILED
+        assert client.get_job_info(sid)["exit_code"] == 3
+
+    def test_stop_job(self, ray_start_regular):
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        sid = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+        assert client.get_job_status(sid) == JobStatus.RUNNING
+        client.stop_job(sid)
+        assert client.wait_until_status(sid, timeout=30) == JobStatus.STOPPED
+
+    def test_job_env_vars_reach_job_tasks(self, ray_start_regular, tmp_path):
+        # the job's env_vars must propagate to tasks the job submits
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        script = tmp_path / "envjob.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import os
+                import ray_tpu
+                ray_tpu.init(address="auto")
+
+                @ray_tpu.remote
+                def read():
+                    return os.environ.get("JOB_SECRET")
+
+                print("TASK_SEES", ray_tpu.get(read.remote()))
+                ray_tpu.shutdown()
+                """
+            )
+        )
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} {script}",
+            runtime_env={"env_vars": {"JOB_SECRET": "s3cret"}},
+        )
+        status = client.wait_until_status(sid, timeout=90)
+        logs = client.get_job_logs(sid)
+        assert status == JobStatus.SUCCEEDED, logs
+        assert "TASK_SEES s3cret" in logs
+
+    def test_stop_compound_entrypoint_kills_grandchildren(self, ray_start_regular, tmp_path):
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        marker = tmp_path / "grandchild_alive"
+        sid = client.submit_job(
+            entrypoint=(
+                f"true && {sys.executable} -c "
+                f"\"import time, pathlib; [pathlib.Path('{marker}').write_text(str(i)) "
+                f'or time.sleep(0.1) for i in range(600)]"'
+            )
+        )
+        deadline = time.time() + 30
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert marker.exists()
+        client.stop_job(sid)
+        assert client.wait_until_status(sid, timeout=30) == JobStatus.STOPPED
+        time.sleep(0.5)
+        before = marker.read_text()
+        time.sleep(0.8)
+        assert marker.read_text() == before  # grandchild stopped writing
+
+    def test_job_env_vars_and_duplicate_id(self, ray_start_regular):
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient()
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"import os; print('V=' + os.environ['JOB_VAR'])\"",
+            runtime_env={"env_vars": {"JOB_VAR": "x42"}},
+            submission_id="job-dup",
+        )
+        assert client.wait_until_status(sid, timeout=60) == JobStatus.SUCCEEDED
+        assert "V=x42" in client.get_job_logs(sid)
+        with pytest.raises(Exception):
+            client.submit_job(entrypoint="true", submission_id="job-dup")
