@@ -87,15 +87,37 @@ def _resolve_address(address: str) -> str:
     # 'auto' prefers the cluster that spawned us (jobs get the exact socket)
     if _os.environ.get("RAY_TPU_ADDRESS"):
         return _os.environ["RAY_TPU_ADDRESS"]
+    def mtime(p):
+        try:
+            return _os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
     candidates = sorted(
         _glob.glob(_os.path.join(GLOBAL_CONFIG.session_dir_root, "session_*", "head.sock")),
-        key=_os.path.getmtime,
+        key=mtime,
+        reverse=True,
     )
-    if not candidates:
-        raise ConnectionError(
-            f"address='auto' but no live session under {GLOBAL_CONFIG.session_dir_root}"
-        )
-    return candidates[-1]
+    for cand in candidates:  # newest LIVE head (crashed heads leave sockets)
+        if _socket_alive(cand):
+            return cand
+    raise ConnectionError(
+        f"address='auto' but no live session under {GLOBAL_CONFIG.session_dir_root}"
+    )
+
+
+def _socket_alive(path: str) -> bool:
+    import socket as _socket
+
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    s.settimeout(0.5)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
 
 
 def _ctx():

@@ -923,11 +923,16 @@ class Head:
             [p for p in sys.path if p] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
         )
         cwd = os.getcwd()
+        loop = asyncio.get_running_loop()
         if runtime_env.get("working_dir"):
-            cwd = await asyncio.get_running_loop().run_in_executor(
+            cwd = await loop.run_in_executor(
                 None, self._stage_dir, runtime_env["working_dir"]
             )
             env["PYTHONPATH"] = cwd + os.pathsep + env["PYTHONPATH"]
+        for mod in runtime_env.get("py_modules") or []:
+            staged = await loop.run_in_executor(None, self._stage_dir, mod)
+            mod_path = staged if os.path.isdir(staged) else os.path.dirname(staged)
+            env["PYTHONPATH"] = mod_path + os.pathsep + env["PYTHONPATH"]
         logf = open(log_path, "ab")
         # own session/process group: stop_job must reach grandchildren of the
         # shell (compound entrypoints), not just /bin/sh
@@ -999,17 +1004,28 @@ class Head:
         if job["status"] == "RUNNING":
             job["status"] = "STOPPED"
             self._terminate_job_proc(job["proc"])
+            asyncio.get_running_loop().create_task(self._escalate_kill(job["proc"]))
         return True
 
-    @staticmethod
-    def _terminate_job_proc(proc):
+    async def _escalate_kill(self, proc, grace_s: float = 3.0):
+        """SIGTERM then, if the group ignores it, SIGKILL (reference:
+        JobSupervisor stop escalation)."""
         import signal
 
+        await asyncio.sleep(grace_s)
+        if proc.poll() is None:
+            self._terminate_job_proc(proc, sig=signal.SIGKILL)
+
+    @staticmethod
+    def _terminate_job_proc(proc, sig=None):
+        import signal
+
+        sig = sig if sig is not None else signal.SIGTERM
         try:  # whole process group (start_new_session at spawn)
-            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            os.killpg(os.getpgid(proc.pid), sig)
         except (ProcessLookupError, PermissionError, OSError):
             try:
-                proc.terminate()
+                proc.send_signal(sig)
             except Exception:
                 pass
 

@@ -203,6 +203,23 @@ class Worker:
     # refcounting (reference_count.h:61 — simplified owner-side counting)
     # ------------------------------------------------------------------
 
+    def merged_runtime_env(self, task_env: Optional[dict]) -> Optional[dict]:
+        """Per-field merge of a task/actor runtime_env over the job-level
+        default (reference semantics: env_vars union, task wins per key;
+        other fields override wholesale)."""
+        default = self.default_runtime_env
+        if not default:
+            return task_env
+        if not task_env:
+            return default
+        merged = {**default, **task_env}
+        if default.get("env_vars") or task_env.get("env_vars"):
+            merged["env_vars"] = {
+                **(default.get("env_vars") or {}),
+                **(task_env.get("env_vars") or {}),
+            }
+        return merged
+
     def add_object_ref(self, object_id: str):
         if self.connected:
             self.send({"t": "add_refs", "counts": {object_id: 1}})
@@ -329,7 +346,7 @@ class Worker:
             "resources": resources,
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
-            "runtime_env": runtime_env or self.default_runtime_env,
+            "runtime_env": self.merged_runtime_env(runtime_env),
         }
         # head takes the initial +1 on each return id at submit time
         self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
@@ -371,7 +388,7 @@ class Worker:
             "max_concurrency": max_concurrency,
             "scheduling_strategy": scheduling_strategy,
             "lifetime": lifetime,
-            "runtime_env": runtime_env or self.default_runtime_env,
+            "runtime_env": self.merged_runtime_env(runtime_env),
         }
         self.request({"t": "create_actor", "spec": spec})
         return actor_id

@@ -18,5 +18,6 @@ from .dataset import (  # noqa: F401
     read_numpy,
     read_parquet,
 )
+from .grouped_data import GroupedData  # noqa: F401
 
 range = range_  # noqa: A001 — mirror ray.data.range

@@ -1,0 +1,205 @@
+"""Two-stage exchanges: distributed sort and hash-partitioned groupby.
+
+Reference parity: python/ray/data/_internal/planner/exchange/ (range/hash
+partition task schedulers), sort.py (sample-based boundaries), and the
+push-based shuffle idea (_internal/push_based_shuffle.py): map tasks emit
+K partitions via num_returns=K, reduce tasks consume one partition from
+every map task — the driver never touches block data, only refs.
+
+Blocks are normalized to dict-of-numpy columns for the exchange; plain
+row-list blocks (rows = dicts or scalars) convert on entry.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+Key = Union[str, None]
+
+
+def to_columns(block) -> Dict[str, np.ndarray]:
+    """Normalize any supported block to dict-of-numpy. Scalar rows become a
+    single 'value' column (marker key so we can convert back)."""
+    if isinstance(block, dict):
+        return {k: np.asarray(v) for k, v in block.items()}
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return {k: np.asarray(v) for k, v in block.to_pydict().items()}
+    except ImportError:
+        pass
+    try:
+        import pandas as pd
+
+        if isinstance(block, pd.DataFrame):
+            return {k: block[k].to_numpy() for k in block.columns}
+    except ImportError:
+        pass
+    rows = list(block)
+    if rows and isinstance(rows[0], dict):
+        keys = list(rows[0])
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return {"__value__": np.asarray(rows)}
+
+
+def from_columns(cols: Dict[str, np.ndarray]):
+    if set(cols) == {"__value__"}:
+        return list(cols["__value__"])
+    return cols
+
+
+def _key_values(cols: Dict[str, np.ndarray], key: Key) -> np.ndarray:
+    if key is None:
+        if "__value__" in cols:
+            return cols["__value__"]
+        raise ValueError("sort/groupby on record blocks requires a key column")
+    if key not in cols:
+        raise KeyError(f"no column {key!r}; have {sorted(cols)}")
+    return cols[key]
+
+
+def _take(cols: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
+    return {k: v[idx] for k, v in cols.items()}
+
+
+def _concat(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    parts = [p for p in parts if p and len(next(iter(p.values())))]
+    if not parts:
+        return {}
+    keys = list(parts[0])
+    return {k: np.concatenate([p[k] for p in parts]) for k in keys}
+
+
+# ---- task bodies (run remotely via ray_tpu.remote(...)) ----
+
+
+def sample_keys(block, key: Key, n_samples: int = 64) -> np.ndarray:
+    cols = to_columns(block)
+    vals = _key_values(cols, key)
+    if len(vals) == 0:
+        return vals
+    idx = np.linspace(0, len(vals) - 1, num=min(n_samples, len(vals))).astype(int)
+    return np.sort(vals)[idx]
+
+
+def range_partition(block, key: Key, boundaries: np.ndarray, num_parts: int):
+    """Sort the block, split at boundaries into EXACTLY num_parts parts
+    (the task is submitted with num_returns=num_parts; empty boundaries —
+    e.g. an all-empty dataset — still must honor that contract)."""
+    cols = to_columns(block)
+    vals = _key_values(cols, key)
+    order = np.argsort(vals, kind="stable")
+    cols = _take(cols, order)
+    vals = vals[order]
+    cuts = np.searchsorted(vals, boundaries, side="right")
+    parts = []
+    prev = 0
+    for c in list(cuts) + [len(vals)]:
+        parts.append(_take(cols, slice(prev, c)))
+        prev = c
+    empty = {k: v[:0] for k, v in cols.items()}
+    while len(parts) < num_parts:
+        parts.append(dict(empty))
+    parts = parts[:num_parts]
+    return tuple(parts) if num_parts > 1 else parts[0]
+
+
+def merge_sorted(key: Key, descending: bool, *parts):
+    cols = _concat([p for p in parts])
+    if not cols:
+        return {}
+    vals = _key_values(cols, key)
+    order = np.argsort(vals, kind="stable")
+    if descending:
+        order = order[::-1]
+    return from_columns(_take(cols, order))
+
+
+def hash_partition(block, key: Key, k: int):
+    cols = to_columns(block)
+    vals = _key_values(cols, key)
+    if len(vals) == 0:
+        empty = {c: v[:0] for c, v in cols.items()}
+        return tuple(empty for _ in builtins.range(k)) if k > 1 else empty
+    # stable hash per key value (python hash is salted per-process: NOT usable)
+    if vals.dtype.kind in "iub":
+        h = vals.astype(np.int64) % k
+    elif vals.dtype.kind == "f":
+        h = vals.astype(np.float64).view(np.int64) % k
+    else:
+        import hashlib
+
+        h = np.asarray(
+            [int(hashlib.md5(str(v).encode()).hexdigest()[:8], 16) % k for v in vals]
+        )
+    parts = tuple(_take(cols, h == i) for i in builtins.range(k))
+    return parts if k > 1 else parts[0]
+
+
+_AGGS: Dict[str, Callable] = {
+    "count": lambda v, inv, n: np.bincount(inv, minlength=n),
+    "sum": lambda v, inv, n: np.bincount(inv, weights=v.astype(np.float64), minlength=n),
+    "min": lambda v, inv, n: _reduce_at(np.minimum, v, inv, n),
+    "max": lambda v, inv, n: _reduce_at(np.maximum, v, inv, n),
+}
+
+
+def _reduce_at(ufunc, v, inv, n):
+    init = np.full(n, np.inf if ufunc is np.minimum else -np.inf, dtype=np.float64)
+    getattr(ufunc, "at")(init, inv, v.astype(np.float64))
+    return init
+
+
+def group_aggregate(key: Key, specs: List[tuple], *parts):
+    """specs: [(col, agg_name, out_name)]. Returns dict block with the key
+    column + one column per spec. Runs on ONE hash partition."""
+    cols = _concat([p for p in parts])
+    if not cols:
+        return {}
+    vals = _key_values(cols, key)
+    uniq, inv = np.unique(vals, return_inverse=True)
+    n = len(uniq)
+    out: Dict[str, np.ndarray] = {key if key is not None else "__value__": uniq}
+    for col, agg, out_name in specs:
+        v = cols[col] if col is not None else np.ones(len(vals))
+        if agg in ("count", "sum", "min", "max"):
+            out[out_name] = _AGGS[agg](v, inv, n)
+            if agg == "count":
+                out[out_name] = out[out_name].astype(np.int64)
+        elif agg == "mean":
+            s = np.bincount(inv, weights=v.astype(np.float64), minlength=n)
+            c = np.bincount(inv, minlength=n)
+            out[out_name] = s / np.maximum(c, 1)
+        elif agg == "std":
+            s = np.bincount(inv, weights=v.astype(np.float64), minlength=n)
+            s2 = np.bincount(inv, weights=v.astype(np.float64) ** 2, minlength=n)
+            c = np.maximum(np.bincount(inv, minlength=n), 1)
+            var = np.maximum(s2 / c - (s / c) ** 2, 0.0)
+            # sample std (ddof=1), the reference default
+            out[out_name] = np.sqrt(var * c / np.maximum(c - 1, 1))
+        else:
+            raise ValueError(f"unknown aggregation {agg!r}")
+    return out
+
+
+def group_map(key: Key, fn: Callable, *parts):
+    """map_groups: apply fn to each group's sub-block (dict-of-numpy),
+    concatenate the outputs (reference: GroupedData.map_groups)."""
+    cols = _concat([p for p in parts])
+    if not cols:
+        return {}
+    vals = _key_values(cols, key)
+    order = np.argsort(vals, kind="stable")
+    cols = _take(cols, order)
+    vals = vals[order]
+    uniq, starts = np.unique(vals, return_index=True)
+    outs = []
+    bounds = list(starts) + [len(vals)]
+    for i in builtins.range(len(uniq)):
+        sub = _take(cols, slice(bounds[i], bounds[i + 1]))
+        outs.append(to_columns(fn(from_columns(sub))))
+    return from_columns(_concat(outs))

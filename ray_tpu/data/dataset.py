@@ -230,6 +230,257 @@ class Dataset:
         ]
         return Dataset([lambda b=b: b for b in slices])
 
+    # ---- exchanges: sort / groupby (two-stage shuffles) ----
+
+    def _exchange_tasks(self):
+        """Materialize this dataset's blocks as object refs for an exchange
+        (map stages run as tasks; see _exchange.py for the protocol)."""
+        import ray_tpu
+
+        use_tasks = ray_tpu.is_initialized()
+        if use_tasks:
+            exec_task = ray_tpu.remote(_execute_block)
+            refs = [exec_task.remote(fn, self._ops) for fn in self._block_fns]
+            return refs, True
+        return self._compute_blocks(parallel=False), False
+
+    def sort(self, key: Optional[str] = None, descending: bool = False) -> "Dataset":
+        """Distributed sample-sort (reference: dataset.py Dataset.sort via
+        _internal/planner/exchange + sort.py sample boundaries): map tasks
+        range-partition with num_returns=K, one merge task per partition."""
+        from . import _exchange
+
+        import ray_tpu
+
+        blocks, remote = self._exchange_tasks()
+        if not blocks:
+            return Dataset([])
+        k = len(blocks)
+        if not remote or k == 1:
+            blocks = blocks if not remote else ray_tpu.get(blocks)
+            merged = _exchange.merge_sorted(key, descending, *[
+                _exchange.to_columns(b) for b in blocks
+            ])
+            return Dataset([lambda b=merged: b])
+        sample_t = ray_tpu.remote(_exchange.sample_keys)
+        part_t = ray_tpu.remote(_exchange.range_partition).options(num_returns=k)
+        merge_t = ray_tpu.remote(_exchange.merge_sorted)
+        samples = np.concatenate(ray_tpu.get([sample_t.remote(b, key) for b in blocks]))
+        samples = np.sort(samples)
+        # K-1 boundaries at even quantiles of the global sample
+        boundaries = samples[
+            np.linspace(0, len(samples) - 1, num=k + 1).astype(int)[1:-1]
+        ] if len(samples) else np.array([])
+        parts = [part_t.remote(b, key, boundaries, k) for b in blocks]
+        outs = [
+            merge_t.remote(key, descending, *[parts[b][i] for b in builtins.range(len(parts))])
+            for i in builtins.range(k)
+        ]
+        if descending:
+            outs = outs[::-1]
+        final = ray_tpu.get(outs)
+        return Dataset([lambda b=b: b for b in final if _block_num_rows(b)])
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        from .grouped_data import GroupedData
+
+        return GroupedData(self, key)
+
+    def _group_exchange(self, key, reducer, reducer_args) -> "Dataset":
+        """Hash-partition blocks by key, run `reducer(*args, *partition
+        parts)` once per partition."""
+        from . import _exchange
+
+        import ray_tpu
+
+        blocks, remote = self._exchange_tasks()
+        if not blocks:
+            return Dataset([])
+        k = len(blocks)
+        if not remote or k == 1:
+            blocks = blocks if not remote else ray_tpu.get(blocks)
+            out = reducer(*reducer_args, *[_exchange.to_columns(b) for b in blocks])
+            return Dataset([lambda b=out: b])
+        part_t = ray_tpu.remote(_exchange.hash_partition).options(num_returns=k)
+        reduce_t = ray_tpu.remote(reducer)
+        parts = [part_t.remote(b, key, k) for b in blocks]
+        outs = [
+            reduce_t.remote(*reducer_args, *[parts[b][i] for b in builtins.range(len(parts))])
+            for i in builtins.range(k)
+        ]
+        final = ray_tpu.get(outs)
+        return Dataset([lambda b=b: b for b in final if b and _block_num_rows(b)])
+
+    # ---- schema / column ops ----
+
+    def add_column(self, name: str, fn: Callable[[Batch], Any]) -> "Dataset":
+        def add(batch):
+            from . import _exchange
+
+            cols = _exchange.to_columns(batch)
+            cols[name] = np.asarray(fn(cols))
+            return cols
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        drop = set(cols)
+
+        def do(batch):
+            from . import _exchange
+
+            return {k: v for k, v in _exchange.to_columns(batch).items() if k not in drop}
+
+        return self.map_batches(do)
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        keep = list(cols)
+
+        def do(batch):
+            from . import _exchange
+
+            c = _exchange.to_columns(batch)
+            return {k: c[k] for k in keep}
+
+        return self.map_batches(do)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def do(batch):
+            from . import _exchange
+
+            return {mapping.get(k, k): v for k, v in _exchange.to_columns(batch).items()}
+
+        return self.map_batches(do)
+
+    def unique(self, column: str) -> List[Any]:
+        out = set()
+        for block in self._iter_computed_blocks():
+            from . import _exchange
+
+            cols = _exchange.to_columns(block)
+            out.update(np.unique(cols[column]).tolist())
+        return sorted(out)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (materializes only what it needs)."""
+        taken = []
+        remaining = n
+        for block in self._iter_computed_blocks():
+            rows = _block_num_rows(block)
+            take = min(rows, remaining)
+            if take > 0:
+                taken.append(_block_slice(block, 0, take))
+                remaining -= take
+            if remaining <= 0:
+                break
+        return Dataset([lambda b=b: b for b in taken])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        datasets = [self, *others]
+        block_fns = []
+        for ds in datasets:
+            if ds._ops:
+                blocks = ds._compute_blocks()
+                block_fns.extend([lambda b=b: b for b in blocks])
+            else:
+                block_fns.extend(ds._block_fns)
+        return Dataset(block_fns)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (reference:
+        zip_operator.py); overlapping names get a _1 suffix."""
+        from . import _exchange
+
+        left = self._compute_blocks()
+        right = other._compute_blocks()
+        lc = _exchange._concat([_exchange.to_columns(b) for b in left]) if left else {}
+        rc = _exchange._concat([_exchange.to_columns(b) for b in right]) if right else {}
+        ln = len(next(iter(lc.values()))) if lc else 0
+        rn = len(next(iter(rc.values()))) if rc else 0
+        if ln != rn:
+            raise ValueError(f"zip requires equal row counts, got {ln} vs {rn}")
+        out = dict(lc)
+        for k, v in rc.items():
+            out[k if k not in out else f"{k}_1"] = v
+        return Dataset([lambda b=out: b])
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        """Returns (train, test) datasets (reference: dataset.py
+        train_test_split)."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        blocks = ds._compute_blocks()
+        merged = _block_concat(blocks) if len(blocks) > 1 else (blocks[0] if blocks else [])
+        n = _block_num_rows(merged)
+        cut = n - int(n * test_size) if isinstance(test_size, float) else n - test_size
+        train = _block_slice(merged, 0, cut)
+        test = _block_slice(merged, cut, n)
+        return Dataset([lambda b=train: b]), Dataset([lambda b=test: b])
+
+    # ---- writes (reference: data/datasource do_write paths) ----
+
+    def _write_files(self, path: str, ext: str, write_one: Callable[[Any, str], None]):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        paths = []
+        for i, block in enumerate(self._iter_computed_blocks()):
+            fp = os.path.join(path, f"part-{i:05d}.{ext}")
+            write_one(block, fp)
+            paths.append(fp)
+        return paths
+
+    def write_parquet(self, path: str) -> List[str]:
+        from . import _exchange
+
+        def write_one(block, fp):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(pa.table(_exchange.to_columns(block)), fp)
+
+        return self._write_files(path, "parquet", write_one)
+
+    def write_csv(self, path: str) -> List[str]:
+        from . import _exchange
+
+        def write_one(block, fp):
+            import csv
+
+            cols = _exchange.to_columns(block)
+            keys = list(cols)
+            with open(fp, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(keys)
+                for i in builtins.range(len(cols[keys[0]]) if keys else 0):
+                    w.writerow([cols[k][i] for k in keys])
+
+        return self._write_files(path, "csv", write_one)
+
+    def write_json(self, path: str) -> List[str]:
+        def write_one(block, fp):
+            import json
+
+            with open(fp, "w") as f:
+                for row in _block_to_rows(block):
+                    if isinstance(row, dict):
+                        row = {
+                            k: (v.item() if hasattr(v, "item") else v) for k, v in row.items()
+                        }
+                    f.write(json.dumps(row) + "\n")
+
+        return self._write_files(path, "json", write_one)
+
+    def iter_torch_batches(self, *, batch_size: int = 256, drop_last: bool = False):
+        """Batches as dicts of torch CPU tensors (reference:
+        iter_torch_batches; the TPU path is iter_device_batches)."""
+        import torch
+
+        from . import _exchange
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            cols = _exchange.to_columns(batch)
+            yield {k: torch.as_tensor(np.ascontiguousarray(v)) for k, v in cols.items()}
+
     def split_at(self, rank: int, world_size: int) -> "Dataset":
         """Contiguous block-range shard for one worker (streaming split)."""
         n = self.num_blocks()

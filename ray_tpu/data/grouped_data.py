@@ -1,0 +1,46 @@
+"""GroupedData: the result of Dataset.groupby (reference:
+python/ray/data/grouped_data.py — count/sum/mean/min/max/std/map_groups
+over hash-partitioned groups)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from . import _exchange
+
+
+class GroupedData:
+    def __init__(self, dataset, key: Optional[str]):
+        self._ds = dataset
+        self._key = key
+
+    def _run(self, specs: List[tuple]):
+        return self._ds._group_exchange(
+            self._key, _exchange.group_aggregate, (self._key, list(specs))
+        )
+
+    def count(self):
+        return self._run([(None, "count", "count()")])
+
+    def sum(self, on: str):
+        return self._run([(on, "sum", f"sum({on})")])
+
+    def mean(self, on: str):
+        return self._run([(on, "mean", f"mean({on})")])
+
+    def min(self, on: str):
+        return self._run([(on, "min", f"min({on})")])
+
+    def max(self, on: str):
+        return self._run([(on, "max", f"max({on})")])
+
+    def std(self, on: str):
+        return self._run([(on, "std", f"std({on})")])
+
+    def aggregate(self, **named_specs: tuple):
+        """aggregate(total=("x", "sum"), n=(None, "count"))"""
+        specs = [(col, agg, out) for out, (col, agg) in named_specs.items()]
+        return self._run(specs)
+
+    def map_groups(self, fn: Callable):
+        return self._ds._group_exchange(self._key, _exchange.group_map, (self._key, fn))

@@ -1,0 +1,152 @@
+"""Dataset exchanges (sort/groupby), schema ops, writes, zip/union/limit
+(reference: python/ray/data tests for sort.py, grouped_data.py, zip)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _ds_from(cols, blocks=4):
+    n = len(next(iter(cols.values())))
+    per = (n + blocks - 1) // blocks
+    slices = [
+        {k: v[i * per : (i + 1) * per] for k, v in cols.items()}
+        for i in range((n + per - 1) // per)
+    ]
+    return rdata.Dataset([lambda b=b: b for b in slices])
+
+
+class TestSort:
+    def test_sort_columns(self, ray_start_regular, rng):
+        x = rng.permutation(1000).astype(np.int64)
+        ds = _ds_from({"x": x, "y": x * 2})
+        out = ds.sort("x")
+        rows = out.take_all()
+        got = np.array([r["x"] for r in rows])
+        np.testing.assert_array_equal(got, np.arange(1000))
+        assert all(r["y"] == 2 * r["x"] for r in rows[:50])
+
+    def test_sort_descending(self, ray_start_regular, rng):
+        x = rng.permutation(200)
+        ds = _ds_from({"x": x})
+        got = np.array([r["x"] for r in ds.sort("x", descending=True).take_all()])
+        np.testing.assert_array_equal(got, np.arange(199, -1, -1))
+
+    def test_sort_scalars_local(self, rng):
+        # no cluster: local fallback path
+        vals = list(rng.permutation(50))
+        ds = rdata.from_items(vals)
+        assert ds.sort().take_all() == sorted(vals)
+
+
+class TestGroupBy:
+    def test_count_sum_mean(self, ray_start_regular, rng):
+        keys = rng.integers(0, 7, 500)
+        vals = rng.random(500)
+        ds = _ds_from({"k": keys, "v": vals})
+        rows = {r["k"]: r for r in ds.groupby("k").sum("v").take_all()}
+        for k in range(7):
+            np.testing.assert_allclose(rows[k]["sum(v)"], vals[keys == k].sum(), rtol=1e-9)
+        counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+        assert counts == {k: int((keys == k).sum()) for k in range(7)}
+        means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+        for k in range(7):
+            np.testing.assert_allclose(means[k], vals[keys == k].mean(), rtol=1e-9)
+
+    def test_min_max_std(self, ray_start_regular, rng):
+        keys = rng.integers(0, 3, 300)
+        vals = rng.random(300)
+        ds = _ds_from({"k": keys, "v": vals})
+        mins = {r["k"]: r["min(v)"] for r in ds.groupby("k").min("v").take_all()}
+        maxs = {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}
+        stds = {r["k"]: r["std(v)"] for r in ds.groupby("k").std("v").take_all()}
+        for k in range(3):
+            np.testing.assert_allclose(mins[k], vals[keys == k].min())
+            np.testing.assert_allclose(maxs[k], vals[keys == k].max())
+            np.testing.assert_allclose(stds[k], vals[keys == k].std(ddof=1), rtol=1e-6)
+
+    def test_aggregate_multi(self, ray_start_regular, rng):
+        keys = rng.integers(0, 4, 200)
+        vals = rng.random(200)
+        ds = _ds_from({"k": keys, "v": vals})
+        rows = ds.groupby("k").aggregate(total=("v", "sum"), n=(None, "count")).take_all()
+        by_k = {r["k"]: r for r in rows}
+        for k in range(4):
+            np.testing.assert_allclose(by_k[k]["total"], vals[keys == k].sum(), rtol=1e-9)
+            assert by_k[k]["n"] == int((keys == k).sum())
+
+    def test_map_groups(self, ray_start_regular, rng):
+        keys = np.repeat(np.arange(5), 20)
+        vals = rng.random(100)
+        ds = _ds_from({"k": keys, "v": vals})
+
+        def center(group):
+            group["v"] = group["v"] - group["v"].mean()
+            return group
+
+        out = ds.groupby("k").map_groups(center)
+        cols = {}
+        for r in out.take_all():
+            cols.setdefault(r["k"], []).append(r["v"])
+        for k, vs in cols.items():
+            assert abs(np.mean(vs)) < 1e-9
+
+
+class TestSchemaOps:
+    def test_add_drop_select_rename(self, ray_start_regular):
+        ds = _ds_from({"a": np.arange(10), "b": np.arange(10) * 2})
+        ds2 = ds.add_column("c", lambda cols: cols["a"] + cols["b"])
+        assert [r["c"] for r in ds2.take(3)] == [0, 3, 6]
+        assert "b" not in ds2.drop_columns(["b"]).take(1)[0]
+        assert set(ds2.select_columns(["a", "c"]).take(1)[0]) == {"a", "c"}
+        assert "alpha" in ds.rename_columns({"a": "alpha"}).take(1)[0]
+
+    def test_unique_limit_union_zip(self, ray_start_regular):
+        ds = _ds_from({"a": np.array([3, 1, 2, 1, 3, 3])}, blocks=2)
+        assert ds.unique("a") == [1, 2, 3]
+        assert ds.limit(2).count() == 2
+        u = ds.union(ds)
+        assert u.count() == 12
+        z = _ds_from({"x": np.arange(4)}).zip(_ds_from({"y": np.arange(4) * 10}))
+        rows = z.take_all()
+        assert rows[2] == {"x": 2, "y": 20}
+        with pytest.raises(ValueError, match="equal row counts"):
+            _ds_from({"x": np.arange(4)}).zip(_ds_from({"y": np.arange(3)}))
+
+    def test_train_test_split(self, ray_start_regular):
+        ds = _ds_from({"x": np.arange(100)})
+        train, test = ds.train_test_split(test_size=0.25)
+        assert train.count() == 75
+        assert test.count() == 25
+
+
+class TestWrites:
+    def test_write_read_roundtrips(self, ray_start_regular, tmp_path):
+        ds = _ds_from({"x": np.arange(20), "y": np.arange(20) * 1.5}, blocks=3)
+        pq_files = ds.write_parquet(str(tmp_path / "pq"))
+        assert len(pq_files) == 3
+        back = rdata.read_parquet(pq_files)
+        assert back.count() == 20
+        csv_files = ds.write_csv(str(tmp_path / "csv"))
+        back_csv = rdata.read_csv(csv_files)
+        assert back_csv.count() == 20
+        json_files = ds.write_json(str(tmp_path / "js"))
+        import json
+
+        rows = [json.loads(l) for f in json_files for l in open(f)]
+        assert len(rows) == 20 and rows[0]["x"] == 0
+
+    def test_iter_torch_batches(self, ray_start_regular):
+        import torch
+
+        ds = _ds_from({"x": np.arange(10, dtype=np.float32)})
+        batches = list(ds.iter_torch_batches(batch_size=4))
+        assert [b["x"].shape[0] for b in batches] == [4, 4, 2]
+        assert isinstance(batches[0]["x"], torch.Tensor)
