@@ -128,6 +128,14 @@ def _ctx():
 
 
 def shutdown():
+    # close the driver's own connection before stopping the IO loop so its
+    # read task is cancelled cleanly (otherwise asyncio warns about a
+    # destroyed pending task at loop teardown)
+    if global_worker.conn is not None and not global_worker.conn.closed and global_worker.io:
+        try:
+            global_worker.io.run(global_worker.conn.close(), timeout=2)
+        except Exception:
+            pass
     if global_worker.node is not None:
         global_worker.node.stop()
     global_worker.node = None

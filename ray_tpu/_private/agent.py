@@ -1,0 +1,215 @@
+"""Per-host node agent: the remote half of the control plane.
+
+Reference parity: src/ray/raylet (node_manager.h:117) — the per-node daemon
+that registers with the GCS, owns the local worker pool, and serves the
+local object plane. ray_tpu's agent is deliberately thinner: scheduling
+stays centralized in the head (one scheduler, no resource gossip needed at
+TPU-pod scale — tens of hosts, not thousands), so the agent only
+  - registers the node + its resources over TCP (ray_syncer / node table),
+  - spawns/kills local worker processes on the head's behalf
+    (worker_pool.h:420 StartWorkerProcess),
+  - serves reads/deletes against the node-local shared-memory object plane
+    so the head can pull cross-node dependencies (object_manager.h:117's
+    chunked pull, collapsed to request/response over the same framing).
+
+Workers spawned here connect STRAIGHT to the head over TCP — task dispatch
+never relays through the agent, keeping the hot path at one hop (the same
+reason the reference pushes tasks worker-to-worker, direct_task_transport).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+from . import protocol
+from .config import GLOBAL_CONFIG as cfg
+
+_DEF_GRACE_S = 3.0
+
+
+class Agent:
+    def __init__(
+        self,
+        head_address: str,
+        node_id: str,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.head_address = head_address
+        self.node_id = node_id
+        self.resources = resources
+        self.labels = labels or {}
+        self.conn: protocol.Connection = None  # type: ignore
+        self.session: str = ""
+        self.scratch_dir: str = ""
+        self.shm_session: str = ""
+        self._shm = None
+        self._shm_tried = False
+        self.workers: Dict[str, subprocess.Popen] = {}
+        self._stop = asyncio.Event()
+
+    # ------------------------------------------------------------------
+
+    def _shm_client(self):
+        if not self._shm_tried:
+            self._shm_tried = True
+            from .shm import ShmClient
+
+            try:
+                self._shm = ShmClient(self.shm_session, cfg.shm_store_bytes)
+            except Exception:
+                self._shm = None
+        return self._shm
+
+    async def run(self):
+        reader, writer = await protocol.open_stream(self.head_address)
+        self.conn = protocol.Connection(reader, writer, self.handle, self._on_close)
+        self.conn.start()
+        info = await self.conn.request(
+            {
+                "t": "register_node",
+                "node_id": self.node_id,
+                "resources": self.resources,
+                "labels": self.labels,
+            }
+        )
+        self.session = info["session"]
+        self.shm_session = f"{self.session}_{self.node_id}"
+        self.scratch_dir = os.path.join(
+            cfg.session_dir_root, self.session, "nodes", self.node_id
+        )
+        os.makedirs(self.scratch_dir, exist_ok=True)
+        await self._stop.wait()
+        self._cleanup()
+
+    async def _on_close(self):
+        self._stop.set()
+
+    def _cleanup(self):
+        for proc in self.workers.values():
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.time() + _DEF_GRACE_S
+        for proc in self.workers.values():
+            try:
+                proc.wait(timeout=max(0.0, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        shm = self._shm_client()
+        if shm is not None:
+            try:
+                shm.disconnect()
+                from .shm import ShmClient
+
+                ShmClient.destroy(self.shm_session)
+            except Exception:
+                pass
+        shutil.rmtree(self.scratch_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------------
+
+    async def handle(self, msg):
+        t = msg["t"]
+        fn = getattr(self, f"_h_{t}", None)
+        if fn is None:
+            raise ValueError(f"agent got unknown message {t!r}")
+        return await fn(msg)
+
+    async def _h_ping(self, msg):
+        return "pong"
+
+    async def _h_shutdown(self, msg):
+        self._stop.set()
+        return True
+
+    async def _h_spawn_worker(self, msg):
+        """Spawn a local worker that dials the head directly over TCP."""
+        worker_id = msg["worker_id"]
+        runtime_env = msg.get("runtime_env") or {}
+        needs_tpu = msg.get("needs_tpu", False)
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = msg["head_address"]
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_SESSION_DIR"] = self.scratch_dir
+        env["RAY_TPU_SHM_SESSION"] = self.shm_session
+        user_env_vars = runtime_env.get("env_vars") or {}
+        for k, v in user_env_vars.items():
+            env[k] = str(v)
+        cwd = self.scratch_dir
+        extra_paths = []
+        loop = asyncio.get_running_loop()
+        if runtime_env.get("working_dir"):
+            cwd = await loop.run_in_executor(
+                None, _stage_dir, self.scratch_dir, runtime_env["working_dir"]
+            )
+            extra_paths.append(cwd)
+        for mod in runtime_env.get("py_modules") or []:
+            staged = await loop.run_in_executor(None, _stage_dir, self.scratch_dir, mod)
+            extra_paths.append(staged if os.path.isdir(staged) else os.path.dirname(staged))
+        argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        if needs_tpu:
+            env.pop("JAX_PLATFORMS", None)
+        else:
+            if "JAX_PLATFORMS" not in user_env_vars:
+                env["JAX_PLATFORMS"] = "cpu"
+            argv.insert(1, "-S")
+        # workers run -S: carry this agent's sys.path (plus staged dirs first)
+        parts = list(extra_paths)
+        if "PYTHONPATH" in user_env_vars:
+            parts.append(env["PYTHONPATH"])
+        parts.extend(p for p in sys.path if p)
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        proc = subprocess.Popen(argv, env=env, cwd=cwd)
+        self.workers[worker_id] = proc
+        return {"pid": proc.pid}
+
+    async def _h_kill_worker(self, msg):
+        proc = self.workers.pop(msg["worker_id"], None)
+        if proc is None:
+            return False
+        if proc.poll() is None:
+            try:
+                proc.kill() if msg.get("force") else proc.terminate()
+            except Exception:
+                pass
+        return True
+
+    async def _h_read_buffers(self, msg):
+        """Serve node-local shm buffers to the head (cross-node object pull)."""
+        from .shm import ShmBufferRef
+
+        shm = self._shm_client()
+        out: Dict[str, Optional[bytes]] = {}
+        for name in msg["names"]:
+            if shm is None:
+                out[name] = None
+                continue
+            mv = shm.get(ShmBufferRef(name=name, size=0))
+            out[name] = None if mv is None else bytes(mv)
+        return out
+
+    async def _h_delete_buffers(self, msg):
+        shm = self._shm_client()
+        if shm is not None:
+            for name in msg["names"]:
+                shm.delete(name)
+        return True
+
+
+def _stage_dir(scratch_dir: str, src: str) -> str:
+    from .staging import stage_into
+
+    return stage_into(scratch_dir, src)

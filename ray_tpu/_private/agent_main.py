@@ -1,0 +1,36 @@
+"""Node-agent process entrypoint: `python -m ray_tpu._private.agent_main`.
+
+Reference parity: the raylet main (src/ray/raylet/main.cc) — joins an
+existing cluster at --address and serves until the head connection drops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from .agent import Agent
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--resources", default="{}", help="JSON resource map")
+    p.add_argument("--labels", default="{}", help="JSON label map")
+    args = p.parse_args()
+    agent = Agent(
+        args.address,
+        args.node_id,
+        {k: float(v) for k, v in json.loads(args.resources).items()},
+        json.loads(args.labels),
+    )
+    try:
+        asyncio.run(agent.run())
+    except (KeyboardInterrupt, ConnectionError):
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,11 @@ D("worker_pool_prestart", int, 0, "workers to prestart per node at init")
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")
+D("head_tcp_host", str, "127.0.0.1",
+  "bind host for the multi-host TCP control plane; the wire protocol is "
+  "unauthenticated pickle, so bind non-loopback (0.0.0.0) only on trusted "
+  "networks (real multi-host deployments)")
+D("head_tcp_port", int, 0, "bind port for the TCP control plane (0 = ephemeral)")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

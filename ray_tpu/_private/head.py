@@ -32,7 +32,6 @@ import logging
 import os
 import subprocess
 import sys
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -55,10 +54,18 @@ class NodeRecord:
     available: Dict[str, float] = field(default_factory=dict)
     alive: bool = True
     labels: Dict[str, str] = field(default_factory=dict)
+    # agent connection for REAL remote nodes (reference: the raylet's gRPC
+    # channel, node_manager.h:117); None for the head node and for logical
+    # resource-only nodes (autoscaler simulations)
+    conn: Optional["protocol.Connection"] = None
 
     def __post_init__(self):
         if not self.available:
             self.available = dict(self.resources)
+
+    @property
+    def remote(self) -> bool:
+        return self.conn is not None
 
 
 @dataclass
@@ -127,6 +134,25 @@ class PlacementGroupRecord:
     state: str = "pending"  # pending | created | removed
     name: Optional[str] = None
     ready_event: Optional[asyncio.Event] = None
+
+
+def _advertise_host(bind_host: str) -> str:
+    """The address peers should dial. For a wildcard bind, find this host's
+    outbound IP (remote agents relay it to the workers they spawn — a
+    loopback advert would make those workers dial themselves)."""
+    if bind_host not in ("0.0.0.0", ""):
+        return bind_host
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))  # no packet sent; picks the route
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
 
 
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
@@ -229,6 +255,8 @@ class Head:
         self.pending_queue: collections.deque = collections.deque()
         self.idle_workers: Dict[str, List[str]] = collections.defaultdict(list)
         self.server: Optional[asyncio.base_events.Server] = None
+        self.tcp_server: Optional[asyncio.base_events.Server] = None
+        self.tcp_address: Optional[str] = None
         self._worker_counter = 0
         self._client_conns: Set[protocol.Connection] = set()
         self._head_node_id = "node-head"
@@ -258,21 +286,78 @@ class Head:
         return self._shm
 
     def _free_shm_buffers(self, env):
-        from .serialization import shm_buffer_names
+        from .serialization import shm_buffer_refs
 
         try:
-            names = shm_buffer_names(env)
+            refs = shm_buffer_refs(env)
         except Exception:
             return
-        if not names:
+        if not refs:
             return
-        shm = self._shm_client()
-        if shm is not None:
-            for n in names:
-                shm.delete(n)
+        by_node: Dict[str, List[str]] = collections.defaultdict(list)
+        for r in refs:
+            by_node[r.node or self._head_node_id].append(r.name)
+        for node_id, names in by_node.items():
+            node = self.nodes.get(node_id)
+            if node is not None and node.remote:
+                if not node.conn.closed:
+                    try:
+                        asyncio.get_running_loop().create_task(
+                            node.conn.send({"t": "delete_buffers", "names": names})
+                        )
+                    except RuntimeError:
+                        pass  # loop gone (shutdown)
+                continue
+            # head node AND logical nodes: workers share the head machine's
+            # session shm plane, so delete locally
+            shm = self._shm_client()
+            if shm is not None:
+                for n in names:
+                    shm.delete(n)
 
-    async def start(self):
+    async def _h_fetch_buffers(self, conn, msg):
+        """Pull shm buffers that live on `node` for a consumer elsewhere —
+        the collapsed analogue of the reference's chunked object pull
+        (pull_manager.h:52 / object_manager.h:117)."""
+        node_id = msg.get("node") or self._head_node_id
+        names: List[str] = msg["names"]
+        node = self.nodes.get(node_id)
+        if node is not None and node.remote:
+            if not node.alive or node.conn.closed:
+                return {name: None for name in names}
+            try:
+                return await node.conn.request(
+                    {"t": "read_buffers", "names": names}, timeout=60
+                )
+            except Exception:
+                return {name: None for name in names}
+        # head node and logical nodes share the head machine's shm plane
+        from .shm import ShmBufferRef
+
+        shm = self._shm_client()
+        out = {}
+        for name in names:
+            mv = None if shm is None else shm.get(ShmBufferRef(name=name, size=0))
+            out[name] = None if mv is None else bytes(mv)
+        return out
+
+    async def start(self, tcp_host: Optional[str] = None, tcp_port: Optional[int] = None):
+        """Listen on the session unix socket AND on TCP (the multi-host
+        plane; reference: grpc_server.h:73). The bound host:port is written
+        to <session_dir>/head_addr for discovery by `init(address=...)`."""
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
+        host = tcp_host if tcp_host is not None else cfg.head_tcp_host
+        port = tcp_port if tcp_port is not None else cfg.head_tcp_port
+        try:
+            self.tcp_server = await asyncio.start_server(self._on_client, host=host, port=port)
+        except OSError as e:
+            logger.warning("head TCP listener failed (%s); single-host only", e)
+            self.tcp_server = None
+            return
+        bound = self.tcp_server.sockets[0].getsockname()
+        self.tcp_address = f"{_advertise_host(host)}:{bound[1]}"
+        with open(os.path.join(self.session_dir, "head_addr"), "w") as f:
+            f.write(self.tcp_address)
 
     async def stop(self):
         self._shutdown = True
@@ -282,8 +367,17 @@ class Head:
                 self._terminate_job_proc(job["proc"])
         for w in list(self.workers.values()):
             await self._kill_worker(w, reason="shutdown")
+        for n in list(self.nodes.values()):
+            if n.conn is not None and not n.conn.closed:
+                try:
+                    await n.conn.request({"t": "shutdown"}, timeout=2)
+                except Exception:
+                    pass
+                await n.conn.close()
         if self.server is not None:
             self.server.close()
+        if self.tcp_server is not None:
+            self.tcp_server.close()
         # Close remaining client connections (incl. the driver's); 3.12's
         # Server.wait_closed would otherwise wait on them forever.
         for conn in list(self._client_conns):
@@ -324,9 +418,32 @@ class Head:
         # can't resurrect the entry after an earlier prune
         for proc in getattr(conn, "_metric_procs", ()):
             self.metrics_store.pop(proc, None)
+        for n in list(self.nodes.values()):
+            if n.conn is conn and n.alive:
+                await self._on_node_death(n, reason="agent connection closed")
         for w in list(self.workers.values()):
             if w.conn is conn and w.state != "dead":
                 await self._on_worker_death(w, reason="connection closed")
+
+    async def _on_node_death(self, node: NodeRecord, reason: str):
+        """Agent died: the node and everything on it is gone (reference:
+        GcsNodeManager node-death broadcast + NodeManager lease cleanup)."""
+        if not node.alive:
+            return
+        node.alive = False
+        if not self._shutdown:
+            logger.warning("node %s died: %s", node.node_id, reason)
+        for w in list(self.workers.values()):
+            if w.node_id == node.node_id and w.state != "dead":
+                # best effort: tell orphaned workers (agent-spawned procs
+                # survive an agent SIGKILL) to exit, then run death handling
+                if w.conn is not None and not w.conn.closed:
+                    try:
+                        await w.conn.send({"t": "shutdown"})
+                    except Exception:
+                        pass
+                    await w.conn.close()
+                await self._on_worker_death(w, reason=f"node died ({reason})")
 
     # ------------------------------------------------------------------
     # message handling
@@ -344,6 +461,18 @@ class Head:
     async def _h_register_driver(self, conn, msg):
         self._driver_conn = conn
         return {"node_id": self._head_node_id, "job_config": self.job_config}
+
+    async def _h_register_node(self, conn, msg):
+        """A per-host agent joined over TCP (reference: raylet registration
+        with GcsNodeManager)."""
+        node_id = msg["node_id"]
+        if node_id in self.nodes and self.nodes[node_id].alive:
+            raise ValueError(f"node id {node_id!r} already registered")
+        self.nodes[node_id] = NodeRecord(
+            node_id, dict(msg["resources"]), labels=msg.get("labels", {}), conn=conn
+        )
+        self._pump()
+        return {"session": os.path.basename(self.session_dir)}
 
     async def _h_register_worker(self, conn, msg):
         w = self.workers.get(msg["worker_id"])
@@ -512,6 +641,8 @@ class Head:
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
         except asyncio.TimeoutError:
+            pass
+        if w.state not in ("idle", "starting") or w.conn is None:
             rec.state = "dead"
             rec.death_reason = "worker failed to start"
             self._release_node(node_id, resources)
@@ -740,6 +871,12 @@ class Head:
         for w in list(self.workers.values()):
             if w.node_id == rec.node_id:
                 await self._kill_worker(w, reason="node removed")
+        if rec.remote and not rec.conn.closed:
+            try:
+                await rec.conn.request({"t": "shutdown"}, timeout=2)
+            except Exception:
+                pass
+            await rec.conn.close()
         return True
 
     async def _h_pending_demands(self, conn, msg):
@@ -1240,6 +1377,25 @@ class Head:
         w = WorkerRecord(worker_id=worker_id, node_id=node_id, actor_id=dedicated_actor_id)
         w.registered = asyncio.get_running_loop().create_future()
         self.workers[worker_id] = w
+        node = self.nodes.get(node_id)
+        if node is not None and node.remote:
+            # remote node: the agent spawns; the worker dials us back over TCP
+            try:
+                await node.conn.request(
+                    {
+                        "t": "spawn_worker",
+                        "worker_id": worker_id,
+                        "head_address": self.tcp_address,
+                        "runtime_env": runtime_env,
+                        "needs_tpu": needs_tpu,
+                    }
+                )
+            except Exception as e:
+                logger.warning("agent spawn failed on %s: %r", node_id, e)
+                w.state = "dead"
+                if not w.registered.done():
+                    w.registered.set_result(None)
+            return w
         env = dict(os.environ)
         env["RAY_TPU_SOCKET"] = self.socket_path
         env["RAY_TPU_WORKER_ID"] = worker_id
@@ -1296,44 +1452,9 @@ class Head:
         return w
 
     def _stage_dir(self, src: str) -> str:
-        """Copy a working_dir/py_module into the session dir, keyed by a
-        cheap content signature so identical envs share one copy."""
-        import hashlib
-        import shutil
+        from .staging import stage_into
 
-        h = hashlib.sha1(src.encode())
-        for root, _dirs, files in os.walk(src):
-            for f in sorted(files):
-                p = os.path.join(root, f)
-                try:
-                    st = os.stat(p)
-                    h.update(f"{os.path.relpath(p, src)}:{st.st_size}:{st.st_mtime_ns}".encode())
-                except OSError:
-                    continue
-        if os.path.isfile(src):
-            st = os.stat(src)
-            h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
-        dest = os.path.join(
-            self.session_dir, "runtime_resources", h.hexdigest()[:16], os.path.basename(src)
-        )
-        if not os.path.exists(dest):
-            # stage to a temp path then atomically rename: concurrent stages
-            # of the same content (off-loop executor threads) never expose a
-            # half-copied tree
-            os.makedirs(os.path.dirname(dest), exist_ok=True)
-            tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
-            try:
-                if os.path.isdir(src):
-                    shutil.copytree(src, tmp)
-                else:
-                    shutil.copy2(src, tmp)
-                os.rename(tmp, dest)
-            except OSError:
-                if not os.path.exists(dest):
-                    raise
-            finally:
-                shutil.rmtree(tmp, ignore_errors=True)
-        return dest
+        return stage_into(self.session_dir, src)
 
     async def _kill_worker(self, w: WorkerRecord, reason: str = ""):
         if w.state == "dead":
@@ -1346,10 +1467,22 @@ class Head:
                 w.proc.terminate()
             except Exception:
                 pass
+        elif w.proc is None:
+            # remote worker: the owning agent holds the process handle
+            node = self.nodes.get(w.node_id)
+            if node is not None and node.remote and not node.conn.closed:
+                try:
+                    await node.conn.request(
+                        {"t": "kill_worker", "worker_id": w.worker_id}, timeout=5
+                    )
+                except Exception:
+                    pass
         if w.worker_id in self.idle_workers[w.node_id]:
             self.idle_workers[w.node_id].remove(w.worker_id)
 
     async def _on_worker_death(self, w: WorkerRecord, reason: str):
+        if w.state == "dead":
+            return
         was_actor = w.actor_id
         w.state = "dead"
         if w.worker_id in self.idle_workers[w.node_id]:

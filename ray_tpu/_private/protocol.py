@@ -1,10 +1,11 @@
-"""Control-plane wire protocol: length-prefixed pickled dicts over unix sockets.
+"""Control-plane wire protocol: length-prefixed pickled dicts.
 
 Reference parity: src/ray/rpc (GrpcServer/GrpcClient) + src/ray/protobuf.
 The reference uses gRPC because its control plane spans hosts and languages;
-here the intra-host control plane is asyncio over unix domain sockets (the
-multi-host plane in ray_tpu rides the same framing over TCP). Bulk data never
-rides this socket — it goes through the shared-memory object plane.
+here the same framing rides two transports: unix domain sockets intra-host
+(drivers/workers on the head machine) and TCP inter-host (per-host agents,
+remote workers, remote drivers). Bulk data prefers the shared-memory object
+plane; cross-node buffers are pulled through the head (see serialization).
 
 Message = dict with "t" (type). Requests carry "rid"; replies are
 {"t": "reply", "rid", "ok", "value"|"error"}.
@@ -16,10 +17,31 @@ import asyncio
 import itertools
 import pickle
 import struct
-from typing import Any, Awaitable, Callable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
 MAX_MSG = 1 << 40
+
+
+def is_tcp_address(address: str) -> bool:
+    """'host:port' (TCP) vs a filesystem path (unix socket)."""
+    if address.startswith(("/", ".")):
+        return False
+    host, sep, port = address.rpartition(":")
+    return bool(sep) and port.isdigit() and bool(host)
+
+
+def parse_tcp_address(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host, int(port)
+
+
+async def open_stream(address: str):
+    """Open (reader, writer) to a head/agent at a unix path or host:port."""
+    if is_tcp_address(address):
+        host, port = parse_tcp_address(address)
+        return await asyncio.open_connection(host, port)
+    return await asyncio.open_unix_connection(address)
 
 
 async def read_msg(reader: asyncio.StreamReader) -> dict:

@@ -106,16 +106,25 @@ def deserialize(obj: SerializedObject) -> Any:
 
 def externalize(env: SerializedObject, shm_client, threshold: int) -> SerializedObject:
     """Move large out-of-band buffers into the shared-memory store, replacing
-    them with ShmBufferRef handles (zero-copy across host processes)."""
+    them with ShmBufferRef handles (zero-copy across host processes). Each
+    handle is tagged with the producing node so cross-node consumers know
+    where the primary copy lives."""
     if shm_client is None:
         return env
     import uuid
 
+    from .worker import global_worker
+
+    node = global_worker.node_id or ""
     new_buffers = []
     for buf in env.buffers:
         if isinstance(buf, (bytes, memoryview)) and len(buf) >= threshold:
             ref = shm_client.create(uuid.uuid4().hex, memoryview(buf))
-            new_buffers.append(ref if ref is not None else buf)
+            if ref is not None:
+                ref.node = node
+                new_buffers.append(ref)
+            else:
+                new_buffers.append(buf)
         else:
             new_buffers.append(buf)
     env.buffers = new_buffers
@@ -123,23 +132,52 @@ def externalize(env: SerializedObject, shm_client, threshold: int) -> Serialized
 
 
 def materialize(env: SerializedObject, shm_client) -> SerializedObject:
-    """Resolve ShmBufferRef buffers into mapped memoryviews (no copy)."""
+    """Resolve ShmBufferRef buffers into memoryviews.
+
+    Same-node buffers map zero-copy from the local shm plane. Cross-node
+    buffers (ref.node != ours) are pulled through the head (which relays to
+    the owning node's agent — reference: pull_manager.h:52) and cached into
+    the local plane under the same cluster-unique name, so repeat consumers
+    on this node hit shm."""
     from .shm import ShmBufferRef
 
-    out = []
-    for buf in env.buffers:
-        if isinstance(buf, ShmBufferRef):
-            if shm_client is None:
-                raise RuntimeError("shm buffer present but shm store unavailable")
-            mv = shm_client.get(buf)
-            if mv is None:
-                from ..exceptions import ObjectLostError
+    from ..exceptions import ObjectLostError
 
-                raise ObjectLostError(buf.name)
-            out.append(mv)
+    refs = [b for b in env.buffers if isinstance(b, ShmBufferRef)]
+    if not refs:
+        return env
+    from .worker import global_worker
+
+    my_node = global_worker.node_id or ""
+    resolved = {}
+    missing = []
+    for buf in refs:
+        if buf.name in resolved:
+            continue
+        mv = shm_client.get(buf) if shm_client is not None else None
+        if mv is not None:
+            resolved[buf.name] = mv
+        elif (buf.node or "") == my_node and shm_client is not None:
+            raise ObjectLostError(buf.name)  # primary copy gone (evicted)
         else:
-            out.append(buf)
-    env.buffers = out
+            missing.append(buf)
+    if missing:
+        by_node: dict = {}
+        for buf in missing:
+            by_node.setdefault(buf.node or "", []).append(buf.name)
+        for node, names in by_node.items():
+            got = global_worker.request(
+                {"t": "fetch_buffers", "names": names, "node": node}
+            )
+            for name, data in got.items():
+                if data is None:
+                    raise ObjectLostError(name)
+                if shm_client is not None:
+                    shm_client.create(name, data)  # best-effort local cache
+                resolved[name] = memoryview(data)
+    env.buffers = [
+        resolved[b.name] if isinstance(b, ShmBufferRef) else b for b in env.buffers
+    ]
     return env
 
 
@@ -147,3 +185,9 @@ def shm_buffer_names(env: SerializedObject):
     from .shm import ShmBufferRef
 
     return [b.name for b in env.buffers if isinstance(b, ShmBufferRef)]
+
+
+def shm_buffer_refs(env: SerializedObject):
+    from .shm import ShmBufferRef
+
+    return [b for b in env.buffers if isinstance(b, ShmBufferRef)]

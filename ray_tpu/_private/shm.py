@@ -64,10 +64,15 @@ def _load_lib():
 
 @dataclass
 class ShmBufferRef:
-    """Picklable handle to a shared-memory buffer (travels in envelopes)."""
+    """Picklable handle to a shared-memory buffer (travels in envelopes).
+
+    `node` is the cluster node whose local shm plane holds the primary copy
+    ("" = head node); consumers on other nodes pull through the head
+    (serialization.materialize)."""
 
     name: str
     size: int
+    node: str = ""
 
 
 def _release_mapping(lib, handle, name_bytes, ptr):
@@ -79,13 +84,19 @@ def _release_mapping(lib, handle, name_bytes, ptr):
 
 def connect_for_session(session_dir: str):
     """Shared lazy-connect helper (head + workers): returns a ShmClient for
-    the session, or None if disabled/unavailable."""
+    the session, or None if disabled/unavailable. RAY_TPU_SHM_SESSION
+    overrides the session name — agents give each node its own namespace so
+    the per-node planes stay distinct even when tests colocate nodes on one
+    machine."""
     from .config import GLOBAL_CONFIG as cfg
 
-    if not cfg.shm_store_enabled or not session_dir:
+    session = os.environ.get("RAY_TPU_SHM_SESSION") or (
+        os.path.basename(session_dir) if session_dir else ""
+    )
+    if not cfg.shm_store_enabled or not session:
         return None
     try:
-        return ShmClient(os.path.basename(session_dir), cfg.shm_store_bytes)
+        return ShmClient(session, cfg.shm_store_bytes)
     except Exception:
         return None
 

@@ -1,0 +1,50 @@
+"""Runtime-env staging: copy working_dir / py_modules into a session-owned
+directory, keyed by a cheap content signature so identical envs share one
+copy. Used by the head (local worker spawns, job submission) and by node
+agents (remote worker spawns). Reference parity:
+_private/runtime_env/working_dir.py + the per-node runtime-env agent
+(runtime_env_agent.py:161), collapsed to a copy-on-spawn helper.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import threading
+
+
+def stage_into(base_dir: str, src: str) -> str:
+    """Copy `src` (dir or file) under base_dir/runtime_resources/<sig>/ and
+    return the staged path. Concurrent stages of the same content are safe:
+    copy to a temp path, then atomically rename."""
+    h = hashlib.sha1(src.encode())
+    for root, _dirs, files in os.walk(src):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            try:
+                st = os.stat(p)
+                h.update(f"{os.path.relpath(p, src)}:{st.st_size}:{st.st_mtime_ns}".encode())
+            except OSError:
+                continue
+    if os.path.isfile(src):
+        st = os.stat(src)
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+    dest = os.path.join(
+        base_dir, "runtime_resources", h.hexdigest()[:16], os.path.basename(src)
+    )
+    if not os.path.exists(dest):
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            if os.path.isdir(src):
+                shutil.copytree(src, tmp)
+            else:
+                shutil.copy2(src, tmp)
+            os.rename(tmp, dest)
+        except OSError:
+            if not os.path.exists(dest):
+                raise
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest

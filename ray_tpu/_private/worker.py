@@ -123,10 +123,11 @@ class Worker:
         self.connected = True
 
     def connect_existing(self, socket_path: str, namespace: str = ""):
-        """Attach as an ADDITIONAL driver to a running head (the job-
-        submission / `ray.init(address="auto")` path — reference:
-        worker.py:1186 address resolution). Owns its own IO thread; the
-        head outlives this client."""
+        """Attach as an ADDITIONAL driver to a running head — via the
+        session unix socket (job submission, `init(address="auto")`) or a
+        TCP host:port (remote drivers; reference: worker.py:1186 address
+        resolution + util/client). Owns its own IO thread; the head
+        outlives this client."""
         import os
 
         self.mode = MODE_DRIVER
@@ -141,7 +142,11 @@ class Worker:
         self.node = None
         self.io = EventLoopThread()
         self._owns_io = True
-        self.session_dir = os.path.dirname(socket_path)
+        # remote (TCP) drivers have no local session dir: no shm plane —
+        # objects ride the socket inline and buffers are pulled via the head
+        self.session_dir = (
+            None if protocol.is_tcp_address(socket_path) else os.path.dirname(socket_path)
+        )
         self.namespace = namespace
         self.conn = self.io.run(self._open_conn(socket_path))
         info = self.request({"t": "register_driver"})
@@ -162,7 +167,7 @@ class Worker:
         self.connected = True
 
     async def _open_conn(self, socket_path: str) -> protocol.Connection:
-        reader, writer = await asyncio.open_unix_connection(socket_path)
+        reader, writer = await protocol.open_stream(socket_path)
 
         async def handler(msg):
             return await self._handle_push(msg)

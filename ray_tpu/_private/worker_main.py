@@ -43,7 +43,7 @@ class WorkerServer:
 
     async def run(self):
         self._loop = asyncio.get_running_loop()
-        reader, writer = await asyncio.open_unix_connection(self.socket_path)
+        reader, writer = await protocol.open_stream(self.socket_path)
         self.conn = protocol.Connection(reader, writer, self.handle)
         self.conn.start()
 
@@ -140,10 +140,12 @@ class WorkerServer:
 
 
 def main():
-    socket_path = os.environ["RAY_TPU_SOCKET"]
+    # local workers get the session unix socket; agent-spawned workers on
+    # remote nodes dial the head's TCP address directly
+    address = os.environ.get("RAY_TPU_SOCKET") or os.environ["RAY_TPU_ADDRESS"]
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     node_id = os.environ["RAY_TPU_NODE_ID"]
-    server = WorkerServer(socket_path, worker_id, node_id)
+    server = WorkerServer(address, worker_id, node_id)
     try:
         asyncio.run(server.run())
     except (KeyboardInterrupt, ConnectionError):

@@ -136,6 +136,54 @@ def cmd_metrics(obs: _Observer, args) -> None:
     print("\n".join(merged_lines) if merged_lines else "(no metrics)")
 
 
+def cmd_start(args) -> None:
+    """`ray_tpu start --head` runs a standalone head process (the TCP
+    address is printed for workers to join); `ray_tpu start --address
+    host:port` runs this host's node agent until the head goes away.
+    Reference parity: `ray start` (scripts.py:537)."""
+    if args.head:
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        overrides = {}
+        if args.port is not None:
+            overrides["head_tcp_port"] = args.port
+        ray_tpu.init(
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            _system_config=overrides or None,
+        )
+        addr = global_worker.node.head.tcp_address
+        print(f"head started: --address={addr}", flush=True)
+        print(f"session dir:  {global_worker.session_dir}", flush=True)
+        try:
+            import signal
+
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ray_tpu.shutdown()
+        return
+    if not args.address:
+        sys.exit("start needs --head or --address host:port")
+    import socket
+    import uuid
+
+    from ray_tpu._private.agent import Agent
+    from ray_tpu._private.node import default_resources
+
+    node_id = args.node_id or f"node-{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+    res = default_resources(args.num_cpus, args.num_tpus)
+    res.pop("node:__internal_head__", None)
+    agent = Agent(args.address, node_id, res)
+    print(f"joining {args.address} as {node_id} with {res}", flush=True)
+    try:
+        asyncio.run(agent.run())
+    except (KeyboardInterrupt, ConnectionError):
+        pass
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     parser.add_argument("--session-dir", help="session dir (default: newest live session)")
@@ -147,7 +195,18 @@ def main(argv=None) -> None:
     p_tl = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p_tl.add_argument("-o", "--output", default="timeline.json")
     sub.add_parser("metrics", help="dump metrics (prometheus-ish text)")
+    p_start = sub.add_parser("start", help="start a head or join as a node agent")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", help="head host:port to join as a node")
+    p_start.add_argument("--port", type=int, help="head TCP port (with --head)")
+    p_start.add_argument("--node-id")
+    p_start.add_argument("--num-cpus", type=int)
+    p_start.add_argument("--num-tpus", type=int)
     args = parser.parse_args(argv)
+
+    if args.cmd == "start":
+        cmd_start(args)
+        return
 
     obs = _Observer(_find_session(args.session_dir))
     try:
