@@ -45,12 +45,18 @@ def main():
     on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
     n_chips = len(jax.devices())
 
+    import dataclasses
+
     if on_tpu:
-        cfg = CONFIGS["gpt2_125m"]
+        # Pallas flash attention (head-major layout) + selective remat that
+        # saves weight-matmul outputs, rope'd q/k, and the attention output:
+        # measured 0.41 MFU vs 0.27 for dense+full-remat on v5e (b16 was the
+        # largest batch whose saved residuals fit 16G HBM at compile time).
+        cfg = dataclasses.replace(
+            CONFIGS["gpt2_125m"], attention="flash", remat_policy="flash"
+        )
         batch, seq, steps = 16, 1024, 10
     else:  # CI / local smoke: tiny model
-        import dataclasses
-
         cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
         batch, seq, steps = 8, 128, 5
 

@@ -26,10 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import causal_attention
+from ..ops.attention import causal_attention, causal_attention_bhsd
 from ..ops.norm import rms_norm
 from ..ops.ring_attention import ring_attention
-from ..ops.rope import apply_rope, rope_frequencies
+from ..ops.rope import apply_rope, apply_rope_bhsd, rope_frequencies
 from ..ops.ulysses import ulysses_attention
 from ..ops.losses import softmax_cross_entropy_with_int_labels
 from ..parallel.sharding import ShardingRules, constrain
@@ -49,6 +49,17 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = True
+    # what the per-layer checkpoint saves: "full" recomputes everything
+    # (max memory savings), "dots_no_batch" keeps weight-matmul outputs and
+    # recomputes only attention + elementwise (the usual best MFU/memory
+    # trade), "dots" keeps every dot product, "flash" = dots_no_batch plus
+    # the attention-kernel output (backward never re-runs the kernel)
+    remat_policy: str = "full"
+    # flash attention tile sizes; on v5e big tiles win (grid overhead
+    # dominates small blocks — measured 310ms @128 vs 234ms @1024 on the
+    # 125M single-chip bench)
+    flash_block_q: int = 1024
+    flash_block_k: int = 1024
     # MoE (expert parallel); n_experts=0 -> dense MLP
     n_experts: int = 0
     top_k: int = 2
@@ -253,24 +264,38 @@ def make_forward(
     else:
         inner_attn = None
 
+    # dense/flash run head-major ([B,H,S,D], the kernel/MXU-native layout:
+    # relayout transposes around attention cost more than attention itself
+    # at small d_head); ring/ulysses keep [B,S,H,D] (seq must be a leading
+    # non-minor dim for the sp shard_map)
+    head_major = inner_attn is None
+
     def attend(q, k, v):
-        if inner_attn is None or mesh is None:
+        if inner_attn is not None and mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, "sp", None, None)
+            return jax.shard_map(
+                inner_attn,
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+                axis_names=frozenset({"sp"}),
+            )(q, k, v)
+        if head_major:
             if cfg.attention == "flash":
                 from ..ops.flash_attention import flash_attention
 
-                return flash_attention(q, k, v)
-            return causal_attention(q, k, v)
-        from jax.sharding import PartitionSpec as P
-
-        spec = P(None, "sp", None, None)
-        return jax.shard_map(
-            inner_attn,
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            check_vma=False,
-            axis_names=frozenset({"sp"}),
-        )(q, k, v)
+                return flash_attention(
+                    q, k, v,
+                    block_q=min(cfg.flash_block_q, q.shape[2]),
+                    block_k=min(cfg.flash_block_k, k.shape[2]),
+                    layout="bhsd",
+                )
+            return causal_attention_bhsd(q, k, v)
+        # ring/ulysses without a mesh: dense correctness oracle
+        return causal_attention(q, k, v)
 
     def _constrain(x, *axes):
         if rules is None or mesh is None:
@@ -279,20 +304,51 @@ def make_forward(
 
     def layer_step(x, lp):
         h = rms_norm(x, lp["attn_norm"])
-        q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(h.dtype))
-        k = jnp.einsum("bse,ekd->bskd", h, lp["wk"].astype(h.dtype))
-        v = jnp.einsum("bse,ekd->bskd", h, lp["wv"].astype(h.dtype))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        q = _constrain(q, "batch", "seq", "heads", "head_dim")
-        attn = attend(q, k, v)
-        x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"].astype(h.dtype))
+        if head_major:
+            from jax.ad_checkpoint import checkpoint_name
+
+            q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ekd->bksd", h, lp["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ekd->bksd", h, lp["wv"].astype(h.dtype))
+            # post-rope q/k are named so the flash remat policy can save
+            # them — backward then reads them instead of re-deriving
+            # qkv-matmul + rope per layer
+            q = checkpoint_name(apply_rope_bhsd(q, cos, sin), "rope_q")
+            k = checkpoint_name(apply_rope_bhsd(k, cos, sin), "rope_k")
+            q = _constrain(q, "batch", "heads", "seq", "head_dim")
+            attn = attend(q, k, v)
+            x = x + jnp.einsum("bhsd,hde->bse", attn, lp["wo"].astype(h.dtype))
+        else:
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            q = _constrain(q, "batch", "seq", "heads", "head_dim")
+            attn = attend(q, k, v)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"].astype(h.dtype))
         h2 = rms_norm(x, lp["mlp_norm"])
         x = x + _mlp(h2, lp, cfg, _constrain)
         x = _constrain(x, "batch", "seq", "embed")
         return x, None
 
-    step = jax.checkpoint(layer_step) if cfg.remat else layer_step
+    if cfg.remat:
+        cp = jax.checkpoint_policies
+        policies = {
+            "full": None,
+            "dots": cp.checkpoint_dots,
+            "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+            "flash": cp.save_from_both_policies(
+                cp.dots_with_no_batch_dims_saveable,
+                cp.save_only_these_names(
+                    "flash_out", "flash_lse", "rope_q", "rope_k"
+                ),
+            ),
+        }
+        policy = policies[cfg.remat_policy]
+        step = jax.checkpoint(layer_step, policy=policy)
+    else:
+        step = layer_step
 
     def _apply_layers(params, x):
         if cfg.pp_stages > 1:
@@ -315,9 +371,20 @@ def make_forward(
         x, _ = lax.scan(step, x, params["layers"])
         return x
 
+    _MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router")
+
     def forward(params, tokens):
         x = params["embed"].astype(cfg.dtype)[tokens]
         x = _constrain(x, "batch", "seq", "embed")
+        # cast the stacked matmul weights to compute dtype ONCE — otherwise
+        # XLA re-converts the f32 masters on every scan iteration and again
+        # per remat pass (~5% of step time on the 125M bench); norm scales
+        # stay f32 (rms_norm computes in f32 anyway)
+        layers = dict(params["layers"])
+        for key in _MATMUL_KEYS:
+            if key in layers:
+                layers[key] = layers[key].astype(cfg.dtype)
+        params = {**params, "layers": layers}
         x = _apply_layers(params, x)
         x = rms_norm(x, params["final_norm"])
         unembed = params.get("unembed")

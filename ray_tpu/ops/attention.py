@@ -22,6 +22,40 @@ def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
+def _repeat_kv_bhsd(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    if n_rep == 1:
+        return k
+    b, h, s, d = k.shape
+    return jnp.broadcast_to(k[:, :, None, :, :], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)
+
+
+def causal_attention_bhsd(
+    q: jnp.ndarray,  # [B, H, Lq, D]
+    k: jnp.ndarray,  # [B, Hkv, Lk, D]
+    v: jnp.ndarray,  # [B, Hkv, Lk, D]
+    *,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    q_offset: int = 0,
+) -> jnp.ndarray:
+    """Head-major dense attention: same math as causal_attention but the
+    whole computation stays in [B, H, L, D], the layout the MXU and the
+    Pallas kernels want — no relayout transposes on the hot path."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = _repeat_kv_bhsd(k, q.shape[1] // k.shape[1])
+    v = _repeat_kv_bhsd(v, q.shape[1] // v.shape[1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        qpos = jnp.arange(lq)[:, None] + q_offset
+        kpos = jnp.arange(lk)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
 def causal_attention(
     q: jnp.ndarray,  # [B, Lq, H, D]
     k: jnp.ndarray,  # [B, Lk, Hkv, D]

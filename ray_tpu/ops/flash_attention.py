@@ -14,10 +14,13 @@ one q block (the sequential-last-dim contract of Pallas TPU grids). Causal
 skipping is predicated per block pair — fully-masked pairs never touch the
 MXU.
 
-Backward is a custom VJP: the kernel saves the log-sum-exp row statistics;
-gradients are recomputed blockwise (a lax.scan over KV blocks) so backward
-memory is O(L * BLOCK_K) instead of O(L^2) — same rematerialization trade
-FlashAttention makes on GPU.
+Backward is a custom VJP with two more Pallas kernels (FlashAttention-2
+structure): a dq kernel (grid over q blocks, kv innermost, dq accumulator in
+VMEM) and a dk/dv kernel (grid over kv blocks, q innermost). Probabilities
+are recomputed from the saved log-sum-exp rows, so backward memory is
+O(L * BLOCK) instead of O(L^2) and all four matmuls per block pair run on
+the MXU in f32 accumulation. Causally-dead block pairs are skipped in both
+kernels.
 """
 
 from __future__ import annotations
@@ -52,9 +55,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *, scale, cau
 
     @pl.when(run)
     def _attend():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        # matmul inputs stay bf16 (f32 operands run the MXU at a fraction of
+        # bf16 rate); accumulation is f32 via preferred_element_type
+        q = q_ref[0, 0, :, :]  # [BQ, D]
+        k = k_ref[0, 0, :, :]  # [BK, D]
+        v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK]
@@ -69,7 +74,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *, scale, cau
         l_i[:] = alpha * l_i[:] + jnp.sum(p, axis=1, keepdims=True)
         m_i[:] = m_new
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == nk - 1)
@@ -83,14 +89,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *, scale, cau
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q/k/v in [B, L, H, D]; kernel runs in [B, H, L, D] (Mosaic requires
+    """q/k/v in [B, H, L, D] — the kernel's native layout (Mosaic requires
     the last two BLOCK dims to tile (8, 128) or equal the array dims, so L
-    and D must be innermost). Returns out [B, Lq, H, D], lse [B, H, Lq]."""
-    b, lq, h, d = q.shape
-    lk = k.shape[1]
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
+    and D must be innermost). Returns out [B, H, Lq, D], lse [B, H, Lq]."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    qt, kt, vt = q, k, v
     nq = lq // block_q
     nk = lk // block_k
     grid = (b, h, nq, nk)
@@ -120,46 +124,151 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse[..., 0]
+    return out, lse[..., 0]
 
 
-def _flash_backward(scale, causal, block_k, res, do):
-    """Blockwise recompute backward (plain JAX, O(L*BLOCK_K) live memory)."""
-    q, k, v, out, lse = res
-    b, lq, h, d = q.shape
-    lk = k.shape[1]
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    # Delta_i = rowsum(dO * O)  [B, L, H]
-    delta = jnp.einsum("blhd,blhd->blh", dof, out.astype(jnp.float32))
-    qpos = jnp.arange(lq)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+               *, scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    nk = lk // block_k
-    kfb = kf.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
-    vfb = vf.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def kv_step(dq_acc, inp):
-        j, k_j, v_j = inp  # [B, BK, H, D]
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_j) * scale
+    run = (not causal) or (qi * block_q + block_q - 1 >= kj * block_k)
+
+    @pl.when(run)
+    def _accum():
+        q = q_ref[0, 0, :, :]                          # [BQ, D] bf16
+        k = k_ref[0, 0, :, :]                          # [BK, D]
+        v = v_ref[0, 0, :, :]                          # [BK, D]
+        do = do_ref[0, 0, :, :]                        # [BQ, D]
+        lse = lse_ref[0, 0, :, :]                      # [BQ, 1]
+        delta = delta_ref[0, 0, :, :]                  # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
         if causal:
-            kpos = j * block_k + jnp.arange(block_k)
-            s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, :, :, None])  # [B, H, L, BK]
-        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, v_j)
-        ds = p * (dp - delta.transpose(0, 2, 1)[:, :, :, None])  # [B,H,L,BK]
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, k_j) * scale
-        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-        return dq_acc, (dk_j, dv_j)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                           # [BQ, BK] f32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        kv_step, jnp.zeros_like(qf), (jnp.arange(nk), kfb, vfb)
-    )
-    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
-    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (not causal) or (qi * block_q + block_q - 1 >= kj * block_k)
+
+    @pl.when(run)
+    def _accum():
+        q = q_ref[0, 0, :, :]                          # [BQ, D] bf16
+        k = k_ref[0, 0, :, :]                          # [BK, D]
+        v = v_ref[0, 0, :, :]                          # [BK, D]
+        do = do_ref[0, 0, :, :]                        # [BQ, D]
+        lse = lse_ref[0, 0, :, :]                      # [BQ, 1]
+        delta = delta_ref[0, 0, :, :]                  # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                           # [BQ, BK] f32
+        pb = p.astype(do.dtype)
+        # dV += P^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        # dK += dS^T @ Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(scale, causal, block_q, block_k, interpret, res, do):
+    """FlashAttention-2 backward: two Pallas kernels over [B, H, L, D]."""
+    q, k, v, out, lse = res
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    qt, kt, vt, dot = q, k, v, do
+    # Delta_i = rowsum(dO * O)  [B, H, L, 1]
+    delta = jnp.einsum(
+        "bhld,bhld->bhl", do.astype(jnp.float32), out.astype(jnp.float32)
+    )[..., None]
+    lse4 = lse[..., None]  # [B, H, L, 1]
+    nq = lq // block_q
+    nk = lk // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, lq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse4, delta)[0]
+
+    # kv kernel: q innermost so the dk/dv accumulators persist per kv block
+    qi_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kj_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    rowi_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, rowi_spec, rowi_spec],
+        out_specs=[kj_spec, kj_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse4, delta)
+
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -169,19 +278,25 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    # name the residuals so remat policies can SAVE them — without this the
+    # forward kernel re-runs inside backward just to regenerate lse
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    return _flash_backward(scale, causal, block_k, res, do)
+    return _flash_backward(scale, causal, block_q, block_k, interpret, res, do)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
-    q: jnp.ndarray,  # [B, Lq, H, D]
+    q: jnp.ndarray,  # [B, Lq, H, D]  (or [B, H, Lq, D] with layout="bhsd")
     k: jnp.ndarray,  # [B, Lk, Hkv, D]
     v: jnp.ndarray,  # [B, Lk, Hkv, D]
     *,
@@ -190,21 +305,38 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    layout: str = "bshd",
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.attention.causal_attention on block-
     aligned shapes; GQA handled by repeating KV heads outside the kernel
     (gradients flow through the broadcast). Falls back to the dense einsum
-    path when the sequence doesn't tile evenly."""
-    from .attention import causal_attention, _repeat_kv
+    path when the sequence doesn't tile evenly.
+
+    layout="bhsd" runs the kernel on head-major inputs with NO relayout —
+    the fast path the model uses (transposes around the kernel cost more
+    than the attention itself at small d_head)."""
+    from .attention import causal_attention, causal_attention_bhsd, _repeat_kv, _repeat_kv_bhsd
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
-    if q.shape[1] % block_q or k.shape[1] % block_k:
-        return causal_attention(q, k, v, scale=scale, causal=causal)
-    n_rep = q.shape[2] // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
+    seq_axis = 2 if layout == "bhsd" else 1
+    head_axis = 1 if layout == "bhsd" else 2
+    block_q = min(block_q, q.shape[seq_axis])
+    block_k = min(block_k, k.shape[seq_axis])
+    if q.shape[seq_axis] % block_q or k.shape[seq_axis] % block_k:
+        dense = causal_attention_bhsd if layout == "bhsd" else causal_attention
+        return dense(q, k, v, scale=scale, causal=causal)
+    n_rep = q.shape[head_axis] // k.shape[head_axis]
+    rep = _repeat_kv_bhsd if layout == "bhsd" else _repeat_kv
+    k = rep(k, n_rep)
+    v = rep(v, n_rep)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    if layout == "bhsd":
+        return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    out = _flash(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        scale, causal, block_q, block_k, interpret,
+    )
+    return out.transpose(0, 2, 1, 3)
