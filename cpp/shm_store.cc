@@ -2,14 +2,17 @@
 //
 // Reference parity: src/ray/object_manager/plasma (PlasmaStore store.h:55,
 // ObjectLifecycleManager, eviction_policy.h) — redesigned for the TPU-host
-// shape: instead of a separate store daemon + unix-socket IPC + dlmalloc
-// slabs, each object is one POSIX shm segment created by the producing
-// process and mapped read-only by consumers (zero-copy numpy/jax host
-// buffers). A small shared control segment carries the capacity ledger and
+// shape: instead of a separate store daemon + unix-socket IPC, all objects
+// live in ONE session-wide POSIX shm slab with an offset allocator, and a
+// shared control segment carries the allocation table, capacity ledger, and
 // per-object refcounts/seal state so any process can admit, pin, and evict
-// without a broker round-trip. Coordination (who owns which id, when to
-// free) stays in the head's ObjectDirectory, exactly like the reference
-// keeps location metadata in the owner/GCS rather than in plasma itself.
+// without a broker round-trip. The slab is the same trick as plasma's
+// pre-mapped dlmalloc arena: freed pages stay faulted-in and warm, so a
+// steady-state put runs at memcpy speed (~12 GB/s here) instead of paying
+// first-touch zero-fill faults per object (~0.8 GB/s measured).
+// Coordination (who owns which id, when to free) stays in the head's
+// ObjectDirectory, exactly like the reference keeps location metadata in
+// the owner/GCS rather than in plasma itself.
 //
 // Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lrt -pthread
 
@@ -18,39 +21,89 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 
+#include <ctime>
 #include <fcntl.h>
+#include <pthread.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint32_t kMagic = 0x52545055;  // "RTPU"
-constexpr int kMaxObjects = 1 << 16;
+constexpr uint32_t kMagic = 0x52545057;  // "RTPW" (v3: slab + robust mutex)
+constexpr int kMaxObjects = 1 << 14;
 constexpr int kNameLen = 48;
+constexpr int64_t kAlign = 4096;
 
 struct ObjectEntry {
-  char name[kNameLen];          // shm segment name ("" = free slot)
+  char name[kNameLen];          // object id ("" = free slot)
   std::atomic<int64_t> size;    // payload bytes
+  std::atomic<int64_t> offset;  // into the data slab
   std::atomic<int32_t> refs;    // process-shared pin count
   std::atomic<int32_t> sealed;  // 0 = being written, 1 = immutable
+  std::atomic<int32_t> pinned;  // never evicted (no lineage: ray.put data)
   std::atomic<int64_t> last_use_ns;
+};
+
+struct AllocRange {
+  int64_t off;
+  int64_t size;
 };
 
 struct ControlBlock {
   uint32_t magic;
+  std::atomic<int32_t> mu_state;  // 0 = uninit, 1 = initializing, 2 = ready
+  pthread_mutex_t mu;             // robust, process-shared: guards ranges[]
+                                  // + entry alloc; survives owner death
   std::atomic<int64_t> capacity;
   std::atomic<int64_t> used;
   std::atomic<int64_t> num_objects;
   std::atomic<int64_t> clock_ns;  // logical clock for LRU
+  int64_t nranges;                // live allocations, sorted by off
+  AllocRange ranges[kMaxObjects];
   ObjectEntry entries[kMaxObjects];
 };
 
 struct StoreHandle {
   ControlBlock* ctrl;
   char prefix[kNameLen];
+  void* data_rw;
+  void* data_ro;
+  int64_t data_len;
 };
+
+void init_mutex(ControlBlock* cb) {
+  int32_t expect = 0;
+  if (cb->mu_state.compare_exchange_strong(expect, 1)) {
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    // ROBUST: a producer SIGKILLed while holding the lock must not wedge
+    // every other process's object store — the next locker gets
+    // EOWNERDEAD and recovers (the previous per-segment design was
+    // lock-free; the slab allocator needs this instead)
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&cb->mu, &attr);
+    pthread_mutexattr_destroy(&attr);
+    cb->mu_state.store(2);
+  } else {
+    while (cb->mu_state.load() != 2) sched_yield();
+  }
+}
+
+void lock_cb(ControlBlock* cb) {
+  int r = pthread_mutex_lock(&cb->mu);
+  if (r == EOWNERDEAD) {
+    // owner died mid-section; the range table is best-effort consistent
+    // (memmove of POD ranges) — mark recovered and continue
+    pthread_mutex_consistent(&cb->mu);
+  }
+}
+
+void unlock_cb(ControlBlock* cb) { pthread_mutex_unlock(&cb->mu); }
 
 uint64_t fnv1a(const char* s) {
   uint64_t h = 1469598103934665603ull;
@@ -73,7 +126,6 @@ ObjectEntry* find_entry(ControlBlock* cb, const char* name, bool create) {
     if (e->name[0] == '\0') {
       if (!create) return nullptr;
       ObjectEntry* slot = first_tomb ? first_tomb : e;
-      // claim the slot (benign race: callers create unique names)
       memset(slot->name, 0, kNameLen);
       strncpy(slot->name, name, kNameLen - 1);
       return slot;
@@ -87,8 +139,72 @@ ObjectEntry* find_entry(ControlBlock* cb, const char* name, bool create) {
   return nullptr;
 }
 
-int64_t now_tick(ControlBlock* cb) {
-  return cb->clock_ns.fetch_add(1) + 1;
+int64_t now_tick(ControlBlock* cb) { return cb->clock_ns.fetch_add(1) + 1; }
+
+// First-fit allocation over the sorted range table. Caller holds the lock.
+int64_t slab_alloc(ControlBlock* cb, int64_t size) {
+  if (cb->nranges >= kMaxObjects) return -1;
+  int64_t prev_end = 0;
+  int insert_at = (int)cb->nranges;
+  int64_t off = -1;
+  for (int i = 0; i < cb->nranges; ++i) {
+    if (cb->ranges[i].off - prev_end >= size) {
+      off = prev_end;
+      insert_at = i;
+      break;
+    }
+    prev_end = cb->ranges[i].off + cb->ranges[i].size;
+  }
+  if (off < 0) {
+    if (cb->capacity.load() - prev_end < size) return -1;
+    off = prev_end;
+  }
+  memmove(&cb->ranges[insert_at + 1], &cb->ranges[insert_at],
+          (cb->nranges - insert_at) * sizeof(AllocRange));
+  cb->ranges[insert_at] = {off, size};
+  cb->nranges++;
+  return off;
+}
+
+void slab_free(ControlBlock* cb, int64_t off) {
+  for (int i = 0; i < cb->nranges; ++i) {
+    if (cb->ranges[i].off == off) {
+      memmove(&cb->ranges[i], &cb->ranges[i + 1],
+              (cb->nranges - i - 1) * sizeof(AllocRange));
+      cb->nranges--;
+      return;
+    }
+  }
+}
+
+// Maps the session data slab into this process (once per protection mode).
+// Guarded by a process-local mutex: the pretouch thread and producer threads
+// (ctypes calls release the GIL) may race here.
+std::mutex g_map_mutex;
+
+void* ensure_data_map(StoreHandle* h, bool writable) {
+  std::lock_guard<std::mutex> guard(g_map_mutex);
+  void*& slot = writable ? h->data_rw : h->data_ro;
+  if (slot != nullptr) return slot;
+  char seg[kNameLen * 2];
+  snprintf(seg, sizeof(seg), "%s_data", h->prefix);
+  int64_t cap = h->ctrl->capacity.load();
+  int fd = shm_open(seg, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) == 0 && st.st_size < cap) {
+    if (ftruncate(fd, cap) != 0) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  void* mem = mmap(nullptr, cap, writable ? (PROT_READ | PROT_WRITE) : PROT_READ,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  h->data_len = cap;
+  slot = mem;
+  return mem;
 }
 
 }  // namespace
@@ -110,15 +226,17 @@ void* shm_store_connect(const char* session, int64_t capacity_bytes) {
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   auto* cb = static_cast<ControlBlock*>(mem);
-  uint32_t expected = 0;
   if (cb->magic != kMagic) {
     cb->capacity.store(capacity_bytes);
     cb->magic = kMagic;
   }
+  init_mutex(cb);
   auto* h = new StoreHandle;
   h->ctrl = cb;
+  h->data_rw = nullptr;
+  h->data_ro = nullptr;
+  h->data_len = 0;
   snprintf(h->prefix, sizeof(h->prefix), "/rtpu_%s", session);
-  (void)expected;
   return h;
 }
 
@@ -132,48 +250,35 @@ int64_t shm_store_used(void* handle) {
 
 // Creates an object buffer; returns writable pointer (caller must seal).
 // Returns nullptr if capacity would be exceeded (caller may evict+retry).
-void* shm_store_create(void* handle, const char* object_name, int64_t size) {
+void* shm_store_create(void* handle, const char* object_name, int64_t size,
+                       int32_t pin) {
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
-  int64_t used = cb->used.fetch_add(size);
-  if (used + size > cb->capacity.load()) {
-    cb->used.fetch_sub(size);
-    return nullptr;
-  }
-  char seg[kNameLen * 2];
-  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
-  int fd = shm_open(seg, O_CREAT | O_EXCL | O_RDWR, 0600);
-  if (fd < 0) {
-    cb->used.fetch_sub(size);
-    return nullptr;
-  }
-  if (ftruncate(fd, size ? size : 1) != 0) {
-    close(fd);
-    shm_unlink(seg);
-    cb->used.fetch_sub(size);
-    return nullptr;
-  }
-  void* mem = mmap(nullptr, size ? size : 1, PROT_READ | PROT_WRITE,
-                   MAP_SHARED, fd, 0);
-  close(fd);
-  if (mem == MAP_FAILED) {
-    shm_unlink(seg);
-    cb->used.fetch_sub(size);
+  char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
+  if (base == nullptr) return nullptr;
+  int64_t alloc_size = size ? (size + kAlign - 1) / kAlign * kAlign : kAlign;
+  lock_cb(cb);
+  int64_t off = slab_alloc(cb, alloc_size);
+  if (off < 0) {
+    unlock_cb(cb);
     return nullptr;
   }
   ObjectEntry* e = find_entry(cb, object_name, /*create=*/true);
   if (e == nullptr) {
-    munmap(mem, size ? size : 1);
-    shm_unlink(seg);
-    cb->used.fetch_sub(size);
+    slab_free(cb, off);
+    unlock_cb(cb);
     return nullptr;
   }
   e->size.store(size);
+  e->offset.store(off);
   e->refs.store(1);
   e->sealed.store(0);
+  e->pinned.store(pin);
   e->last_use_ns.store(now_tick(cb));
+  cb->used.fetch_add(alloc_size);
   cb->num_objects.fetch_add(1);
-  return mem;
+  unlock_cb(cb);
+  return base + off;
 }
 
 int shm_store_seal(void* handle, const char* object_name) {
@@ -187,50 +292,82 @@ int shm_store_seal(void* handle, const char* object_name) {
 // Maps a sealed object read-only; returns pointer, sets *size_out.
 void* shm_store_get(void* handle, const char* object_name, int64_t* size_out) {
   auto* h = static_cast<StoreHandle*>(handle);
-  ObjectEntry* e = find_entry(h->ctrl, object_name, false);
-  if (e == nullptr || !e->sealed.load()) return nullptr;
-  char seg[kNameLen * 2];
-  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
-  int fd = shm_open(seg, O_RDONLY, 0600);
-  if (fd < 0) return nullptr;
-  int64_t size = e->size.load();
-  void* mem = mmap(nullptr, size ? size : 1, PROT_READ, MAP_SHARED, fd, 0);
-  close(fd);
-  if (mem == MAP_FAILED) return nullptr;
-  e->refs.fetch_add(1);
-  e->last_use_ns.store(now_tick(h->ctrl));
-  *size_out = size;
-  return mem;
-}
-
-// Unmaps a previously created/got mapping and drops its pin.
-int shm_store_release(void* handle, const char* object_name, void* mem) {
-  auto* h = static_cast<StoreHandle*>(handle);
-  ObjectEntry* e = find_entry(h->ctrl, object_name, false);
-  if (e == nullptr) return -1;
-  int64_t size = e->size.load();
-  munmap(mem, size ? size : 1);
-  e->refs.fetch_sub(1);
-  return 0;
-}
-
-// Deletes the object (unlink + ledger update). Safe while readers hold
-// mappings (POSIX keeps pages until last munmap).
-int shm_store_delete(void* handle, const char* object_name) {
-  auto* h = static_cast<StoreHandle*>(handle);
+  char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/false));
+  if (base == nullptr) return nullptr;
   ControlBlock* cb = h->ctrl;
+  lock_cb(cb);  // vs concurrent delete reaping the entry mid-lookup
   ObjectEntry* e = find_entry(cb, object_name, false);
-  if (e == nullptr) return -1;
-  char seg[kNameLen * 2];
-  snprintf(seg, sizeof(seg), "%s_%s", h->prefix, object_name);
-  shm_unlink(seg);
-  cb->used.fetch_sub(e->size.load());
+  if (e == nullptr || e->sealed.load() != 1) {
+    unlock_cb(cb);
+    return nullptr;
+  }
+  e->refs.fetch_add(1);
+  e->last_use_ns.store(now_tick(cb));
+  *size_out = e->size.load();
+  int64_t off = e->offset.load();
+  unlock_cb(cb);
+  return base + off;
+}
+
+namespace {
+
+// Caller holds the lock. Frees the slab range and clears the entry.
+void reap_entry(ControlBlock* cb, ObjectEntry* e) {
+  int64_t size = e->size.load();
+  int64_t alloc_size = size ? (size + kAlign - 1) / kAlign * kAlign : kAlign;
+  slab_free(cb, e->offset.load());
+  cb->used.fetch_sub(alloc_size);
   cb->num_objects.fetch_sub(1);
   e->size.store(0);
   e->sealed.store(0);
   e->refs.store(0);
   e->name[0] = kTombstone;  // keep probe chains intact
   e->name[1] = '\0';
+}
+
+constexpr int32_t kPendingDelete = 2;  // sealed-state: delete when refs hit 0
+
+}  // namespace
+
+// Drops a pin taken by create/get. The slab mapping is process-wide and
+// persists; nothing to unmap per object. Completes a deferred delete when
+// the last pin goes away.
+int shm_store_release(void* handle, const char* object_name, void* mem) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  (void)mem;
+  lock_cb(cb);
+  ObjectEntry* e = find_entry(cb, object_name, false);
+  if (e == nullptr) {
+    unlock_cb(cb);
+    return -1;
+  }
+  if (e->refs.fetch_sub(1) == 1 && e->sealed.load() == kPendingDelete) {
+    reap_entry(cb, e);
+  }
+  unlock_cb(cb);
+  return 0;
+}
+
+// Deletes the object (slab range freed + ledger update). If readers still
+// pin it, the range is NOT reclaimed until the last pin is released —
+// unlike the per-segment design, a freed slab range can be reused by a new
+// object, so handing it out under a live reader would corrupt data.
+int shm_store_delete(void* handle, const char* object_name) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  lock_cb(cb);
+  ObjectEntry* e = find_entry(cb, object_name, false);
+  if (e == nullptr) {
+    unlock_cb(cb);
+    return -1;
+  }
+  if (e->refs.load() > 0) {
+    e->sealed.store(kPendingDelete);  // reaped on last release
+  } else {
+    reap_entry(cb, e);
+  }
+  unlock_cb(cb);
   return 0;
 }
 
@@ -246,8 +383,8 @@ int64_t shm_store_evict(void* handle, int64_t want_bytes) {
     int64_t best_tick = INT64_MAX;
     for (int i = 0; i < kMaxObjects; ++i) {
       ObjectEntry* e = &cb->entries[i];
-      if (e->name[0] && e->name[0] != kTombstone && e->sealed.load() &&
-          e->refs.load() <= 1) {
+      if (e->name[0] && e->name[0] != kTombstone && e->sealed.load() == 1 &&
+          e->refs.load() <= 0 && !e->pinned.load()) {
         int64_t t = e->last_use_ns.load();
         if (t < best_tick) {
           best_tick = t;
@@ -256,25 +393,75 @@ int64_t shm_store_evict(void* handle, int64_t want_bytes) {
       }
     }
     if (best == nullptr) break;
-    freed += best->size.load();
     char name_copy[kNameLen];
     strncpy(name_copy, best->name, kNameLen);
+    // count what was ACTUALLY reclaimed (a racing reader pin defers the
+    // reap; payload size also under-states the page-aligned allocation)
+    int64_t used_before = cb->used.load();
     shm_store_delete(handle, name_copy);
+    int64_t got = used_before - cb->used.load();
+    if (got <= 0) break;  // victim became pinned: no progress
+    freed += got;
   }
   return freed;
 }
 
+// Pre-faults the whole data slab (write one byte per page). Run once per
+// machine from a background thread at head startup — after this, creates
+// run at memcpy speed instead of paying first-touch zero-fill (plasma
+// pre-touches its dlmalloc arena the same way). Returns bytes touched.
+int64_t shm_store_pretouch(void* handle) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
+  if (base == nullptr) return 0;
+  int64_t cap = cb->capacity.load();
+  constexpr int64_t kChunk = 8ll << 20;  // touch 8MB per lock hold
+  struct timespec nap = {0, 30 * 1000 * 1000};
+  int64_t touched = 0;
+  for (int64_t start = 0; start < cap; start += kChunk) {
+    int64_t end = start + kChunk < cap ? start + kChunk : cap;
+    // Touch ONLY while holding the allocator lock and ONLY chunks that
+    // overlap no live allocation: a write-back into a producer's range
+    // would race its memcpy and corrupt sealed data. Allocated ranges are
+    // already faulted by their producers anyway.
+    lock_cb(cb);
+    bool overlaps = false;
+    for (int i = 0; i < cb->nranges; ++i) {
+      if (cb->ranges[i].off < end &&
+          cb->ranges[i].off + cb->ranges[i].size > start) {
+        overlaps = true;
+        break;
+      }
+    }
+    if (!overlaps) {
+      for (int64_t off = start; off < end; off += 4096) {
+        volatile char* p = base + off;
+        *p = 0;
+      }
+      touched += end - start;
+    }
+    unlock_cb(cb);
+    nanosleep(&nap, nullptr);  // ~8MB / 30ms: stays off foreground cores
+  }
+  return touched;
+}
+
 void shm_store_disconnect(void* handle) {
   auto* h = static_cast<StoreHandle*>(handle);
+  if (h->data_rw) munmap(h->data_rw, h->data_len);
+  if (h->data_ro) munmap(h->data_ro, h->data_len);
   munmap(h->ctrl, sizeof(ControlBlock));
   delete h;
 }
 
-// Destroys the session's control segment (head calls at shutdown).
+// Destroys the session's control + data segments (head calls at shutdown).
 void shm_store_destroy(const char* session) {
-  char ctrl_name[kNameLen];
-  snprintf(ctrl_name, sizeof(ctrl_name), "/rtpu_%s_ctrl", session);
-  shm_unlink(ctrl_name);
+  char name[kNameLen];
+  snprintf(name, sizeof(name), "/rtpu_%s_ctrl", session);
+  shm_unlink(name);
+  snprintf(name, sizeof(name), "/rtpu_%s_data", session);
+  shm_unlink(name);
 }
 
 }  // extern "C"

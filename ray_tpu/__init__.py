@@ -136,10 +136,12 @@ def shutdown():
             global_worker.io.run(global_worker.conn.close(), timeout=2)
         except Exception:
             pass
-    if global_worker.node is not None:
-        global_worker.node.stop()
-    global_worker.node = None
+    node, global_worker.node = global_worker.node, None
+    # disconnect first: direct actor channels close while the IO loop is
+    # still running (node.stop() tears the loop down)
     global_worker.disconnect()
+    if node is not None:
+        node.stop()
 
 
 def is_initialized() -> bool:

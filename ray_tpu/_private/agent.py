@@ -63,6 +63,7 @@ class Agent:
 
             try:
                 self._shm = ShmClient(self.shm_session, cfg.shm_store_bytes)
+                self._shm.pretouch_async()  # one pretouch per node slab
             except Exception:
                 self._shm = None
         return self._shm

@@ -88,6 +88,9 @@ D("shm_store_enabled", bool, True)
 D("get_poll_timeout_s", float, 0.2)
 D("actor_restart_delay_ms", int, 100)
 D("worker_pool_prestart", int, 0, "workers to prestart per node at init")
+D("direct_actor_calls", bool, True,
+  "push actor calls straight to the actor's worker (head only resolves the "
+  "route); falls back to head-mediated dispatch per actor on failure")
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")

@@ -79,6 +79,9 @@ class WorkerRecord:
     registered: Optional[asyncio.Future] = None
     num_running: int = 0
     pooled: bool = True
+    # caller->worker push endpoint (unix path or host:port) for the direct
+    # actor-call transport (direct_actor_task_submitter.h:67)
+    direct_address: Optional[str] = None
 
 
 @dataclass
@@ -189,6 +192,7 @@ class ObjectDirectory:
         self.task_pins: collections.Counter = collections.Counter()
         self.errors: Dict[str, Any] = {}
         self.on_free = on_free  # called with the envelope when freed
+        self.on_free_oid = None  # called with the object id when freed
 
     def _event(self, oid: str) -> asyncio.Event:
         ev = self.events.get(oid)
@@ -199,6 +203,14 @@ class ObjectDirectory:
     def put(self, oid: str, envelope: Any):
         self.objects[oid] = envelope
         self._event(oid).set()
+
+    def invalidate(self, oid: str):
+        """Drop a stale envelope (its shm buffers were lost) so waiters
+        block until reconstruction re-puts it. Refcounts are untouched."""
+        self.objects.pop(oid, None)
+        ev = self.events.get(oid)
+        if ev is not None:
+            ev.clear()
 
     def contains(self, oid: str) -> bool:
         return oid in self.objects
@@ -228,11 +240,19 @@ class ObjectDirectory:
     def _maybe_free(self, oid: str):
         if self.refcounts[oid] <= 0 and self.task_pins[oid] <= 0:
             env = self.objects.pop(oid, None)
+            if env is None and self.refcounts[oid] < 0:
+                # a remove_refs outran its object's arrival (direct-path
+                # results carry the caller's +1 on the put itself): keep
+                # the debt so the late put reconciles to zero and frees
+                self.task_pins.pop(oid, None)
+                return
             self.events.pop(oid, None)
             self.refcounts.pop(oid, None)
             self.task_pins.pop(oid, None)
             if env is not None and self.on_free is not None:
                 self.on_free(env)
+            if self.on_free_oid is not None:
+                self.on_free_oid(oid, None)
 
 
 # --------------------------------------------------------------------------
@@ -268,6 +288,12 @@ class Head:
         self.job_config: Dict[str, Any] = {}
         self._shm = None
         self._shm_tried = False
+        # lineage: return-object id -> creating task id (stateless tasks
+        # only; reference: task_manager.h:164 lineage pinning). Entries die
+        # with their object's last reference.
+        self.object_lineage: Dict[str, str] = {}
+        self._reconstructing: Dict[str, asyncio.Future] = {}
+        self.objects.on_free_oid = self.object_lineage.pop
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
         self.metrics_store: Dict[str, dict] = {}
         # submitted jobs: submission_id -> record (entrypoint subprocess)
@@ -283,6 +309,10 @@ class Head:
             from .shm import connect_for_session
 
             self._shm = connect_for_session(self.session_dir)
+            if self._shm is not None:
+                # one pretouch per machine: producers then run at memcpy
+                # speed instead of paying first-touch faults per put
+                self._shm.pretouch_async()
         return self._shm
 
     def _free_shm_buffers(self, env):
@@ -346,6 +376,7 @@ class Head:
         plane; reference: grpc_server.h:73). The bound host:port is written
         to <session_dir>/head_addr for discovery by `init(address=...)`."""
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
+        self._shm_client()  # connect early: kicks off the slab pretouch
         host = tcp_host if tcp_host is not None else cfg.head_tcp_host
         port = tcp_port if tcp_port is not None else cfg.head_tcp_port
         try:
@@ -479,12 +510,29 @@ class Head:
         if w is None:
             raise ValueError(f"unknown worker {msg['worker_id']}")
         w.conn = conn
+        w.direct_address = msg.get("direct_address")
         if w.state == "starting":
             w.state = "idle"
         if w.registered is not None and not w.registered.done():
             w.registered.set_result(None)
         self._pump()
         return {"node_id": w.node_id, "session_dir": self.session_dir}
+
+    async def _h_get_actor_route(self, conn, msg):
+        """Direct-transport route lookup: where does this actor live RIGHT
+        NOW? Callers cache the answer and re-resolve on connection failure
+        (actor restarts move it)."""
+        rec = self.actors.get(msg["actor_id"])
+        if rec is None:
+            return None
+        w = self.workers.get(rec.worker_id or "")
+        return {
+            "state": rec.state,
+            "worker_id": rec.worker_id,
+            "node_id": None if w is None else w.node_id,
+            "address": None if w is None else w.direct_address,
+            "death_reason": rec.death_reason,
+        }
 
     # --- KV (GcsKVManager) ---
 
@@ -516,6 +564,9 @@ class Head:
         oid = msg["object_id"]
         self.objects.put(oid, msg["envelope"])
         self.objects.add_ref(oid, msg.get("initial_refs", 1))
+        # direct-transport results carry the caller's +1 here; if the caller
+        # already dropped its ref (counter went negative), reconcile now
+        self.objects._maybe_free(oid)
 
     async def _h_get_objects(self, conn, msg):
         ids: List[str] = msg["object_ids"]
@@ -584,6 +635,10 @@ class Head:
 
     async def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
+        # the caller's +1 on each return id, folded into the submit message
+        for oid in spec["return_ids"]:
+            self.objects.add_ref(oid, 1)
+            self.object_lineage[oid] = spec["task_id"]
         rec = TaskRecord(
             spec=spec,
             retries_left=spec.get("max_retries", 0),
@@ -601,6 +656,57 @@ class Head:
         rec.mark("pending")
         self.pending_queue.append(rec)
         self._pump()
+
+    # --- lineage reconstruction (object_recovery_manager.h:41) ---
+
+    async def _h_reconstruct_objects(self, conn, msg):
+        """A consumer hit ObjectLostError (shm eviction / node death): re-run
+        the creating tasks and wait until the objects exist again."""
+        results = {}
+        for oid in msg["object_ids"]:
+            try:
+                await self._reconstruct(oid)
+                results[oid] = True
+            except Exception:
+                results[oid] = False
+        return results
+
+    async def _reconstruct(self, oid: str):
+        fut = self._reconstructing.get(oid)
+        if fut is not None:
+            return await fut
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._reconstructing[oid] = fut
+        try:
+            tid = self.object_lineage.get(oid)
+            rec = self.tasks.get(tid or "")
+            if rec is None:
+                from ..exceptions import ObjectLostError
+
+                raise ObjectLostError(oid)
+            # deps whose ENVELOPES are gone must be reconstructed first
+            # (deps with stale buffers surface as lost_deps at execution
+            # and loop back through here)
+            for dep in rec.spec.get("deps", []):
+                if not self.objects.contains(dep):
+                    await self._reconstruct(dep)
+            for rid in rec.spec["return_ids"]:
+                self.objects.invalidate(rid)
+            for dep in rec.spec.get("deps", []):
+                self.objects.pin(dep)
+            rec.retries_left = max(rec.retries_left, rec.spec.get("max_retries", 0))
+            await self._resolve_and_enqueue(rec)
+            await self.objects.wait_available(oid)
+            fut.set_result(True)
+        except Exception as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._reconstructing.pop(oid, None)
+            # the future may never be awaited by anyone else
+            if fut.done() and fut.exception() is not None:
+                fut.exception()  # mark retrieved
 
     # --- actors ---
 
@@ -671,11 +777,16 @@ class Head:
 
     async def _h_submit_actor_task(self, conn, msg):
         spec = msg["spec"]
+        for oid in spec["return_ids"]:
+            self.objects.add_ref(oid, 1)
         rec = self.actors.get(spec["actor_id"])
         from ..exceptions import ActorDiedError
 
         if rec is None:
-            raise ActorDiedError(spec["actor_id"], "unknown actor")
+            # submits are fire-and-forget: surface the error through the
+            # return objects, not the (absent) reply channel
+            self._fail_task_returns(spec, ActorDiedError(spec["actor_id"], "unknown actor"))
+            return
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
         if rec.state == "dead":
@@ -712,10 +823,34 @@ class Head:
             )
         try:
             reply = await reply_fut
+            for _ in range(3):
+                lost = reply.get("lost_deps")
+                if not lost:
+                    break
+                # dep buffers evicted before the actor read them: the user
+                # method never ran, so reconstruct + resend is side-effect
+                # safe (same contract as the stateless-task path)
+                for oid in lost:
+                    await self._reconstruct(oid)
+                w = self.workers.get(rec.worker_id or "")
+                if w is None or w.conn is None or w.conn.closed:
+                    raise ConnectionError("actor worker gone during reconstruction")
+                reply = await w.conn.request(
+                    {
+                        "t": "run_task",
+                        "task_id": spec["task_id"],
+                        "actor_id": rec.actor_id,
+                        "method": spec["method"],
+                        "args": self._resolve_args(spec),
+                        "return_ids": spec["return_ids"],
+                    }
+                )
+            if "results" not in reply:
+                raise RuntimeError(f"unrecoverable deps for {spec['task_id']}")
         except Exception as e:
-            # Worker died mid-call; restart path handles backlog.
-            if rec.state == "alive":
-                self._fail_task_returns(spec, ActorDiedError(rec.actor_id, repr(e)))
+            # Worker died mid-call (restart path handles backlog) or deps
+            # were unrecoverable: fail the returns so consumers never hang.
+            self._fail_task_returns(spec, ActorDiedError(rec.actor_id, repr(e)))
             return
         finally:
             for oid in spec.get("deps", []):
@@ -1298,6 +1433,17 @@ class Head:
                 else:
                     await self._kill_worker(w, reason="non-poolable lease done")
                 self._pump()
+        if reply.get("lost_deps"):
+            # dep buffers were evicted under the worker: rebuild them from
+            # lineage and re-dispatch this task (pins stay held; not a retry)
+            for oid in reply["lost_deps"]:
+                try:
+                    await self._reconstruct(oid)
+                except Exception as e:
+                    await self._retry_or_fail(rec, e)
+                    return
+            await self._resolve_and_enqueue(rec)
+            return
         for oid in spec.get("deps", []):
             self.objects.unpin(oid)
         self._store_task_results(spec, reply)

@@ -104,11 +104,14 @@ def deserialize(obj: SerializedObject) -> Any:
     return pickle.loads(obj.payload, buffers=obj.buffers)
 
 
-def externalize(env: SerializedObject, shm_client, threshold: int) -> SerializedObject:
+def externalize(
+    env: SerializedObject, shm_client, threshold: int, pin: bool = False
+) -> SerializedObject:
     """Move large out-of-band buffers into the shared-memory store, replacing
     them with ShmBufferRef handles (zero-copy across host processes). Each
     handle is tagged with the producing node so cross-node consumers know
-    where the primary copy lives."""
+    where the primary copy lives. pin=True (ray.put data: no lineage) marks
+    the buffers never-evictable."""
     if shm_client is None:
         return env
     import uuid
@@ -119,7 +122,7 @@ def externalize(env: SerializedObject, shm_client, threshold: int) -> Serialized
     new_buffers = []
     for buf in env.buffers:
         if isinstance(buf, (bytes, memoryview)) and len(buf) >= threshold:
-            ref = shm_client.create(uuid.uuid4().hex, memoryview(buf))
+            ref = shm_client.create(uuid.uuid4().hex, memoryview(buf), pin=pin)
             if ref is not None:
                 ref.node = node
                 new_buffers.append(ref)

@@ -40,7 +40,9 @@ def _load_lib():
         lib.shm_store_connect.restype = ctypes.c_void_p
         lib.shm_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.shm_store_create.restype = ctypes.c_void_p
-        lib.shm_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.shm_store_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ]
         lib.shm_store_get.restype = ctypes.c_void_p
         lib.shm_store_get.argtypes = [
             ctypes.c_void_p,
@@ -58,6 +60,8 @@ def _load_lib():
         lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
         lib.shm_store_disconnect.argtypes = [ctypes.c_void_p]
         lib.shm_store_destroy.argtypes = [ctypes.c_char_p]
+        lib.shm_store_pretouch.restype = ctypes.c_int64
+        lib.shm_store_pretouch.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -109,24 +113,38 @@ class ShmClient:
         if not self.handle:
             raise OSError("failed to connect to shm store")
 
-    def create(self, name: str, data: memoryview | bytes) -> Optional[ShmBufferRef]:
+    def create(
+        self, name: str, data: memoryview | bytes, pin: bool = False
+    ) -> Optional[ShmBufferRef]:
         """Copy `data` into a new sealed shm object. Returns None when the
-        store is full — the caller falls back to the socket path; eviction is
-        NEVER triggered here (only the head, which knows the live-ref set,
-        may evict — evicting from a producer would drop objects that other
-        processes still reference)."""
+        store is full even after LRU eviction of unpinned sealed objects —
+        evicted ids are reconstructible from lineage (head.py), which is
+        what makes producer-side eviction safe; `pin=True` marks data with
+        NO lineage (ray.put) as never-evictable."""
         data = memoryview(data)
         size = data.nbytes
-        ptr = self.lib.shm_store_create(self.handle, name.encode(), size)
+        ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
         if not ptr:
-            return None
+            # LRU-evict evictable objects and retry once (plasma eviction
+            # contract: the head reconstructs evicted ids on demand)
+            if self.lib.shm_store_evict(self.handle, max(size * 2, 1 << 20)) > 0:
+                ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
+            if not ptr:
+                return None
         try:
             # zero-copy source view when the buffer is writable & contiguous
             src: object = (ctypes.c_char * size).from_buffer(data)
+            ctypes.memmove(ptr, src, size)
+            del src
         except (TypeError, BufferError):
-            src = data.tobytes()
-        ctypes.memmove(ptr, src, size)
-        del src
+            # read-only source (e.g. np.frombuffer views): numpy copies
+            # straight into the mapping — no intermediate bytes object
+            import numpy as np
+
+            dst = np.ctypeslib.as_array(
+                (ctypes.c_ubyte * size).from_address(ptr)
+            )
+            np.copyto(dst, np.frombuffer(data, dtype=np.uint8))
         self.lib.shm_store_seal(self.handle, name.encode())
         self.lib.shm_store_release(self.handle, name.encode(), ptr)
         return ShmBufferRef(name=name, size=size)
@@ -161,6 +179,25 @@ class ShmClient:
 
     def evict(self, nbytes: int) -> int:
         return self.lib.shm_store_evict(self.handle, nbytes)
+
+    def pretouch_async(self):
+        """Fault in the whole slab from a daemon thread (one caller per
+        machine — the head does this at startup) so producers never pay
+        first-touch zero-fill during puts. Skipped on single/dual-core
+        hosts where the background faulting would contend with foreground
+        work; there the allocator's warm-page reuse carries the load."""
+        if (os.cpu_count() or 1) < 4:
+            return
+        handle = self.handle
+
+        def _touch():
+            try:
+                if self.handle is not None:
+                    self.lib.shm_store_pretouch(handle)
+            except Exception:
+                pass
+
+        threading.Thread(target=_touch, name="shm-pretouch", daemon=True).start()
 
     def disconnect(self):
         # The C handle is intentionally NOT freed: outstanding mapping

@@ -13,14 +13,19 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import collections
 import hashlib
+import logging
 import os
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
+
+logger = logging.getLogger(__name__)
 
 from .. import exceptions
 from . import protocol, serialization
@@ -63,6 +68,233 @@ class _ArgRef:
     object_id: str
 
 
+async def _swallow_conn_errors(coro):
+    """Fire-and-forget sends: a connection torn down mid-send (shutdown,
+    worker death) must not leave an unretrieved-exception future."""
+    try:
+        await coro
+    except Exception:
+        pass
+
+
+def _copy_envelope(env):
+    """Shallow copy so materialize() never mutates a cached envelope."""
+    return serialization.SerializedObject(
+        payload=env.payload,
+        buffers=list(env.buffers),
+        contained_refs=list(env.contained_refs),
+        is_error=env.is_error,
+    )
+
+
+class _ActorChannel:
+    """Per-(caller, actor) direct transport. Reference parity:
+    CoreWorkerDirectActorTaskSubmitter (direct_actor_task_submitter.h:67) —
+    calls push straight to the actor's worker process over one ordered
+    connection; the head is only consulted for the route (and re-consulted
+    when the connection breaks, e.g. across an actor restart).
+
+    A single consumer coroutine drains a FIFO queue: per-caller submission
+    order is preserved no matter how route resolution, dependency waits, or
+    fallback interleave. Results come back inline; the caller caches them
+    locally and forwards them to the head's object directory so any other
+    process can still `get` them."""
+
+    def __init__(self, worker: "Worker", actor_id: str):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.conn: Optional[protocol.Connection] = None
+        self.head_routed = False  # permanent fallback: order must not mix
+        self.task = asyncio.get_running_loop().create_task(self._consume())
+
+    async def _resolve(self) -> Optional[str]:
+        """Poll the head until the actor is alive (with an address), dead,
+        or the register timeout elapses. Returns the address or None."""
+        deadline = asyncio.get_running_loop().time() + cfg.worker_register_timeout_s
+        delay = 0.02
+        while True:
+            route = await self.worker.conn.request(
+                {"t": "get_actor_route", "actor_id": self.actor_id}
+            )
+            if route is None or route["state"] == "dead":
+                return None
+            if route["state"] == "alive" and route["address"]:
+                addr = route["address"]
+                if not protocol.is_tcp_address(addr) and (
+                    route["node_id"] != self.worker.node_id
+                ):
+                    return None  # unix socket on another machine
+                return addr
+            if asyncio.get_running_loop().time() > deadline:
+                return None
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 0.5)
+
+    async def _connect(self) -> bool:
+        if self.conn is not None and not self.conn.closed:
+            return True
+        addr = await self._resolve()
+        if addr is None:
+            return False
+        try:
+            reader, writer = await protocol.open_stream(addr)
+        except OSError:
+            return False
+
+        async def handler(msg):
+            raise ValueError("unexpected push on direct actor channel")
+
+        self.conn = protocol.Connection(reader, writer, handler)
+        self.conn.start()
+        return True
+
+    async def _resolve_deps(self, spec: dict) -> dict:
+        resolved = {}
+        missing = []
+        for oid in spec.get("deps", []):
+            env = self.worker._local_objects.get(oid)
+            if env is not None:
+                resolved[oid] = env
+            else:
+                missing.append(oid)
+        if missing:
+            envs = await self.worker.conn.request(
+                {"t": "get_objects", "object_ids": missing}
+            )
+            resolved.update(dict(zip(missing, envs)))
+        return resolved
+
+    async def _consume(self):
+        while True:
+            spec = await self.queue.get()
+            if spec is None:
+                return
+            try:
+                await self._submit_one(spec)
+            except Exception:
+                logger.exception("direct actor call failed; routing via head")
+                self._to_head(spec)
+
+    async def _submit_one(self, spec: dict):
+        """Send in FIFO order but do NOT wait for the reply — replies are
+        collected by a separate task per call, so calls pipeline exactly
+        like the head path (and like the reference's in-flight queue)."""
+        if self.head_routed or not await self._connect():
+            self.head_routed = True
+            self._to_head(spec)
+            return
+        resolved = await self._resolve_deps(spec)
+        msg = {
+            "t": "run_task",
+            "task_id": spec["task_id"],
+            "actor_id": self.actor_id,
+            "method": spec["method"],
+            "args": {"env": spec["args"], "resolved": resolved},
+            "return_ids": spec["return_ids"],
+        }
+        loop = asyncio.get_running_loop()
+        fut = loop.create_task(self.conn.request(msg))
+        loop.create_task(self._finish(spec, msg, fut))
+
+    async def _finish(self, spec: dict, msg: dict, fut):
+        """Collect the reply and settle the return objects. MUST terminate
+        every return id one way or another — a get() may be blocked on the
+        local pending event with no timeout."""
+        try:
+            try:
+                reply = await fut
+            except Exception as e:
+                # The connection broke mid-call (worker death / restart). Do
+                # NOT resend: the actor may have already executed this call —
+                # a replay would double-execute side effects (reference
+                # semantics: in-flight actor tasks fail with ActorDiedError
+                # on death; only max_task_retries opts into replays). Later
+                # calls reconnect to the restarted actor via a fresh route.
+                self.conn = None
+                await self._fail_returns(spec, f"worker died mid-call: {e!r}")
+                return
+            for _ in range(3):
+                lost = reply.get("lost_deps")
+                if not lost:
+                    break
+                # dep buffers were evicted before the actor could read them.
+                # The user code never ran, so a resend is side-effect safe;
+                # rebuild the deps from lineage first.
+                ok = await self.worker.conn.request(
+                    {"t": "reconstruct_objects", "object_ids": lost}
+                )
+                if not all(ok.get(oid) for oid in lost):
+                    await self._fail_returns(spec, f"lost deps {lost} unrecoverable")
+                    return
+                msg["args"] = {
+                    "env": spec["args"],
+                    "resolved": await self._resolve_deps(spec),
+                }
+                reply = await self.conn.request(msg)
+            if "results" not in reply:
+                await self._fail_returns(spec, f"bad reply {list(reply)}")
+                return
+            envs = reply["results"]
+            for oid, env in zip(spec["return_ids"], envs):
+                self.worker._cache_local_object(oid, env)
+                await self.worker.conn.send(
+                    {"t": "put_object", "object_id": oid, "envelope": env,
+                     "initial_refs": 1}
+                )
+        except Exception as e:  # never leave pending events unsettled
+            try:
+                await self._fail_returns(spec, f"direct call failed: {e!r}")
+            except Exception:
+                self.worker._release_pending(spec["return_ids"])
+        finally:
+            # deps stay pinned until the actor has consumed (or we failed)
+            await self._release_deps(spec)
+
+    async def _fail_returns(self, spec: dict, reason: str):
+        from ..exceptions import ActorDiedError
+
+        err = serialization.serialize(ActorDiedError(self.actor_id, reason))
+        err.is_error = True
+        for oid in spec["return_ids"]:
+            self.worker._cache_local_object(oid, err)
+            await self.worker.conn.send(
+                {"t": "put_object", "object_id": oid, "envelope": err,
+                 "initial_refs": 1}
+            )
+
+    def _to_head(self, spec: dict):
+        # release get() waiters: the result will come via the head, not the
+        # local cache (events with no cached envelope mean "ask the head")
+        self.worker._release_pending(spec["return_ids"])
+        try:
+            loop = asyncio.get_running_loop()
+            # the head takes the caller's +1 at submit (the direct path
+            # skipped it; head-path results don't carry it in put_object)
+            loop.create_task(
+                self.worker.conn.send({"t": "submit_actor_task", "spec": spec})
+            )
+            # release the direct-path dep pins AFTER the submit lands (the
+            # handler pins deps synchronously on arrival)
+            loop.create_task(self._release_deps(spec))
+        except Exception:
+            pass
+
+    async def _release_deps(self, spec: dict):
+        """Idempotent release of the dep refs taken at direct submit (both
+        the direct send and the head fallback funnel through here)."""
+        if spec.get("deps") and not spec.get("_deps_released"):
+            spec["_deps_released"] = True
+            await self.worker.conn.send(
+                {"t": "remove_refs", "counts": {d: 1 for d in spec["deps"]}}
+            )
+
+    async def close(self):
+        self.task.cancel()
+        if self.conn is not None:
+            await self.conn.close()
+
+
 class Worker:
     """The global per-process runtime."""
 
@@ -86,6 +318,32 @@ class Worker:
         self._lock = threading.RLock()
         self._shm = None
         self._shm_tried = False
+        # direct-transport state: per-actor channels + locally cached result
+        # envelopes (bounded; the head's ObjectDirectory stays the source of
+        # truth for every other process)
+        self._actor_channels: Dict[str, _ActorChannel] = {}
+        self._local_objects: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        # in-flight direct calls: return id -> Event set when the reply
+        # lands locally (get() waits here instead of round-tripping the head)
+        self._local_pending: Dict[str, threading.Event] = {}
+        self._local_lock = threading.Lock()
+
+    def _cache_local_object(self, oid: str, env) -> None:
+        with self._local_lock:
+            self._local_objects[oid] = env
+            self._local_objects.move_to_end(oid)
+            while len(self._local_objects) > 1024:
+                self._local_objects.popitem(last=False)
+            ev = self._local_pending.pop(oid, None)
+        if ev is not None:
+            ev.set()
+
+    def _release_pending(self, oids) -> None:
+        with self._local_lock:
+            evs = [self._local_pending.pop(oid, None) for oid in oids]
+        for ev in evs:
+            if ev is not None:
+                ev.set()
 
     @property
     def shm(self):
@@ -188,13 +446,35 @@ class Worker:
         if self.conn is None or self.conn.closed or self.io is None:
             return
         try:
-            self.io.post(self.conn.send(msg))
+            self.io.post(_swallow_conn_errors(self.conn.send(msg)))
         except RuntimeError:
             pass  # loop shut down
+
+    def send_ordered(self, msg: dict):
+        """Fire-and-forget submit. Per-connection FIFO both on the asyncio
+        send side and in the head's handler dispatch, so a later request()
+        from this process observes its effects (the reference gets the same
+        property from gRPC in-order delivery per channel)."""
+        if self.conn is None or self.conn.closed or self.io is None:
+            raise exceptions.RayTpuError("ray_tpu is not connected (call ray_tpu.init())")
+        self.io.post(_swallow_conn_errors(self.conn.send(msg)))
 
     def disconnect(self):
         self.connected = False
         self.mode = None
+        channels, self._actor_channels = dict(self._actor_channels), {}
+        if self.io is not None:
+            for ch in channels.values():
+                try:
+                    self.io.run(ch.close(), timeout=2)
+                except Exception:
+                    pass
+        with self._local_lock:
+            self._local_objects.clear()
+            pending, self._local_pending = dict(self._local_pending), {}
+        for ev in pending.values():
+            ev.set()  # wake blocked get()s; they fall through to a
+            # not-connected error instead of waiting forever
         self.conn = None
         if getattr(self, "_owns_io", False) and self.io is not None:
             try:
@@ -230,6 +510,8 @@ class Worker:
             self.send({"t": "add_refs", "counts": {object_id: 1}})
 
     def remove_object_ref(self, object_id: str):
+        with self._local_lock:
+            self._local_objects.pop(object_id, None)
         if self.connected:
             self.send({"t": "remove_refs", "counts": {object_id: 1}})
 
@@ -246,8 +528,16 @@ class Worker:
 
         oid = ObjectID.from_put(self.job_id).hex()
         env = serialization.serialize(value)
-        env = serialization.externalize(env, self.shm, cfg.object_inline_limit_bytes)
-        self.request({"t": "put_object", "object_id": oid, "envelope": env, "initial_refs": 1})
+        # pin=True: put data has no lineage, so it must never be evicted
+        env = serialization.externalize(
+            env, self.shm, cfg.object_inline_limit_bytes, pin=True
+        )
+        # fire-and-forget: messages on one connection are handled in order,
+        # so a later get() cannot observe the object missing; dropping the
+        # ack makes put() bandwidth-bound instead of RTT-bound
+        self.send_ordered(
+            {"t": "put_object", "object_id": oid, "envelope": env, "initial_refs": 1}
+        )
         return ObjectRef(oid, skip_adding_local_ref=True)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -258,12 +548,66 @@ class Worker:
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
-        envs = self.request(
-            {"t": "get_objects", "object_ids": [r.id for r in ref_list], "timeout": timeout}
-        )
+        # fast path: results of direct actor calls are cached locally (or in
+        # flight — then wait on the local event) — no head round-trip for
+        # the produce-then-get pattern
+        envs: List[Any] = [None] * len(ref_list)
+        missing: List[int] = []
+        pending: List[Tuple[int, Any]] = []
+        with self._local_lock:
+            for i, r in enumerate(ref_list):
+                env = self._local_objects.get(r.id)
+                if env is not None:
+                    envs[i] = _copy_envelope(env)
+                    continue
+                ev = self._local_pending.get(r.id)
+                if ev is not None:
+                    pending.append((i, ev))
+                else:
+                    missing.append(i)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for i, ev in pending:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not ev.wait(remaining):
+                raise exceptions.GetTimeoutError(
+                    f"Get timed out after {timeout}s waiting for {ref_list[i].id}"
+                )
+            with self._local_lock:
+                env = self._local_objects.get(ref_list[i].id)
+            if env is not None:
+                envs[i] = _copy_envelope(env)
+            else:
+                missing.append(i)  # routed via the head after all
+        if missing:
+            fetched = self.request(
+                {
+                    "t": "get_objects",
+                    "object_ids": [ref_list[i].id for i in missing],
+                    "timeout": timeout,
+                }
+            )
+            for i, env in zip(missing, fetched):
+                envs[i] = env
         values = []
-        for env in envs:
-            env = serialization.materialize(env, self.shm)
+        for env, ref in zip(envs, ref_list):
+            for attempt in range(3):
+                try:
+                    env = serialization.materialize(env, self.shm)
+                    break
+                except exceptions.ObjectLostError:
+                    # buffers evicted/lost: ask the head to rebuild the
+                    # object from its creating task's lineage, then refetch
+                    # (reference: ObjectRecoveryManager resubmission)
+                    if attempt == 2:
+                        raise
+                    ok = self.request(
+                        {"t": "reconstruct_objects", "object_ids": [ref.id]}
+                    )
+                    if not ok.get(ref.id):
+                        raise exceptions.ObjectLostError(ref.id) from None
+                    env = self.request(
+                        {"t": "get_objects", "object_ids": [ref.id], "timeout": timeout}
+                    )[0]
             value = serialization.deserialize(env)
             if getattr(env, "is_error", False):
                 raise value
@@ -353,9 +697,10 @@ class Worker:
             "scheduling_strategy": scheduling_strategy,
             "runtime_env": self.merged_runtime_env(runtime_env),
         }
-        # head takes the initial +1 on each return id at submit time
-        self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
-        self.request({"t": "submit_task", "spec": spec})
+        # fire-and-forget (FIFO per connection): submission is
+        # serialization-bound, not RTT-bound; the head takes the caller's
+        # +1 on each return id when it processes the submit
+        self.send_ordered({"t": "submit_task", "spec": spec})
         return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]
 
     # ------------------------------------------------------------------
@@ -420,9 +765,29 @@ class Worker:
             "deps": deps,
             "return_ids": return_ids,
         }
-        self.request({"t": "add_refs", "counts": {oid: 1 for oid in return_ids}})
-        self.request({"t": "submit_actor_task", "spec": spec})
+        if cfg.direct_actor_calls:
+            # no up-front add_refs for RESULTS: the caller's +1 rides the
+            # put_object that delivers them (initial_refs=1); the head
+            # reconciles early remove_refs via its signed counters. Deps DO
+            # get pinned here — the user may drop their ObjectRef right
+            # after .remote(), and the channel still has to resolve them.
+            if deps:
+                self.send_ordered({"t": "add_refs", "counts": {d: 1 for d in deps}})
+            with self._lock:  # two threads must not race in two channels
+                ch = self._actor_channels.get(actor_id)
+                if ch is None:
+                    ch = self.io.run(self._make_channel(actor_id))
+                    self._actor_channels[actor_id] = ch
+            with self._local_lock:
+                for oid in return_ids:
+                    self._local_pending[oid] = threading.Event()
+            self.io.loop.call_soon_threadsafe(ch.queue.put_nowait, spec)
+        else:
+            self.send_ordered({"t": "submit_actor_task", "spec": spec})
         return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]
+
+    async def _make_channel(self, actor_id: str) -> "_ActorChannel":
+        return _ActorChannel(self, actor_id)
 
 
 global_worker = Worker()
@@ -444,7 +809,12 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
             dep_env = resolved.get(a.object_id)
             if dep_env is None:
                 raise exceptions.ObjectLostError(a.object_id)
-            dep_env = serialization.materialize(dep_env, global_worker.shm)
+            try:
+                dep_env = serialization.materialize(dep_env, global_worker.shm)
+            except exceptions.ObjectLostError:
+                # buffer gone (evicted): report the OBJECT id so the head
+                # can reconstruct it from lineage
+                raise exceptions.ObjectLostError(a.object_id) from None
             value = serialization.deserialize(dep_env)
             if getattr(dep_env, "is_error", False):
                 raise value
@@ -456,13 +826,26 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
     return args, kwargs
 
 
-def execute_and_package(fn, fn_name: str, args_msg: dict, return_ids: List[str]) -> dict:
+def execute_and_package(
+    fn, fn_name: str, args_msg: dict, return_ids: List[str], pin_results: bool = False
+) -> dict:
     """Run a task function and package results as envelopes.
+
+    pin_results=True (actor methods): actor outputs have no lineage — the
+    method ran against mutable state — so their shm buffers must never be
+    LRU-evicted. Stateless task outputs stay evictable (reconstructible).
 
     Reference: _raylet.pyx:1630 execute_task_with_cancellation_handler.
     """
     try:
-        args, kwargs = resolve_task_args(args_msg)
+        try:
+            args, kwargs = resolve_task_args(args_msg)
+        except exceptions.ObjectLostError as e:
+            # dependency buffers were evicted: signal the head to rebuild
+            # them from lineage and re-dispatch (not a user error, and not
+            # a retry — reference: dependency resolution failure triggering
+            # ObjectRecoveryManager)
+            return {"lost_deps": [e.object_id_hex]}
         result = fn(*args, **kwargs)
         n = len(return_ids)
         if n == 0:
@@ -481,7 +864,10 @@ def execute_and_package(fn, fn_name: str, args_msg: dict, return_ids: List[str])
         for v in values:
             env = serialization.serialize(v)
             envs.append(
-                serialization.externalize(env, global_worker.shm, cfg.object_inline_limit_bytes)
+                serialization.externalize(
+                    env, global_worker.shm, cfg.object_inline_limit_bytes,
+                    pin=pin_results,
+                )
             )
         return {"results": envs}
     except Exception as e:  # noqa: BLE001

@@ -41,6 +41,30 @@ class WorkerServer:
         )
         self._loop: asyncio.AbstractEventLoop = None  # type: ignore
 
+    async def _start_direct_server(self) -> str:
+        """Listen for direct caller->worker task pushes (reference:
+        CoreWorker's gRPC server receiving PushTask,
+        direct_actor_task_submitter.h:67). Local workers use a unix socket
+        in the session dir; agent-spawned workers (remote nodes) listen on
+        TCP so cross-host callers can reach them."""
+
+        async def on_peer(reader, writer):
+            conn = protocol.Connection(reader, writer, self.handle)
+            conn.start()
+
+        if protocol.is_tcp_address(self.socket_path):
+            server = await asyncio.start_server(on_peer, host="0.0.0.0", port=0)
+            port = server.sockets[0].getsockname()[1]
+            from .head import _advertise_host
+
+            return f"{_advertise_host('0.0.0.0')}:{port}"
+        base = os.path.dirname(self.socket_path)
+        sock_dir = os.path.join(base, "workers")
+        os.makedirs(sock_dir, exist_ok=True)
+        path = os.path.join(sock_dir, f"{self.worker_id}.sock")
+        await asyncio.start_unix_server(on_peer, path=path)
+        return path
+
     async def run(self):
         self._loop = asyncio.get_running_loop()
         reader, writer = await protocol.open_stream(self.socket_path)
@@ -57,12 +81,17 @@ class WorkerServer:
             self.socket_path, self.worker_id, io, self.conn, node_id=self.node_id
         )
 
+        try:
+            direct_address = await self._start_direct_server()
+        except Exception:
+            direct_address = None
         await self.conn.request(
             {
                 "t": "register_worker",
                 "worker_id": self.worker_id,
                 "pid": os.getpid(),
                 "node_id": self.node_id,
+                "direct_address": direct_address,
             }
         )
         # serve until the connection dies
@@ -125,7 +154,9 @@ class WorkerServer:
                     self._loop.call_soon_threadsafe(self._loop.call_later, 0.05, sys.exit, 0)
                     return {"results": []}
                 fn = getattr(inst, method_name)
-                return execute_and_package(fn, method_name, msg["args"], msg["return_ids"])
+                return execute_and_package(
+                    fn, method_name, msg["args"], msg["return_ids"], pin_results=True
+                )
 
             return await self._loop.run_in_executor(self._executor, _call)
         fn = await self._fetch_blob("fn", msg["fn_key"], self._fn_cache)

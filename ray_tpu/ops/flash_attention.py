@@ -127,6 +127,32 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     return out, lse[..., 0]
 
 
+def _recompute_p_ds(refs, qi, kj, *, scale, causal, block_q, block_k):
+    """Shared backward recompute for one (q block, kv block) pair: rebuilds
+    the probabilities from the saved lse row stats and derives dS. Inputs
+    stay bf16 into the MXU; accumulation is f32. Returns (p, ds, q, k, v, do)."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs
+    q = q_ref[0, 0, :, :]                          # [BQ, D] bf16
+    k = k_ref[0, 0, :, :]                          # [BK, D]
+    v = v_ref[0, 0, :, :]                          # [BK, D]
+    do = do_ref[0, 0, :, :]                        # [BQ, D]
+    lse = lse_ref[0, 0, :, :]                      # [BQ, 1]
+    delta = delta_ref[0, 0, :, :]                  # [BQ, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse)                           # [BQ, BK] f32
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = (p * (dp - delta) * scale).astype(k.dtype)
+    return p, ds, q, k, v, do
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
                *, scale, causal, block_q, block_k):
     qi = pl.program_id(2)
@@ -141,24 +167,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
     @pl.when(run)
     def _accum():
-        q = q_ref[0, 0, :, :]                          # [BQ, D] bf16
-        k = k_ref[0, 0, :, :]                          # [BK, D]
-        v = v_ref[0, 0, :, :]                          # [BK, D]
-        do = do_ref[0, 0, :, :]                        # [BQ, D]
-        lse = lse_ref[0, 0, :, :]                      # [BQ, 1]
-        delta = delta_ref[0, 0, :, :]                  # [BQ, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)                           # [BQ, BK] f32
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        _, ds, _, k, _, _ = _recompute_p_ds(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), qi, kj,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -183,29 +195,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(run)
     def _accum():
-        q = q_ref[0, 0, :, :]                          # [BQ, D] bf16
-        k = k_ref[0, 0, :, :]                          # [BK, D]
-        v = v_ref[0, 0, :, :]                          # [BK, D]
-        do = do_ref[0, 0, :, :]                        # [BQ, D]
-        lse = lse_ref[0, 0, :, :]                      # [BQ, 1]
-        delta = delta_ref[0, 0, :, :]                  # [BQ, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)                           # [BQ, BK] f32
-        pb = p.astype(do.dtype)
+        p, ds, q, _, _, do = _recompute_p_ds(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), qi, kj,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
         # dV += P^T @ dO
         dv_acc[:] += jax.lax.dot_general(
-            pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
         # dK += dS^T @ Q
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
