@@ -90,7 +90,23 @@ void init_mutex(ControlBlock* cb) {
     pthread_mutexattr_destroy(&attr);
     cb->mu_state.store(2);
   } else {
-    while (cb->mu_state.load() != 2) sched_yield();
+    // Bounded wait: if the initializing process is killed in the 1->2
+    // window (microseconds long), recover by re-initializing ourselves
+    // instead of spinning forever.
+    struct timespec nap = {0, 1 * 1000 * 1000};
+    for (int i = 0; cb->mu_state.load() != 2; ++i) {
+      if (i > 2000) {  // ~2s
+        pthread_mutexattr_t attr;
+        pthread_mutexattr_init(&attr);
+        pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+        pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+        pthread_mutex_init(&cb->mu, &attr);
+        pthread_mutexattr_destroy(&attr);
+        cb->mu_state.store(2);
+        break;
+      }
+      nanosleep(&nap, nullptr);
+    }
   }
 }
 
@@ -410,12 +426,15 @@ int64_t shm_store_evict(void* handle, int64_t want_bytes) {
 // machine from a background thread at head startup — after this, creates
 // run at memcpy speed instead of paying first-touch zero-fill (plasma
 // pre-touches its dlmalloc arena the same way). Returns bytes touched.
-int64_t shm_store_pretouch(void* handle) {
+int64_t shm_store_pretouch(void* handle, int64_t max_bytes) {
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
   if (base == nullptr) return 0;
   int64_t cap = cb->capacity.load();
+  // cap the eagerly committed prefix (tmpfs pages are real RAM; the region
+  // beyond the prefix warms organically through allocator reuse)
+  if (max_bytes > 0 && max_bytes < cap) cap = max_bytes;
   constexpr int64_t kChunk = 8ll << 20;  // touch 8MB per lock hold
   struct timespec nap = {0, 30 * 1000 * 1000};
   int64_t touched = 0;

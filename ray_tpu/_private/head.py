@@ -790,6 +790,8 @@ class Head:
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
         if rec.state == "dead":
+            for oid in spec.get("deps", []):
+                self.objects.unpin(oid)
             self._fail_task_returns(spec, ActorDiedError(rec.actor_id, rec.death_reason))
             return
         if rec.state in ("pending", "starting", "restarting"):

@@ -61,7 +61,7 @@ def _load_lib():
         lib.shm_store_disconnect.argtypes = [ctypes.c_void_p]
         lib.shm_store_destroy.argtypes = [ctypes.c_char_p]
         lib.shm_store_pretouch.restype = ctypes.c_int64
-        lib.shm_store_pretouch.argtypes = [ctypes.c_void_p]
+        lib.shm_store_pretouch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
     return _lib
 
@@ -193,7 +193,10 @@ class ShmClient:
         def _touch():
             try:
                 if self.handle is not None:
-                    self.lib.shm_store_pretouch(handle)
+                    # commit at most a 256MB prefix: enough for steady-state
+                    # puts to stay warm without eagerly pinning the whole
+                    # capacity in RAM on every node
+                    self.lib.shm_store_pretouch(handle, 256 * 1024 * 1024)
             except Exception:
                 pass
 

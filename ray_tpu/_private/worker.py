@@ -578,12 +578,17 @@ class Worker:
                 envs[i] = _copy_envelope(env)
             else:
                 missing.append(i)  # routed via the head after all
+        def remaining():
+            if deadline is None:
+                return None
+            return max(0.0, deadline - time.monotonic())
+
         if missing:
             fetched = self.request(
                 {
                     "t": "get_objects",
                     "object_ids": [ref_list[i].id for i in missing],
-                    "timeout": timeout,
+                    "timeout": remaining(),
                 }
             )
             for i, env in zip(missing, fetched):
@@ -606,7 +611,8 @@ class Worker:
                     if not ok.get(ref.id):
                         raise exceptions.ObjectLostError(ref.id) from None
                     env = self.request(
-                        {"t": "get_objects", "object_ids": [ref.id], "timeout": timeout}
+                        {"t": "get_objects", "object_ids": [ref.id],
+                         "timeout": remaining()}
                     )[0]
             value = serialization.deserialize(env)
             if getattr(env, "is_error", False):
@@ -803,18 +809,22 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
     resolved: Dict[str, serialization.SerializedObject] = args_msg["resolved"]
     env = serialization.materialize(env, global_worker.shm)
     args, kwargs = serialization.deserialize(env)
+    lost: List[str] = []
 
     def conv(a):
         if isinstance(a, _ArgRef):
             dep_env = resolved.get(a.object_id)
             if dep_env is None:
-                raise exceptions.ObjectLostError(a.object_id)
+                lost.append(a.object_id)
+                return None
             try:
                 dep_env = serialization.materialize(dep_env, global_worker.shm)
             except exceptions.ObjectLostError:
-                # buffer gone (evicted): report the OBJECT id so the head
-                # can reconstruct it from lineage
-                raise exceptions.ObjectLostError(a.object_id) from None
+                # buffer gone (evicted): collect the OBJECT id — ALL lost
+                # deps are reported together so the head reconstructs them
+                # in one round
+                lost.append(a.object_id)
+                return None
             value = serialization.deserialize(dep_env)
             if getattr(dep_env, "is_error", False):
                 raise value
@@ -823,6 +833,8 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
 
     args = tuple(conv(a) for a in args)
     kwargs = {k: conv(v) for k, v in kwargs.items()}
+    if lost:
+        raise exceptions.LostDepsError(lost)
     return args, kwargs
 
 
@@ -840,12 +852,12 @@ def execute_and_package(
     try:
         try:
             args, kwargs = resolve_task_args(args_msg)
-        except exceptions.ObjectLostError as e:
+        except exceptions.LostDepsError as e:
             # dependency buffers were evicted: signal the head to rebuild
             # them from lineage and re-dispatch (not a user error, and not
             # a retry — reference: dependency resolution failure triggering
             # ObjectRecoveryManager)
-            return {"lost_deps": [e.object_id_hex]}
+            return {"lost_deps": e.object_ids}
         result = fn(*args, **kwargs)
         n = len(return_ids)
         if n == 0:

@@ -54,6 +54,18 @@ class ObjectLostError(RayTpuError):
         return (type(self), (self.object_id_hex,))
 
 
+class LostDepsError(RayTpuError):
+    """Internal: ALL task dependencies whose buffers were lost, collected in
+    one pass so reconstruction fixes them in a single round."""
+
+    def __init__(self, object_ids):
+        self.object_ids = list(object_ids)
+        super().__init__(f"Lost dependencies: {self.object_ids}")
+
+    def __reduce__(self):
+        return (type(self), (self.object_ids,))
+
+
 class WorkerCrashedError(RayTpuError):
     pass
 

@@ -63,6 +63,11 @@ class TransformerConfig:
     # MoE (expert parallel); n_experts=0 -> dense MLP
     n_experts: int = 0
     top_k: int = 2
+    # "dispatch": capacity-based top-k routing (FLOPs scale with top_k) —
+    # the real EP path; "dense": every expert computes every token (exact
+    # oracle for tests, O(n_experts) FLOPs)
+    moe_impl: str = "dispatch"
+    moe_capacity_factor: float = 1.25
     tie_embeddings: bool = False
     # pipeline parallelism: >1 splits the layer stack into pp stages
     pp_stages: int = 1
@@ -222,23 +227,74 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
 # --------------------------------------------------------------------------
 
 
+def _moe_dense(h, lp, cfg: TransformerConfig):
+    """Dense-dispatch oracle: every expert computes every token; the top-k
+    router weights zero out non-selected experts. Exact but O(n_experts)
+    FLOPs — kept as the correctness reference for the dispatch path."""
+    gate_logits = jnp.einsum("bse,ex->bsx", h, lp["router"].astype(h.dtype))
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_vals, _ = lax.top_k(probs, cfg.top_k)
+    thresh = top_vals[..., -1:]
+    gate = jnp.where(probs >= thresh, probs, 0.0)
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+    g = jnp.einsum("bse,xef->bsxf", h, lp["w_gate"].astype(h.dtype))
+    u = jnp.einsum("bse,xef->bsxf", h, lp["w_up"].astype(h.dtype))
+    y = jnp.einsum("bsxf,xfe->bsxe", jax.nn.silu(g) * u, lp["w_down"].astype(h.dtype))
+    return jnp.einsum("bsxe,bsx->bse", y, gate.astype(h.dtype))
+
+
+def _moe_dispatch(h, lp, cfg: TransformerConfig, constrain_fn):
+    """Capacity-based top-k MoE (GShard/Switch family, TPU-first):
+
+    tokens are sorted by destination expert and scattered into a fixed
+    [n_experts, capacity, d_model] buffer; the expert FFNs run as ONE
+    batched matmul over that buffer; outputs scatter-add back weighted by
+    the (renormalized) router probabilities. FLOPs scale with top_k * N *
+    capacity_factor — independent of n_experts. Under an `ep`-sharded mesh
+    the sharding constraint on the buffer makes GSPMD insert the token
+    all-to-alls (SURVEY §2.4 "mesh expert axis + ragged all-to-all");
+    overflow beyond capacity is dropped (standard capacity-factor trade).
+    Static shapes throughout: sort + gather/scatter, no ragged compute."""
+    B, S, E = h.shape
+    N = B * S
+    X, k = cfg.n_experts, cfg.top_k
+    C = min(N, max(1, math.ceil(k * N / X * cfg.moe_capacity_factor)))
+
+    x = h.reshape(N, E)
+    gate_logits = jnp.einsum("ne,ex->nx", x, lp["router"].astype(h.dtype))
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    w, idx = lax.top_k(probs, k)  # [N, k]
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+
+    flat_e = idx.reshape(-1)                       # [N*k] destination expert
+    flat_t = jnp.repeat(jnp.arange(N), k)          # [N*k] source token
+    flat_w = w.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)       # group by expert
+    se, st, sw = flat_e[order], flat_t[order], flat_w[order]
+    # slot within the expert's capacity window
+    group_start = jnp.searchsorted(se, jnp.arange(X))
+    pos = jnp.arange(N * k) - group_start[se]
+    valid = (pos < C).astype(h.dtype)              # overflow -> dropped
+    pos_c = jnp.minimum(pos, C - 1)
+
+    buf = jnp.zeros((X, C, E), h.dtype)
+    buf = buf.at[se, pos_c].add(x[st] * valid[:, None])
+    buf = constrain_fn(buf, "expert", None, "embed")
+    g = jnp.einsum("xce,xef->xcf", buf, lp["w_gate"].astype(h.dtype))
+    u = jnp.einsum("xce,xef->xcf", buf, lp["w_up"].astype(h.dtype))
+    y = jnp.einsum("xcf,xfe->xce", jax.nn.silu(g) * u, lp["w_down"].astype(h.dtype))
+    y = constrain_fn(y, "expert", None, "embed")
+
+    contrib = y[se, pos_c] * (sw.astype(h.dtype) * valid)[:, None]  # [N*k, E]
+    out = jnp.zeros((N, E), h.dtype).at[st].add(contrib)
+    return out.reshape(B, S, E)
+
+
 def _mlp(h, lp, cfg: TransformerConfig, constrain_fn):
     if cfg.n_experts:
-        # Expert-parallel MoE, dense dispatch: every expert computes every
-        # token (einsum over the expert dim, sharded on `ep`); router top-k
-        # weights zero out non-selected experts. Exact for training quality
-        # at small expert counts; capacity-based ragged dispatch is the
-        # planned fast path.
-        gate_logits = jnp.einsum("bse,ex->bsx", h, lp["router"].astype(h.dtype))
-        probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        top_vals, _ = lax.top_k(probs, cfg.top_k)
-        thresh = top_vals[..., -1:]
-        gate = jnp.where(probs >= thresh, probs, 0.0)
-        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
-        g = jnp.einsum("bse,xef->bsxf", h, lp["w_gate"].astype(h.dtype))
-        u = jnp.einsum("bse,xef->bsxf", h, lp["w_up"].astype(h.dtype))
-        y = jnp.einsum("bsxf,xfe->bsxe", jax.nn.silu(g) * u, lp["w_down"].astype(h.dtype))
-        return jnp.einsum("bsxe,bsx->bse", y, gate.astype(h.dtype))
+        if cfg.moe_impl == "dense":
+            return _moe_dense(h, lp, cfg)
+        return _moe_dispatch(h, lp, cfg, constrain_fn)
     g = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(h.dtype))
     u = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(h.dtype))
     g = constrain_fn(g, "batch", "seq", "mlp")

@@ -21,15 +21,15 @@ def _cfg(n_experts, impl, **kw):
 def test_dispatch_matches_dense_oracle():
     """With generous capacity (no drops) the capacity-based dispatch equals
     the dense every-expert-computes-every-token oracle."""
-    cfg_d = _cfg(4, "dense")
-    cfg_s = _cfg(4, "dispatch", moe_capacity_factor=4.0)  # no drops
+    cfg_d = _cfg(4, "dense", dtype=jnp.float32)
+    cfg_s = _cfg(4, "dispatch", moe_capacity_factor=4.0, dtype=jnp.float32)  # no drops
     params = init_params(jax.random.PRNGKey(0), cfg_d)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg_d.vocab_size)
     out_d = make_forward(cfg_d)(params, tokens)
     out_s = make_forward(cfg_s)(params, tokens)
     np.testing.assert_allclose(
         np.asarray(out_d, np.float32), np.asarray(out_s, np.float32),
-        rtol=2e-2, atol=2e-2,
+        rtol=1e-4, atol=1e-4,
     )
 
 
