@@ -77,8 +77,11 @@ class Config:
 D = Config.define
 # --- core runtime ---
 D("raylet_heartbeat_period_ms", int, 1000, "worker->head heartbeat period")
-D("health_check_period_ms", int, 1000, "head-side liveness check period")
-D("health_check_failure_threshold", int, 5, "missed heartbeats before a worker is dead")
+D("health_check_period_ms", int, 3000, "head-side liveness probe period")
+D("health_check_failure_threshold", int, 10,
+  "consecutive failed probes before a worker/node is declared dead (~30s "
+  "with the default period: long GIL-holding stretches, e.g. jax traces, "
+  "must not look like hangs)")
 D("worker_register_timeout_s", float, 30.0, "max wait for a spawned worker to register")
 D("task_retry_delay_ms", int, 100, "delay before retrying a failed task")
 D("max_pending_lease_requests", int, 1024)

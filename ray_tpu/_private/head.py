@@ -58,6 +58,8 @@ class NodeRecord:
     # channel, node_manager.h:117); None for the head node and for logical
     # resource-only nodes (autoscaler simulations)
     conn: Optional["protocol.Connection"] = None
+    health_failures: int = 0
+    probing: bool = False
 
     def __post_init__(self):
         if not self.available:
@@ -79,6 +81,8 @@ class WorkerRecord:
     registered: Optional[asyncio.Future] = None
     num_running: int = 0
     pooled: bool = True
+    health_failures: int = 0
+    probing: bool = False
     # caller->worker push endpoint (unix path or host:port) for the direct
     # actor-call transport (direct_actor_task_submitter.h:67)
     direct_address: Optional[str] = None
@@ -377,6 +381,10 @@ class Head:
         to <session_dir>/head_addr for discovery by `init(address=...)`."""
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
         self._shm_client()  # connect early: kicks off the slab pretouch
+        # liveness prober: a hung worker/agent keeps its socket open, so
+        # connection-close detection alone misses it (reference:
+        # gcs_health_check_manager.h:39 periodic health checks)
+        self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
         host = tcp_host if tcp_host is not None else cfg.head_tcp_host
         port = tcp_port if tcp_port is not None else cfg.head_tcp_port
         try:
@@ -390,8 +398,63 @@ class Head:
         with open(os.path.join(self.session_dir, "head_addr"), "w") as f:
             f.write(self.tcp_address)
 
+    async def _health_loop(self):
+        period = cfg.health_check_period_ms / 1000.0
+        loop = asyncio.get_running_loop()
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            for w in list(self.workers.values()):
+                if w.state in ("dead", "starting") or w.conn is None or w.probing:
+                    continue
+                loop.create_task(self._probe(w, w.conn, self._declare_worker_hung(w)))
+            for n in list(self.nodes.values()):
+                if n.alive and n.remote and not n.conn.closed and not n.probing:
+                    loop.create_task(self._probe(n, n.conn, self._declare_node_hung(n)))
+
+    async def _probe(self, target, conn, on_dead):
+        """One liveness probe. The timeout covers the SEND too — a hung peer
+        can block the connection's send lock (e.g. mid-drain backpressure),
+        and a probe stuck in send would otherwise never fail."""
+        target.probing = True
+        try:
+            await asyncio.wait_for(
+                conn.request({"t": "ping"}), cfg.health_check_period_ms / 1000.0
+            )
+            target.health_failures = 0
+            on_dead.close()
+        except Exception:
+            target.health_failures += 1
+            if target.health_failures >= cfg.health_check_failure_threshold:
+                await on_dead
+            else:
+                on_dead.close()
+        finally:
+            target.probing = False
+
+    async def _declare_worker_hung(self, w: WorkerRecord):
+        if w.state == "dead":
+            return
+        logger.warning("worker %s failed health checks; declaring dead", w.worker_id)
+        # force-kill FIRST: a replacement (possibly TPU-owning) worker must
+        # not start while the hung process may still hold the chips
+        await self._terminate_worker(w, force=True, close_conn=False)
+        await self._on_worker_death(w, reason="unresponsive (health prober)")
+        if w.conn is not None:
+            await w.conn.close()  # after death handling: reason stays accurate
+
+    async def _declare_node_hung(self, n: NodeRecord):
+        if not n.alive:
+            return
+        logger.warning("node %s failed health checks; declaring dead", n.node_id)
+        # death handling first, then close (the close callback's
+        # "connection closed" path is a guarded no-op afterwards)
+        await self._on_node_death(n, reason="unresponsive (health prober)")
+        await n.conn.close()
+
     async def stop(self):
         self._shutdown = True
+        if getattr(self, "_health_task", None) is not None:
+            self._health_task.cancel()
         for job in self.jobs.values():
             if job["status"] == "RUNNING":
                 job["status"] = "STOPPED"
@@ -1608,11 +1671,20 @@ class Head:
         if w.state == "dead":
             return
         w.state = "dead"
-        if w.conn is not None:
+        await self._terminate_worker(w)
+        if w.worker_id in self.idle_workers[w.node_id]:
+            self.idle_workers[w.node_id].remove(w.worker_id)
+
+    async def _terminate_worker(
+        self, w: WorkerRecord, force: bool = False, close_conn: bool = True
+    ):
+        """Tear down the worker's connection and process (local or via its
+        node agent). Idempotent; independent of record state."""
+        if close_conn and w.conn is not None:
             await w.conn.close()
         if w.proc is not None and w.proc.poll() is None:
             try:
-                w.proc.terminate()
+                w.proc.kill() if force else w.proc.terminate()
             except Exception:
                 pass
         elif w.proc is None:
@@ -1621,12 +1693,11 @@ class Head:
             if node is not None and node.remote and not node.conn.closed:
                 try:
                     await node.conn.request(
-                        {"t": "kill_worker", "worker_id": w.worker_id}, timeout=5
+                        {"t": "kill_worker", "worker_id": w.worker_id, "force": force},
+                        timeout=5,
                     )
                 except Exception:
                     pass
-        if w.worker_id in self.idle_workers[w.node_id]:
-            self.idle_workers[w.node_id].remove(w.worker_id)
 
     async def _on_worker_death(self, w: WorkerRecord, reason: str):
         if w.state == "dead":
