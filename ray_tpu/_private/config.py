@@ -97,6 +97,14 @@ D("direct_actor_calls", bool, True,
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")
+D("head_snapshot_period_ms", int, 15000,
+  "period for head-state snapshots (KV, actors, jobs, PGs) to disk; 0 disables")
+D("head_snapshot_path", str, "",
+  "snapshot file (default <session_dir>/head_state.pkl); set a stable path "
+  "to survive session-dir cleanup")
+D("head_restore_path", str, "",
+  "restore head state from this snapshot at startup (reference: GCS "
+  "restart reload, gcs_init_data.h)")
 D("head_tcp_host", str, "127.0.0.1",
   "bind host for the multi-host TCP control plane; the wire protocol is "
   "unauthenticated pickle, so bind non-loopback (0.0.0.0) only on trusted "

@@ -381,6 +381,15 @@ class Head:
         to <session_dir>/head_addr for discovery by `init(address=...)`."""
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
         self._shm_client()  # connect early: kicks off the slab pretouch
+        if cfg.head_restore_path:
+            try:
+                self._load_snapshot(cfg.head_restore_path)
+            except FileNotFoundError:
+                logger.warning("no head snapshot at %s", cfg.head_restore_path)
+        if cfg.head_snapshot_period_ms > 0:
+            self._snapshot_task = asyncio.get_running_loop().create_task(
+                self._snapshot_loop()
+            )
         # liveness prober: a hung worker/agent keeps its socket open, so
         # connection-close detection alone misses it (reference:
         # gcs_health_check_manager.h:39 periodic health checks)
@@ -397,6 +406,131 @@ class Head:
         self.tcp_address = f"{_advertise_host(host)}:{bound[1]}"
         with open(os.path.join(self.session_dir, "head_addr"), "w") as f:
             f.write(self.tcp_address)
+
+    # ------------------------------------------------------------------
+    # persistence (reference: gcs_table_storage.h:252 + gcs_init_data.h —
+    # periodic snapshot instead of per-write Redis mirroring: the metadata
+    # volume is small and the fsync cost of per-write mirroring would sit
+    # on the control hot path)
+    # ------------------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        return cfg.head_snapshot_path or os.path.join(self.session_dir, "head_state.pkl")
+
+    def _write_snapshot(self):
+        """Capture + write in one go (event-loop context only)."""
+        self._write_state(self._snapshot_state())
+
+    def _snapshot_state(self) -> dict:
+        """Capture the state dict ON the event loop — mutations are loop-
+        serialized, so capturing here (it's small metadata) avoids racing
+        dict iteration against handlers; only the file IO leaves the loop."""
+        state = {
+            "version": 1,
+            "time": time.time(),
+            "kv": {ns: dict(table) for ns, table in self.kv.items()},
+            "named_actors": dict(self.named_actors),
+            "actors": {
+                aid: {
+                    "actor_id": aid,
+                    "name": rec.name,
+                    "state": rec.state,
+                    "spec": {
+                        k: rec.spec.get(k)
+                        for k in (
+                            "actor_id", "cls_key", "cls_name", "name",
+                            "namespace", "resources", "max_restarts",
+                            "max_concurrency", "method_names", "lifetime",
+                        )
+                    },
+                }
+                for aid, rec in self.actors.items()
+            },
+            "jobs": {sid: self._job_view(j) for sid, j in self.jobs.items()},
+            "placement_groups": {
+                pid: {
+                    "pg_id": pid,
+                    "strategy": rec.strategy,
+                    "name": rec.name,
+                    "bundles": [dict(b.resources) for b in rec.bundles],
+                }
+                for pid, rec in self.placement_groups.items()
+            },
+        }
+        return state
+
+    def _write_state(self, state: dict):
+        import pickle
+        import uuid as _uuid
+
+        path = self._snapshot_path()
+        tmp = f"{path}.tmp-{_uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)
+
+    def _load_snapshot(self, path: str):
+        """Reload metadata from a previous head's snapshot. Processes are
+        gone: actors come back as DEAD records (name registry + specs kept
+        so they are discoverable and re-creatable), jobs that were RUNNING
+        are marked FAILED, the KV store (function/class exports included)
+        is restored verbatim."""
+        import pickle
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        for ns, table in state.get("kv", {}).items():
+            self.kv[ns].update(table)
+        for aid, meta in state.get("actors", {}).items():
+            self.actors[aid] = ActorRecord(
+                actor_id=aid,
+                spec=dict(meta["spec"] or {}),
+                name=meta.get("name"),
+                state="dead",
+                death_reason="head restarted (restored from snapshot)",
+            )
+        self.named_actors.update(
+            {tuple(k) if isinstance(k, list) else k: v
+             for k, v in state.get("named_actors", {}).items()}
+        )
+        for sid, job in state.get("jobs", {}).items():
+            job = dict(job)
+            if job.get("status") == "RUNNING":
+                job["status"] = "FAILED"
+                job["message"] = "head restarted"
+            job["proc"] = None
+            self.jobs[sid] = job
+        for pid, meta in state.get("placement_groups", {}).items():
+            bundles = [
+                BundleState(i, dict(b), available=dict(b))
+                for i, b in enumerate(meta["bundles"])
+            ]
+            rec = PlacementGroupRecord(
+                pg_id=pid,
+                bundles=bundles,
+                strategy=meta["strategy"],
+                name=meta.get("name"),
+                ready_event=asyncio.Event(),
+            )
+            self.placement_groups[pid] = rec
+            # re-place on whatever capacity this cluster grows
+            asyncio.get_running_loop().create_task(self._schedule_pg(rec))
+        logger.info(
+            "restored head state from %s: %d kv namespaces, %d actors, %d jobs",
+            path, len(state.get("kv", {})), len(state.get("actors", {})),
+            len(state.get("jobs", {})),
+        )
+
+    async def _snapshot_loop(self):
+        period = cfg.head_snapshot_period_ms / 1000.0
+        loop = asyncio.get_running_loop()
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                state = self._snapshot_state()  # on-loop: race-free capture
+                await loop.run_in_executor(None, self._write_state, state)
+            except Exception:
+                logger.exception("head snapshot failed")
 
     async def _health_loop(self):
         period = cfg.health_check_period_ms / 1000.0
@@ -455,10 +589,19 @@ class Head:
         self._shutdown = True
         if getattr(self, "_health_task", None) is not None:
             self._health_task.cancel()
+        if getattr(self, "_snapshot_task", None) is not None:
+            self._snapshot_task.cancel()
         for job in self.jobs.values():
             if job["status"] == "RUNNING":
                 job["status"] = "STOPPED"
                 self._terminate_job_proc(job["proc"])
+        if cfg.head_snapshot_period_ms > 0:
+            try:
+                # final snapshot AFTER settling jobs: a clean shutdown must
+                # not read as a crash (RUNNING -> FAILED) on restore
+                self._write_snapshot()
+            except Exception:
+                pass
         for w in list(self.workers.values()):
             await self._kill_worker(w, reason="shutdown")
         for n in list(self.nodes.values()):
@@ -784,8 +927,10 @@ class Head:
         )
         if rec.name:
             key = (spec.get("namespace", ""), rec.name)
-            if key in self.named_actors:
+            prev = self.actors.get(self.named_actors.get(key, ""))
+            if prev is not None and prev.state != "dead":
                 raise ValueError(f"Actor name {rec.name!r} already taken")
+            # dead holders (incl. snapshot-restored metadata) are replaceable
             self.named_actors[key] = aid
         self.actors[aid] = rec
         for oid in spec.get("deps", []):

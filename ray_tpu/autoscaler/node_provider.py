@@ -75,6 +75,171 @@ TPU_SLICE_TOPOLOGIES: Dict[str, Dict[str, float]] = {
     "v5p-8": {"TPU": 4.0, "CPU": 208.0},
 }
 
+# ray_tpu node-type name -> GCP acceleratorType string
+GCP_ACCELERATOR_TYPES: Dict[str, str] = {
+    "v4-8": "v4-8",
+    "v5e-4": "v5litepod-4",
+    "v5e-8": "v5litepod-8",
+    "v5p-8": "v5p-8",
+}
+
+
+class GCPTPUApi:
+    """Thin client for the Cloud TPU VM REST API (tpu.googleapis.com/v2),
+    authenticated via the GCE metadata server. Injected into
+    GCPTPUNodeProvider so tests substitute a fake (reference:
+    gcp/node_provider.py:86-90 builds the discovery client the same way)."""
+
+    def __init__(self, project: str, zone: str):
+        self.base = (
+            f"https://tpu.googleapis.com/v2/projects/{project}"
+            f"/locations/{zone}/nodes"
+        )
+
+    def _token(self) -> str:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/instance/"
+            "service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())["access_token"]
+
+    def _call(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+        import json
+        import urllib.request
+
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers={
+                "Authorization": f"Bearer {self._token()}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def create(self, node_id: str, body: dict) -> dict:
+        return self._call("POST", f"{self.base}?nodeId={node_id}", body)
+
+    def delete(self, node_id: str) -> dict:
+        return self._call("DELETE", f"{self.base}/{node_id}")
+
+    def list(self) -> List[dict]:
+        nodes: List[dict] = []
+        token = ""
+        while True:
+            url = self.base + (f"?pageToken={token}" if token else "")
+            page = self._call("GET", url)
+            nodes.extend(page.get("nodes", []))
+            token = page.get("nextPageToken", "")
+            if not token:
+                return nodes
+
+
+class GCPTPUNodeProvider(NodeProvider):
+    """Provisions TPU VM slices through the Cloud TPU API; each VM's startup
+    script joins the running head as a node agent (`ray_tpu start
+    --address`). Reference parity: autoscaler/_private/gcp/node_provider.py
+    (GCPTPU :19, client wiring :86-90) — rebuilt on the v2 TPU VM API with
+    the agent join baked into the startup script."""
+
+    def __init__(
+        self,
+        head_address: str,
+        project: str = "",
+        zone: str = "",
+        runtime_version: str = "tpu-ubuntu2204-base",
+        name_prefix: str = "raytpu",
+        api: Optional[GCPTPUApi] = None,
+    ):
+        if api is None:
+            api = GCPTPUApi(project, zone)
+        self.api = api
+        self.head_address = head_address
+        self.runtime_version = runtime_version
+        self.name_prefix = name_prefix
+        self._nodes: Dict[str, str] = {}
+        self._absent_polls: Dict[str, int] = {}
+        self._counter = itertools.count(1)
+
+    def _startup_script(self, node_id: str, num_tpus: float) -> str:
+        return (
+            "#!/bin/bash\n"
+            "python3 -m ray_tpu.scripts start "
+            f"--address {self.head_address} --node-id {node_id} "
+            f"--num-tpus {int(num_tpus)} >/var/log/ray_tpu_agent.log 2>&1 &\n"
+        )
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        accel = GCP_ACCELERATOR_TYPES.get(node_type, node_type)
+        merged = dict(TPU_SLICE_TOPOLOGIES.get(node_type, {}))
+        merged.update(resources)
+        node_id = f"{self.name_prefix}-{node_type}-{next(self._counter)}"
+        self.api.create(
+            node_id,
+            {
+                "acceleratorType": accel,
+                "runtimeVersion": self.runtime_version,
+                "metadata": {
+                    "startup-script": self._startup_script(
+                        node_id, merged.get("TPU", 0)
+                    ),
+                },
+                "labels": {"ray-tpu-node-type": node_type},
+            },
+        )
+        self._nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        if node_id in self._nodes:
+            self.api.delete(node_id)
+            del self._nodes[node_id]
+
+    # TPU node states that mean "this capacity is gone" (the API keeps
+    # reporting preempted/terminated nodes in list() until deleted)
+    _TERMINAL_STATES = {"PREEMPTED", "TERMINATED", "STOPPED", "DELETING"}
+    # a freshly created node may take a while to appear in list() (create
+    # returns a long-running op) — only give up after this many consecutive
+    # absent polls so we never double-launch against a provisioning slice
+    _MAX_ABSENT_POLLS = 24  # ~2 min at the 5s autoscaler tick
+
+    def non_terminated_nodes(self) -> List[str]:
+        listed = {
+            n["name"].rsplit("/", 1)[-1]: n.get("state", "") for n in self.api.list()
+        }
+        for nid in list(self._nodes):
+            state = listed.get(nid)
+            if state is None:
+                # not visible yet (or create failed): tolerate a bounded
+                # provisioning window before declaring it lost
+                self._absent_polls[nid] = self._absent_polls.get(nid, 0) + 1
+                if self._absent_polls[nid] > self._MAX_ABSENT_POLLS:
+                    del self._nodes[nid]
+                    self._absent_polls.pop(nid, None)
+            elif state in self._TERMINAL_STATES:
+                # preempted/terminated: drop so the autoscaler launches a
+                # replacement; best-effort delete of the husk
+                try:
+                    self.api.delete(nid)
+                except Exception:
+                    pass
+                del self._nodes[nid]
+                self._absent_polls.pop(nid, None)
+            else:
+                self._absent_polls.pop(nid, None)
+        return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        return self._nodes.get(node_id)
+
 
 class TPUPodProvider(NodeProvider):
     """TPU-VM provider shell: knows slice topologies (scale quanta) but
