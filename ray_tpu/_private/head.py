@@ -386,6 +386,13 @@ class Head:
                 self._load_snapshot(cfg.head_restore_path)
             except FileNotFoundError:
                 logger.warning("no head snapshot at %s", cfg.head_restore_path)
+            except Exception:
+                # a corrupt/incompatible snapshot must not keep the head
+                # from starting; whatever restored before the failure stays
+                logger.exception(
+                    "failed to restore head snapshot %s; starting fresh",
+                    cfg.head_restore_path,
+                )
         if cfg.head_snapshot_period_ms > 0:
             self._snapshot_task = asyncio.get_running_loop().create_task(
                 self._snapshot_loop()
@@ -479,6 +486,8 @@ class Head:
 
         with open(path, "rb") as f:
             state = pickle.load(f)
+        if state.get("version") != 1:
+            raise ValueError(f"unsupported snapshot version {state.get('version')!r}")
         for ns, table in state.get("kv", {}).items():
             self.kv[ns].update(table)
         for aid, meta in state.get("actors", {}).items():
@@ -528,9 +537,14 @@ class Head:
             await asyncio.sleep(period)
             try:
                 state = self._snapshot_state()  # on-loop: race-free capture
-                await loop.run_in_executor(None, self._write_state, state)
+                self._snapshot_inflight = loop.run_in_executor(
+                    None, self._write_state, state
+                )
+                await self._snapshot_inflight
             except Exception:
                 logger.exception("head snapshot failed")
+            finally:
+                self._snapshot_inflight = None
 
     async def _health_loop(self):
         period = cfg.health_check_period_ms / 1000.0
@@ -596,6 +610,15 @@ class Head:
                 job["status"] = "STOPPED"
                 self._terminate_job_proc(job["proc"])
         if cfg.head_snapshot_period_ms > 0:
+            # an in-flight periodic write (executor thread: cancel doesn't
+            # stop it) must land BEFORE the final write, or its stale state
+            # would clobber the clean-shutdown snapshot
+            inflight = getattr(self, "_snapshot_inflight", None)
+            if inflight is not None:
+                try:
+                    await asyncio.wait_for(asyncio.shield(inflight), timeout=10)
+                except Exception:
+                    pass
             try:
                 # final snapshot AFTER settling jobs: a clean shutdown must
                 # not read as a crash (RUNNING -> FAILED) on restore
@@ -1074,6 +1097,15 @@ class Head:
         for spec in backlog:
             self._fail_task_returns(spec, ActorDiedError(rec.actor_id, rec.death_reason))
 
+    def _unregister_name(self, rec: ActorRecord):
+        """Remove the name ONLY if it still maps to this actor — a dead
+        holder's name may have been legitimately taken by a replacement
+        (e.g. after a snapshot restore), and killing the stale record must
+        not unregister the live one."""
+        key = (rec.spec.get("namespace", ""), rec.name)
+        if self.named_actors.get(key) == rec.actor_id:
+            self.named_actors.pop(key, None)
+
     async def _h_get_named_actor(self, conn, msg):
         key = (msg.get("namespace", ""), msg["name"])
         aid = self.named_actors.get(key)
@@ -1090,7 +1122,7 @@ class Head:
         rec.state = "dead"
         rec.death_reason = "killed via kill_actor"
         if rec.name:
-            self.named_actors.pop((rec.spec.get("namespace", ""), rec.name), None)
+            self._unregister_name(rec)
         w = self.workers.get(rec.worker_id or "")
         if w is not None:
             await self._kill_worker(w, reason="actor killed")
@@ -1869,6 +1901,6 @@ class Head:
                     rec.state = "dead"
                     rec.death_reason = f"worker died ({reason})"
                     if rec.name:
-                        self.named_actors.pop((rec.spec.get("namespace", ""), rec.name), None)
+                        self._unregister_name(rec)
                     await self._fail_backlog(rec)
         _ = was_actor

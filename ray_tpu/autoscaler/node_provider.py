@@ -95,18 +95,26 @@ class GCPTPUApi:
             f"https://tpu.googleapis.com/v2/projects/{project}"
             f"/locations/{zone}/nodes"
         )
+        self._token_value = ""
+        self._token_expiry = 0.0
 
     def _token(self) -> str:
         import json
+        import time
         import urllib.request
 
+        if self._token_value and time.time() < self._token_expiry - 60:
+            return self._token_value
         req = urllib.request.Request(
             "http://metadata.google.internal/computeMetadata/v1/instance/"
             "service-accounts/default/token",
             headers={"Metadata-Flavor": "Google"},
         )
         with urllib.request.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read())["access_token"]
+            payload = json.loads(resp.read())
+        self._token_value = payload["access_token"]
+        self._token_expiry = time.time() + float(payload.get("expires_in", 300))
+        return self._token_value
 
     def _call(self, method: str, url: str, body: Optional[dict] = None) -> dict:
         import json
@@ -212,9 +220,20 @@ class GCPTPUNodeProvider(NodeProvider):
     _MAX_ABSENT_POLLS = 24  # ~2 min at the 5s autoscaler tick
 
     def non_terminated_nodes(self) -> List[str]:
-        listed = {
-            n["name"].rsplit("/", 1)[-1]: n.get("state", "") for n in self.api.list()
-        }
+        nodes = self.api.list()
+        listed = {n["name"].rsplit("/", 1)[-1]: n.get("state", "") for n in nodes}
+        # adopt cloud nodes carrying our label that we don't track (provider
+        # restart, or a slow-provisioning node we'd given up on): orphans
+        # would otherwise bill forever with no way to terminate them
+        for n in nodes:
+            nid = n["name"].rsplit("/", 1)[-1]
+            ntype = (n.get("labels") or {}).get("ray-tpu-node-type")
+            if (
+                ntype
+                and nid not in self._nodes
+                and n.get("state", "") not in self._TERMINAL_STATES
+            ):
+                self._nodes[nid] = ntype
         for nid in list(self._nodes):
             state = listed.get(nid)
             if state is None:

@@ -118,18 +118,42 @@ class _Op:
     fn: Callable
     batch_size: Optional[int] = None
     fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # "tasks" (stateless, one task per block) or "actors" (a pool of
+    # stateful workers; callable classes are constructed once per worker —
+    # reference: _internal/execution/operators/actor_pool_map_operator.py)
+    compute: str = "tasks"
+    num_actors: int = 2
+    fn_constructor_args: tuple = ()
 
 
-def _apply_ops(block, ops: List[_Op]):
-    """Runs inside a task: fold the op chain over one block."""
+def _op_callable(op: _Op, cache: Optional[Dict[int, Callable]]) -> Callable:
+    """Resolve the op's fn: callable classes are instantiated ONCE per
+    cache (the actor-pool contract: expensive state like models or
+    tokenizers loads once per worker — _MapWorker passes its own long-lived
+    cache; the stateless task path rebuilds per task)."""
+    fn = op.fn
+    if isinstance(fn, type):
+        if cache is None:
+            return fn(*op.fn_constructor_args)
+        key = id(op)
+        inst = cache.get(key)
+        if inst is None:
+            inst = cache[key] = fn(*op.fn_constructor_args)
+        return inst
+    return fn
+
+
+def _apply_ops(block, ops: List[_Op], cache: Optional[Dict[int, Callable]] = None):
+    """Runs inside a task/actor: fold the op chain over one block."""
     for op in ops:
         if op.kind == "map_batches":
+            fn = _op_callable(op, cache)
             if op.batch_size is None:
-                block = op.fn(block, **op.fn_kwargs)
+                block = fn(block, **op.fn_kwargs)
             else:
                 n = _block_num_rows(block)
                 outs = [
-                    op.fn(_block_slice(block, s, min(s + op.batch_size, n)), **op.fn_kwargs)
+                    fn(_block_slice(block, s, min(s + op.batch_size, n)), **op.fn_kwargs)
                     for s in builtins.range(0, n, op.batch_size)
                 ]
                 block = _block_concat(outs) if outs else block
@@ -152,6 +176,37 @@ def _execute_block(block_fn, ops: List[_Op]):
     execute off-driver so I/O parallelizes and the driver stays off the data
     path (reference: plan_read_op.py fuses read+transform into one task)."""
     return _apply_ops(block_fn(), ops)
+
+
+class _MapWorker:
+    """Stateful pool worker for compute="actors" map operators: the op
+    chain (and any callable-class state) lives for the actor's lifetime
+    (reference: actor_pool_map_operator.py's _MapWorker)."""
+
+    def __init__(self, ops: List[_Op]):
+        self._ops = ops
+        self._cache: Dict[int, Callable] = {}
+
+    def run(self, block_fn):
+        return _apply_ops(block_fn(), self._ops, self._cache)
+
+
+def _block_size_bytes(block) -> int:
+    """Approximate in-memory size of a block (backpressure accounting)."""
+    if isinstance(block, np.ndarray):
+        return int(block.nbytes)
+    if isinstance(block, dict):
+        return sum(_block_size_bytes(v) for v in block.values())
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return int(block.nbytes)
+    except ImportError:
+        pass
+    if isinstance(block, (list, tuple)):
+        return 64 * len(block)  # rough row-overhead guess
+    return 1024
 
 
 class Dataset:
@@ -179,9 +234,24 @@ class Dataset:
         *,
         batch_size: Optional[int] = None,
         fn_kwargs: Optional[Dict[str, Any]] = None,
+        compute: str = "tasks",
+        num_actors: int = 2,
+        fn_constructor_args: tuple = (),
         **_,
     ) -> "Dataset":
-        return self._with_op(_Op("map_batches", fn, batch_size, fn_kwargs or {}))
+        """compute="actors" runs this op (and the rest of the chain) on a
+        pool of stateful worker actors; pass a callable CLASS as `fn` to
+        construct per-worker state once (reference: actor_pool_map_operator).
+        """
+        if isinstance(fn, type) and compute != "actors":
+            raise ValueError("callable-class map_batches requires compute='actors'")
+        return self._with_op(
+            _Op(
+                "map_batches", fn, batch_size, fn_kwargs or {},
+                compute=compute, num_actors=num_actors,
+                fn_constructor_args=tuple(fn_constructor_args),
+            )
+        )
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return self._with_op(_Op("map", fn))
@@ -500,30 +570,78 @@ class Dataset:
     def _compute_blocks(self, parallel: bool = True) -> List[Any]:
         return list(self._iter_computed_blocks(parallel=parallel))
 
-    def _iter_computed_blocks(self, parallel: bool = True, window: int = 4):
-        """Streaming block computation: submit up to `window` block tasks
-        ahead and yield in order (backpressure against unbounded memory)."""
+    def _iter_computed_blocks(
+        self,
+        parallel: bool = True,
+        window: int = 4,
+        max_in_flight_bytes: Optional[int] = None,
+    ):
+        """Streaming block computation with bounded memory: submit up to
+        `window` block tasks ahead, and additionally shrink the effective
+        window so (observed avg block size x in-flight) stays under
+        `max_in_flight_bytes` (reference: streaming_executor.py:48
+        resource-aware backpressure, collapsed to a byte budget).
+
+        If any op in the chain has compute="actors", the WHOLE chain runs on
+        a pool of stateful _MapWorker actors (round-robin, same windowing)."""
         import ray_tpu
 
         ops = self._ops
-        use_tasks = parallel and ray_tpu.is_initialized() and len(self._block_fns) > 1
+        use_cluster = parallel and ray_tpu.is_initialized() and len(self._block_fns) > 1
 
-        if not use_tasks:
+        if not use_cluster:
+            cache: Dict[int, Callable] = {}
             for fn in self._block_fns:
-                yield _apply_ops(fn(), ops)
+                yield _apply_ops(fn(), ops, cache)
             return
 
-        exec_task = ray_tpu.remote(_execute_block)
-        pending: List[Any] = []
-        fn_iter = iter(self._block_fns)
-        for fn in itertools.islice(fn_iter, window):
-            pending.append(exec_task.remote(fn, ops))
-        while pending:
-            ref = pending.pop(0)
-            nxt = next(fn_iter, None)
-            if nxt is not None:
-                pending.append(exec_task.remote(nxt, ops))
-            yield ray_tpu.get(ref)
+        actor_ops = [op for op in ops if op.compute == "actors"]
+        actors = []
+        if actor_ops:
+            n = max(1, min(actor_ops[0].num_actors, len(self._block_fns)))
+            worker_cls = ray_tpu.remote(_MapWorker)
+            actors = [worker_cls.remote(ops) for _ in builtins.range(n)]
+            rr = itertools.cycle(actors)
+
+            def submit(fn):
+                return next(rr).run.remote(fn)
+        else:
+            exec_task = ray_tpu.remote(_execute_block)
+
+            def submit(fn):
+                return exec_task.remote(fn, ops)
+
+        avg_bytes = 0.0
+        fetched = 0
+
+        def effective_window() -> int:
+            if max_in_flight_bytes is None or fetched == 0:
+                return window
+            return max(1, min(window, int(max_in_flight_bytes // max(1.0, avg_bytes))))
+
+        try:
+            pending: List[Any] = []
+            fn_iter = iter(self._block_fns)
+            for fn in itertools.islice(fn_iter, effective_window()):
+                pending.append(submit(fn))
+            while pending:
+                ref = pending.pop(0)
+                block = ray_tpu.get(ref)
+                size = _block_size_bytes(block)
+                avg_bytes = (avg_bytes * fetched + size) / (fetched + 1)
+                fetched += 1
+                while len(pending) < effective_window():
+                    nxt = next(fn_iter, None)
+                    if nxt is None:
+                        break
+                    pending.append(submit(nxt))
+                yield block
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
     def materialize(self) -> "Dataset":
         blocks = self._compute_blocks()
@@ -541,9 +659,12 @@ class Dataset:
         batch_size: int = 256,
         drop_last: bool = False,
         prefetch_blocks: int = 2,
+        max_in_flight_bytes: Optional[int] = None,
     ) -> Iterator[Batch]:
         carry = None
-        for block in self._iter_computed_blocks(window=max(1, prefetch_blocks)):
+        for block in self._iter_computed_blocks(
+            window=max(1, prefetch_blocks), max_in_flight_bytes=max_in_flight_bytes
+        ):
             if carry is not None:
                 block = _block_concat([carry, block])
                 carry = None

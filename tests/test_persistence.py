@@ -83,11 +83,10 @@ class FakeTPUApi:
             {
                 "name": f"projects/p/locations/z/nodes/{nid}",
                 "state": self.states.get(nid, "READY"),
+                "labels": (body.get("labels") or {}),
             }
-            for nid in self.created
+            for nid, body in self.created.items()
         ]
-
-    states: dict = {}
 
 
 def test_gcp_tpu_provider_against_fake_api():
@@ -114,8 +113,15 @@ def test_gcp_tpu_provider_against_fake_api():
     napi = FakeTPUApi()
     p2 = GCPTPUNodeProvider(head_address="h:1", api=napi)
     pending = p2.create_node("v5e-4", {})
-    napi.created.pop(pending)  # not visible in list yet
+    body = napi.created.pop(pending)  # not visible in list yet
     assert p2.non_terminated_nodes() == [pending]
+
+    # a labeled cloud node unknown to a (restarted) provider is ADOPTED so
+    # it can be idle-terminated instead of billing forever
+    napi.created[pending] = body
+    p3 = GCPTPUNodeProvider(head_address="h:1", api=napi)
+    assert p3.non_terminated_nodes() == [pending]
+    assert p3.node_type_of(pending) == "v5e-4"
 
     nid2 = provider.create_node("v4-8", {})
     provider.terminate_node(nid2)
