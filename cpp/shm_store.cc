@@ -110,11 +110,56 @@ void init_mutex(ControlBlock* cb) {
   }
 }
 
+// '\1' marks a tombstone: a deleted slot that keeps probe chains intact
+// (plain '\0' would terminate lookups for colliding live entries).
+constexpr char kTombstone = '\1';
+
+void repair_ranges(ControlBlock* cb) {
+  // A holder died mid-update (force-killed worker): the range table may be
+  // mid-memmove. Rebuild it from the OBJECT ENTRY table — each live entry
+  // carries the authoritative offset/size of its allocation — rather than
+  // filtering the possibly-torn ranges[] (filtering after one torn slot
+  // would drop every later live range and let the allocator hand out space
+  // still served to readers). Entries torn mid-init are caught by the
+  // bounds/overlap filter below; at worst a torn entry's space leaks until
+  // its object is deleted.
+  int64_t cap = cb->capacity.load();
+  int out = 0;
+  for (int i = 0; i < kMaxObjects && out < kMaxObjects; ++i) {
+    ObjectEntry* e = &cb->entries[i];
+    if (e->name[0] == '\0' || e->name[0] == kTombstone) continue;
+    int64_t size = e->size.load();
+    int64_t off = e->offset.load();
+    int64_t alloc = size ? (size + kAlign - 1) / kAlign * kAlign : kAlign;
+    if (off < 0 || alloc <= 0 || off + alloc > cap) continue;
+    cb->ranges[out++] = {off, alloc};
+  }
+  // sort by offset (insertion sort: out is small and mostly sorted)
+  for (int i = 1; i < out; ++i) {
+    AllocRange key = cb->ranges[i];
+    int j = i - 1;
+    while (j >= 0 && cb->ranges[j].off > key.off) {
+      cb->ranges[j + 1] = cb->ranges[j];
+      --j;
+    }
+    cb->ranges[j + 1] = key;
+  }
+  // drop overlapping survivors (torn entries): keep the earlier one
+  int64_t prev_end = 0;
+  int kept = 0;
+  for (int i = 0; i < out; ++i) {
+    if (cb->ranges[i].off < prev_end) continue;
+    cb->ranges[kept] = cb->ranges[i];
+    prev_end = cb->ranges[kept].off + cb->ranges[kept].size;
+    ++kept;
+  }
+  cb->nranges = kept;
+}
+
 void lock_cb(ControlBlock* cb) {
   int r = pthread_mutex_lock(&cb->mu);
   if (r == EOWNERDEAD) {
-    // owner died mid-section; the range table is best-effort consistent
-    // (memmove of POD ranges) — mark recovered and continue
+    repair_ranges(cb);
     pthread_mutex_consistent(&cb->mu);
   }
 }
@@ -129,10 +174,6 @@ uint64_t fnv1a(const char* s) {
   }
   return h;
 }
-
-// '\1' marks a tombstone: a deleted slot that keeps probe chains intact
-// (plain '\0' would terminate lookups for colliding live entries).
-constexpr char kTombstone = '\1';
 
 ObjectEntry* find_entry(ControlBlock* cb, const char* name, bool create) {
   uint64_t h = fnv1a(name) % kMaxObjects;
@@ -317,10 +358,15 @@ void* shm_store_get(void* handle, const char* object_name, int64_t* size_out) {
     unlock_cb(cb);
     return nullptr;
   }
+  int64_t size = e->size.load();
+  int64_t off = e->offset.load();
+  if (off < 0 || size < 0 || off + size > h->data_len) {
+    unlock_cb(cb);  // corrupt entry (killed producer): refuse the pointer
+    return nullptr;
+  }
   e->refs.fetch_add(1);
   e->last_use_ns.store(now_tick(cb));
-  *size_out = e->size.load();
-  int64_t off = e->offset.load();
+  *size_out = size;
   unlock_cb(cb);
   return base + off;
 }

@@ -60,11 +60,15 @@ def init(
     address resolution)."""
     import os as _os
 
+    global _overrides_before_init
     if global_worker.connected:
         if ignore_reinit_error:
             return _ctx()
         raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
     if _system_config:
+        # session-scoped: shutdown() restores — overrides from one session
+        # (e.g. a test's aggressive prober) must not leak into the next
+        _overrides_before_init = dict(GLOBAL_CONFIG._overrides)
         GLOBAL_CONFIG.apply(_system_config)
     address = address or _os.environ.get("RAY_TPU_ADDRESS")
     if address:
@@ -127,7 +131,11 @@ def _ctx():
     }
 
 
+_overrides_before_init = None
+
+
 def shutdown():
+    global _overrides_before_init
     # close the driver's own connection before stopping the IO loop so its
     # read task is cancelled cleanly (otherwise asyncio warns about a
     # destroyed pending task at loop teardown)
@@ -142,6 +150,12 @@ def shutdown():
     global_worker.disconnect()
     if node is not None:
         node.stop()
+    # only now drop this session's _system_config overrides: the head's own
+    # teardown (final snapshot etc.) must still see them, but they must not
+    # leak into the next session
+    if _overrides_before_init is not None:
+        GLOBAL_CONFIG._overrides = _overrides_before_init
+        _overrides_before_init = None
 
 
 def is_initialized() -> bool:

@@ -77,11 +77,11 @@ class Config:
 D = Config.define
 # --- core runtime ---
 D("raylet_heartbeat_period_ms", int, 1000, "worker->head heartbeat period")
-D("health_check_period_ms", int, 3000, "head-side liveness probe period")
-D("health_check_failure_threshold", int, 10,
-  "consecutive failed probes before a worker/node is declared dead (~30s "
-  "with the default period: long GIL-holding stretches, e.g. jax traces, "
-  "must not look like hangs)")
+D("health_check_period_ms", int, 5000, "head-side liveness probe period")
+D("health_check_failure_threshold", int, 24,
+  "consecutive failed probes before a worker/node is declared dead (~2min "
+  "with the default period: long GIL-holding stretches — jax traces and "
+  "XLA compiles on loaded hosts — must not look like hangs)")
 D("worker_register_timeout_s", float, 30.0, "max wait for a spawned worker to register")
 D("task_retry_delay_ms", int, 100, "delay before retrying a failed task")
 D("max_pending_lease_requests", int, 1024)
@@ -110,6 +110,9 @@ D("head_tcp_host", str, "127.0.0.1",
   "unauthenticated pickle, so bind non-loopback (0.0.0.0) only on trusted "
   "networks (real multi-host deployments)")
 D("head_tcp_port", int, 0, "bind port for the TCP control plane (0 = ephemeral)")
+D("dashboard_enabled", bool, True, "serve the dashboard-lite HTTP endpoint")
+D("dashboard_host", str, "127.0.0.1")
+D("dashboard_port", int, 0, "dashboard port (0 = ephemeral)")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

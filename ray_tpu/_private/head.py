@@ -397,6 +397,14 @@ class Head:
             self._snapshot_task = asyncio.get_running_loop().create_task(
                 self._snapshot_loop()
             )
+        if cfg.dashboard_enabled:
+            from ..dashboard import Dashboard
+
+            self.dashboard = Dashboard(self)
+            addr = await self.dashboard.start(cfg.dashboard_host, cfg.dashboard_port)
+            if addr:
+                with open(os.path.join(self.session_dir, "dashboard_addr"), "w") as f:
+                    f.write(addr)
         # liveness prober: a hung worker/agent keeps its socket open, so
         # connection-close detection alone misses it (reference:
         # gcs_health_check_manager.h:39 periodic health checks)
@@ -638,6 +646,8 @@ class Head:
             self.server.close()
         if self.tcp_server is not None:
             self.tcp_server.close()
+        if getattr(self, "dashboard", None) is not None:
+            await self.dashboard.stop()
         # Close remaining client connections (incl. the driver's); 3.12's
         # Server.wait_closed would otherwise wait on them forever.
         for conn in list(self._client_conns):

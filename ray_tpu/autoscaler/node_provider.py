@@ -175,7 +175,7 @@ class GCPTPUNodeProvider(NodeProvider):
         self.name_prefix = name_prefix
         self._nodes: Dict[str, str] = {}
         self._absent_polls: Dict[str, int] = {}
-        self._counter = itertools.count(1)
+        self._next_index = 1
 
     def _startup_script(self, node_id: str, num_tpus: float) -> str:
         return (
@@ -189,7 +189,8 @@ class GCPTPUNodeProvider(NodeProvider):
         accel = GCP_ACCELERATOR_TYPES.get(node_type, node_type)
         merged = dict(TPU_SLICE_TOPOLOGIES.get(node_type, {}))
         merged.update(resources)
-        node_id = f"{self.name_prefix}-{node_type}-{next(self._counter)}"
+        node_id = f"{self.name_prefix}-{node_type}-{self._next_index}"
+        self._next_index += 1
         self.api.create(
             node_id,
             {
@@ -200,7 +201,13 @@ class GCPTPUNodeProvider(NodeProvider):
                         node_id, merged.get("TPU", 0)
                     ),
                 },
-                "labels": {"ray-tpu-node-type": node_type},
+                # the cluster label scopes adoption/termination to THIS
+                # cluster's nodes — two clusters in one project/zone must
+                # never adopt (and idle-terminate) each other's slices
+                "labels": {
+                    "ray-tpu-node-type": node_type,
+                    "ray-tpu-cluster": self.name_prefix,
+                },
             },
         )
         self._nodes[node_id] = node_type
@@ -227,13 +234,20 @@ class GCPTPUNodeProvider(NodeProvider):
         # would otherwise bill forever with no way to terminate them
         for n in nodes:
             nid = n["name"].rsplit("/", 1)[-1]
-            ntype = (n.get("labels") or {}).get("ray-tpu-node-type")
+            labels = n.get("labels") or {}
+            ntype = labels.get("ray-tpu-node-type")
             if (
                 ntype
+                and labels.get("ray-tpu-cluster") == self.name_prefix
                 and nid not in self._nodes
                 and n.get("state", "") not in self._TERMINAL_STATES
             ):
                 self._nodes[nid] = ntype
+                # keep fresh names ahead of adopted ones (a restarted
+                # provider re-creating 'prefix-type-1' would hit 409)
+                tail = nid.rsplit("-", 1)[-1]
+                if tail.isdigit():
+                    self._next_index = max(self._next_index, int(tail) + 1)
         for nid in list(self._nodes):
             state = listed.get(nid)
             if state is None:

@@ -1,0 +1,153 @@
+"""Dashboard-lite: one HTTP endpoint on the head serving cluster state.
+
+Reference parity: dashboard/head.py + http_server_head.py +
+state_aggregator.py — collapsed to a minimal asyncio HTTP server running on
+the head's own event loop (no aiohttp, no per-node agents, no React build):
+JSON APIs over the same tables the state CLI reads, plus one self-contained
+HTML page that polls them. The 25.9k-LoC reference dashboard's essential
+surface — what is running where, live — in one file.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
+h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
+th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
+th{background:#f0f0f0} .dead{color:#b00} .alive{color:#080}
+#res{font-size:.9rem;margin:.3rem 0}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="res"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Tasks (last 50)</h2><table id="tasks"></table>
+<script>
+function esc(s){
+  return String(s).replace(/[&<>"']/g,
+    c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+function fill(id, rows, cols){
+  const t = document.getElementById(id);
+  if(!rows.length){t.innerHTML = "<tr><td>(empty)</td></tr>"; return;}
+  let h = "<tr>" + cols.map(c=>`<th>${esc(c)}</th>`).join("") + "</tr>";
+  for(const r of rows){
+    h += "<tr>" + cols.map(c=>{
+      let v = r[c]; if(typeof v === "object" && v !== null) v = JSON.stringify(v);
+      let cls = (c=="state"||c=="alive"||c=="status") ?
+        ((v=="dead"||v==false||v=="FAILED")?"dead":"alive") : "";
+      return `<td class="${cls}">${v == null ? "" : esc(v)}</td>`;
+    }).join("") + "</tr>";
+  }
+  t.innerHTML = h;
+}
+async function tick(){
+  try{
+    const [res, nodes, actors, workers, jobs, tasks] = await Promise.all(
+      ["cluster","nodes","actors","workers","jobs","tasks"].map(
+        p=>fetch("/api/"+p).then(r=>r.json())));
+    document.getElementById("res").textContent =
+      Object.entries(res.total).map(([k,v])=>
+        `${k}: ${Math.round((res.available[k]??0)*100)/100}/${Math.round(v*100)/100}`).join("   ");
+    fill("nodes", nodes, ["node_id","alive","resources","available"]);
+    fill("actors", actors, ["actor_id","class_name","name","state","worker_id"]);
+    fill("workers", workers, ["worker_id","node_id","state","actor_id","pid"]);
+    fill("jobs", jobs, ["submission_id","status","entrypoint","log_path"]);
+    fill("tasks", tasks.slice(-50).reverse(), ["task_id","name","state","node_id","worker_id"]);
+  }catch(e){ document.getElementById("res").textContent = "head unreachable: "+e; }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+class Dashboard:
+    """Serves the head's state over HTTP, sharing the head's event loop so
+    handlers read the tables directly (no RPC hop, no races: the loop
+    serializes against the control plane)."""
+
+    def __init__(self, head):
+        self.head = head
+        self.server: Optional[asyncio.base_events.Server] = None
+        self.address: Optional[str] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Optional[str]:
+        try:
+            self.server = await asyncio.start_server(self._on_client, host=host, port=port)
+        except OSError:
+            return None
+        from ._private.head import _advertise_host
+
+        bound = self.server.sockets[0].getsockname()
+        self.address = f"{_advertise_host(host)}:{bound[1]}"
+        return self.address
+
+    async def stop(self):
+        if self.server is not None:
+            self.server.close()
+
+    async def _on_client(self, reader, writer):
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=10)
+            parts = request.decode("latin1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, body = await self._route(path)
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+            )
+            writer.write(body)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, path: str):
+        if path in ("/", "/index.html"):
+            return "200 OK", "text/html; charset=utf-8", _PAGE.encode()
+        if not path.startswith("/api/"):
+            return "404 Not Found", "text/plain", b"not found"
+        kind = path[len("/api/"):].split("?")[0]
+        handlers = {
+            "nodes": {"t": "nodes"},
+            "actors": {"t": "list_actors"},
+            "workers": {"t": "list_workers"},
+            "tasks": {"t": "list_tasks", "limit": 1000},
+            "objects": {"t": "list_objects"},
+            "jobs": {"t": "list_jobs"},
+            "cluster": {"t": "cluster_resources"},
+            "timeline": {"t": "timeline"},
+            "metrics": {"t": "get_metrics"},
+        }
+        msg = handlers.get(kind)
+        if msg is None:
+            return "404 Not Found", "text/plain", b"unknown api"
+        data = await self.head.handle(None, dict(msg))
+        body = json.dumps(data, default=str).encode()
+        return "200 OK", "application/json", body
+
+
+def dashboard_url(session_dir: str) -> Optional[str]:
+    """Read the live dashboard address for a session (None if disabled)."""
+    import os
+
+    try:
+        with open(os.path.join(session_dir, "dashboard_addr")) as f:
+            return "http://" + f.read().strip()
+    except OSError:
+        return None

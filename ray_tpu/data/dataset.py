@@ -598,7 +598,10 @@ class Dataset:
         actor_ops = [op for op in ops if op.compute == "actors"]
         actors = []
         if actor_ops:
-            n = max(1, min(actor_ops[0].num_actors, len(self._block_fns)))
+            # the chain shares one pool: honor the LARGEST request among its
+            # actor ops (silently using op[0]'s size would shrink a user's
+            # explicit pool for the expensive op)
+            n = max(1, min(max(op.num_actors for op in actor_ops), len(self._block_fns)))
             worker_cls = ray_tpu.remote(_MapWorker)
             actors = [worker_cls.remote(ops) for _ in builtins.range(n)]
             rr = itertools.cycle(actors)
@@ -615,8 +618,12 @@ class Dataset:
         fetched = 0
 
         def effective_window() -> int:
-            if max_in_flight_bytes is None or fetched == 0:
+            if max_in_flight_bytes is None:
                 return window
+            if fetched == 0:
+                # no size observation yet: a full-window burst could blow
+                # the budget arbitrarily — probe with one block first
+                return 1
             return max(1, min(window, int(max_in_flight_bytes // max(1.0, avg_bytes))))
 
         try:

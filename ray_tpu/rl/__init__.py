@@ -30,3 +30,4 @@ from .replay_buffer import ReplayBuffer  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401
+from . import offline  # noqa: F401,E402

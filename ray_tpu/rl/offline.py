@@ -1,0 +1,101 @@
+"""Offline RL IO: write rollout experience to disk, read it back for
+offline training (behavior cloning / offline evaluation).
+
+Reference parity: rllib/offline/ (json_writer.py / json_reader.py /
+dataset_reader.py) — SampleBatches serialize to sharded .npz files (columns
+are numpy arrays already; npz keeps them zero-parse and compact vs the
+reference's base64-in-JSON rows), and readers stream shards through the
+data layer so offline datasets compose with map_batches/shuffle/split.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .sample_batch import SampleBatch, concat_samples
+
+
+class JsonWriter:
+    """Append SampleBatches to sharded files under a directory.
+
+    (Name kept for reference parity; the on-disk format is npz shards.)"""
+
+    def __init__(self, path: str, *, max_rows_per_file: int = 5000):
+        self.path = path
+        self.max_rows = max_rows_per_file
+        os.makedirs(path, exist_ok=True)
+        self._pending: List[SampleBatch] = []
+        self._rows = 0
+        self._shard = len(glob.glob(os.path.join(path, "shard-*.npz")))
+
+    def write(self, batch: SampleBatch) -> None:
+        self._pending.append(batch)
+        self._rows += len(batch)
+        if self._rows >= self.max_rows:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        merged = concat_samples(self._pending)
+        out = os.path.join(self.path, f"shard-{self._shard:06d}.npz")
+        tmp = out + ".tmp.npz"  # .npz suffix: savez must not append one
+        np.savez_compressed(tmp, **{k: np.asarray(v) for k, v in merged.items()})
+        os.replace(tmp, out)
+        self._shard += 1
+        self._pending = []
+        self._rows = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()
+
+
+def _load_shard(path: str) -> SampleBatch:
+    with np.load(path) as z:
+        return SampleBatch({k: z[k] for k in z.files})
+
+
+class JsonReader:
+    """Stream SampleBatches back from a written directory."""
+
+    def __init__(self, path: str, *, shuffle: bool = False, seed: Optional[int] = None):
+        self.files = sorted(glob.glob(os.path.join(path, "shard-*.npz")))
+        if not self.files:
+            raise FileNotFoundError(f"no offline shards under {path}")
+        if shuffle:
+            np.random.default_rng(seed).shuffle(self.files)
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for f in self.files:
+            yield _load_shard(f)
+
+    def read_all(self) -> SampleBatch:
+        return concat_samples([_load_shard(f) for f in self.files])
+
+
+def to_dataset(path: str):
+    """Expose an offline directory as a Dataset of SampleBatch blocks
+    (composes with the data layer: map_batches, split_at, actor pools)."""
+    from ..data.dataset import Dataset
+
+    files = sorted(glob.glob(os.path.join(path, "shard-*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no offline shards under {path}")
+    return Dataset([lambda f=f: _load_shard(f) for f in files])
+
+
+def write_dataset(batches: Sequence[SampleBatch], path: str, **kw) -> int:
+    """Convenience: write a sequence of batches; returns total rows."""
+    total = 0
+    with JsonWriter(path, **kw) as w:
+        for b in batches:
+            w.write(b)
+            total += len(b)
+    return total

@@ -195,6 +195,7 @@ def main(argv=None) -> None:
     p_tl = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p_tl.add_argument("-o", "--output", default="timeline.json")
     sub.add_parser("metrics", help="dump metrics (prometheus-ish text)")
+    sub.add_parser("dashboard", help="print (and open) the live dashboard URL")
     p_start = sub.add_parser("start", help="start a head or join as a node agent")
     p_start.add_argument("--head", action="store_true")
     p_start.add_argument("--address", help="head host:port to join as a node")
@@ -206,6 +207,20 @@ def main(argv=None) -> None:
 
     if args.cmd == "start":
         cmd_start(args)
+        return
+    if args.cmd == "dashboard":
+        from ray_tpu.dashboard import dashboard_url
+
+        url = dashboard_url(_find_session(args.session_dir))
+        if url is None:
+            sys.exit("dashboard disabled for this session")
+        print(url)
+        try:
+            import webbrowser
+
+            webbrowser.open(url)
+        except Exception:
+            pass
         return
 
     obs = _Observer(_find_session(args.session_dir))

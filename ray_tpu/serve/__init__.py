@@ -67,9 +67,13 @@ def run(
     *,
     name: str = "default",
     route_prefix: Optional[str] = None,
+    pass_request: bool = False,
     _blocking: bool = True,
 ) -> DeploymentHandle:
-    """Deploy an application graph; returns a handle to its ingress."""
+    """Deploy an application graph; returns a handle to its ingress.
+
+    pass_request=True hands the ingress deployment a http_proxy.Request
+    (method/path/query/headers/body) instead of just the parsed body."""
     import ray_tpu
 
     if not isinstance(app, Application):
@@ -98,7 +102,7 @@ def run(
 
     if route_prefix is not None:
         proxy = start_http_proxy()
-        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress))
+        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress, pass_request))
     return DeploymentHandle(ingress)
 
 

@@ -1,61 +1,186 @@
-"""Minimal HTTP ingress: JSON POST/GET -> ingress DeploymentHandle.
+"""HTTP ingress: content-type-aware request/response handling over
+longest-prefix routes -> ingress DeploymentHandles.
 
-Reference parity: serve/_private/http_proxy.py:320 (HTTPProxy / HTTPProxyActor).
-The reference rides uvicorn+starlette; here a stdlib ThreadingHTTPServer is
-enough — TPU model serving is throughput-bound on the replicas, not the
-ingress parser.
+Reference parity: serve/_private/http_proxy.py:320 (HTTPProxy /
+HTTPProxyActor, uvicorn+starlette). Rebuilt on a stdlib ThreadingHTTPServer
+(one thread per in-flight request; TPU model serving is throughput-bound on
+the replicas, not the ingress parser) with the reference's routing and body
+semantics:
+  - longest-prefix route match (an app at "/app" serves "/app/anything");
+    the matched remainder + query string ride along for handlers that want
+    them (pass_request=True deployments receive a Request object)
+  - JSON bodies parse to Python values; other content types pass through as
+    raw bytes
+  - responses: bytes -> application/octet-stream, str -> text/plain,
+    StreamingResponse -> chunked transfer, anything else -> {"result": ...}
+    JSON (the v1 wire shape, kept stable)
+  - per-proxy configurable request timeout (was a fixed 60s)
 """
 
 from __future__ import annotations
 
 import json
 import threading
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
+from typing import Any, Dict, Iterable, Optional
+from urllib.parse import parse_qs, urlsplit
+
+
+@dataclass
+class Request:
+    """What a deployment sees when it asks for the raw request."""
+
+    method: str
+    path: str            # full request path
+    route: str           # matched route prefix
+    subpath: str         # path remainder after the route
+    query: Dict[str, Any]
+    headers: Dict[str, str]
+    body: Any            # parsed JSON or raw bytes
+
+
+@dataclass
+class StreamingResponse:
+    """Chunked-transfer response: iterable of str/bytes chunks.
+
+    The iterable is materialized at construction (generators included) so
+    the response pickles across the replica->proxy actor boundary — actor
+    results are single messages; the streaming happens proxy->client."""
+
+    chunks: Iterable[Any]
+    content_type: str = "text/plain; charset=utf-8"
+
+    def __post_init__(self):
+        self.chunks = list(self.chunks)
+
+
+@dataclass
+class _Route:
+    prefix: str
+    handle: Any
+    pass_request: bool = False
 
 
 class HTTPProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        request_timeout_s: float = 60.0,
+    ):
         self.host = host
         self.port = port
-        self.routes: Dict[str, object] = {}  # route_prefix -> DeploymentHandle
+        self.request_timeout_s = request_timeout_s
+        self.routes: Dict[str, _Route] = {}
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
-            def _dispatch(self, body):
-                route = self.path.rstrip("/") or "/"
-                handle = proxy.routes.get(route)
-                if handle is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no app at this route"}')
-                    return
-                try:
-                    args = () if body is None else (body,)
-                    result = handle.remote(*args).result(timeout_s=60)
-                    payload = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                except Exception as e:  # noqa: BLE001
-                    payload = json.dumps({"error": repr(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
+            def _match(self, path: str) -> Optional[_Route]:
+                """Longest-prefix routing (reference: route_prefix semantics)."""
+                best = None
+                for prefix, route in proxy.routes.items():
+                    if path == prefix or path.startswith(
+                        prefix if prefix.endswith("/") else prefix + "/"
+                    ) or prefix == "/":
+                        if best is None or len(prefix) > len(best.prefix):
+                            best = route
+                return best
+
+            def _reply(self, status: int, ctype: str, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _reply_chunked(self, resp: StreamingResponse):
+                self.send_response(200)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for chunk in resp.chunks:
+                    data = chunk.encode() if isinstance(chunk, str) else bytes(chunk)
+                    if not data:
+                        continue
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+
+            def _dispatch(self, body):
+                parts = urlsplit(self.path)
+                path = parts.path.rstrip("/") or "/"
+                route = self._match(path)
+                if route is None:
+                    self._reply(404, "application/json",
+                                b'{"error": "no app at this route"}')
+                    return
+                if route.pass_request:
+                    arg = Request(
+                        method=self.command,
+                        path=parts.path,
+                        route=route.prefix,
+                        subpath=path[len(route.prefix):].lstrip("/"),
+                        query={k: v[0] if len(v) == 1 else v
+                               for k, v in parse_qs(parts.query).items()},
+                        headers={k.lower(): v for k, v in self.headers.items()},
+                        body=body,
+                    )
+                    args = (arg,)
+                else:
+                    args = () if body is None else (body,)
+                try:
+                    result = route.handle.remote(*args).result(
+                        timeout_s=proxy.request_timeout_s
+                    )
+                    if isinstance(result, StreamingResponse):
+                        self._reply_chunked(result)
+                        return
+                    if isinstance(result, (bytes, bytearray, memoryview)):
+                        self._reply(200, "application/octet-stream", bytes(result))
+                        return
+                    if isinstance(result, str):
+                        self._reply(200, "text/plain; charset=utf-8", result.encode())
+                        return
+                    # serialization stays inside the try: a non-JSON-able
+                    # result must 500, not drop the connection
+                    payload = json.dumps({"result": result}).encode()
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, "application/json",
+                                json.dumps({"error": repr(e)}).encode())
+                    return
+                self._reply(200, "application/json", payload)
 
             def do_GET(self):
                 self._dispatch(None)
 
-            def do_POST(self):
+            def do_DELETE(self):
+                self._dispatch(None)
+
+            def _read_body(self):
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n) if n else b""
-                try:
-                    body = json.loads(raw) if raw else None
-                except json.JSONDecodeError:
-                    body = raw.decode()
-                self._dispatch(body)
+                ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+                if not raw:
+                    return None
+                if ctype in ("application/json", "", "text/json"):
+                    try:
+                        return json.loads(raw)
+                    except json.JSONDecodeError:
+                        pass
+                if ctype.startswith("text/"):
+                    return raw.decode(errors="replace")
+                return raw  # binary passthrough
+
+            def do_POST(self):
+                self._dispatch(self._read_body())
+
+            def do_PUT(self):
+                self._dispatch(self._read_body())
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -65,14 +190,25 @@ class HTTPProxyActor:
     def ready(self):
         return {"host": self.host, "port": self.port}
 
-    def set_route(self, route_prefix: str, deployment_name: str):
+    def set_route(
+        self, route_prefix: str, deployment_name: str, pass_request: bool = False
+    ):
         from .handle import DeploymentHandle
 
-        self.routes[route_prefix.rstrip("/") or "/"] = DeploymentHandle(deployment_name)
+        prefix = route_prefix.rstrip("/") or "/"
+        self.routes[prefix] = _Route(
+            prefix=prefix,
+            handle=DeploymentHandle(deployment_name),
+            pass_request=pass_request,
+        )
         return True
 
     def remove_route(self, route_prefix: str):
         self.routes.pop(route_prefix.rstrip("/") or "/", None)
+        return True
+
+    def set_request_timeout(self, timeout_s: float):
+        self.request_timeout_s = float(timeout_s)
         return True
 
     def stop(self):

@@ -11,3 +11,4 @@ from .trainer import JaxTrainer  # noqa: F401
 from .config import ScalingConfig, RunConfig, FailureConfig, CheckpointConfig  # noqa: F401
 from .session import report, get_context  # noqa: F401
 from .checkpoint import Checkpoint, save_checkpoint, restore_checkpoint  # noqa: F401
+from .batch_predictor import BatchPredictor, JaxPredictor, Predictor  # noqa: F401,E402

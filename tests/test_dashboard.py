@@ -1,0 +1,68 @@
+"""Dashboard-lite HTTP endpoint (reference: dashboard/head.py scope cut to
+essentials — live nodes/actors/tasks/jobs over one JSON API + HTML page)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import dashboard_url
+
+
+@pytest.fixture
+def started():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _fetch(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def test_dashboard_serves_state(started):
+    from ray_tpu._private.worker import global_worker
+
+    url = dashboard_url(global_worker.session_dir)
+    assert url, "dashboard address file missing"
+
+    @ray_tpu.remote
+    class Marker:
+        def hi(self):
+            return "hi"
+
+    m = Marker.options(name="dash-marker").remote()
+    assert ray_tpu.get(m.hi.remote(), timeout=30) == "hi"
+
+    @ray_tpu.remote
+    def a_task():
+        return 1
+
+    ray_tpu.get(a_task.remote(), timeout=30)
+
+    page = _fetch(url + "/").decode()
+    assert "ray_tpu dashboard" in page
+
+    nodes = json.loads(_fetch(url + "/api/nodes"))
+    assert any(n["node_id"] == "node-head" and n["alive"] for n in nodes)
+
+    actors = json.loads(_fetch(url + "/api/actors"))
+    assert any(a["name"] == "dash-marker" for a in actors)
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tasks = json.loads(_fetch(url + "/api/tasks"))
+        if any(t["name"] == "a_task" and t["state"] == "done" for t in tasks):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("task never showed up in the dashboard")
+
+    cluster = json.loads(_fetch(url + "/api/cluster"))
+    assert cluster["total"].get("CPU") == 2.0
+
+    with pytest.raises(Exception):
+        _fetch(url + "/api/nope")

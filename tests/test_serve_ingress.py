@@ -1,0 +1,95 @@
+"""Upgraded Serve HTTP ingress: longest-prefix routing, binary/text bodies,
+content-type-aware responses, streaming (chunked) responses, configurable
+timeout (reference: serve/_private/http_proxy.py:320)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _addr():
+    return serve.proxy_address()
+
+
+def _get(path, **kw):
+    return urllib.request.urlopen(f"http://{_addr()}{path}", timeout=30, **kw)
+
+
+def test_longest_prefix_routing(serve_cluster):
+    @serve.deployment
+    def app_a(x=None):
+        return {"app": "a"}
+
+    @serve.deployment
+    def app_b(x=None):
+        return {"app": "b"}
+
+    serve.run(app_a.bind(), name="a", route_prefix="/api")
+    serve.run(app_b.bind(), name="b", route_prefix="/api/b")
+
+    with _get("/api/anything/deep") as r:
+        assert json.loads(r.read())["result"]["app"] == "a"
+    with _get("/api/b/sub") as r:
+        assert json.loads(r.read())["result"]["app"] == "b"
+
+
+def test_binary_and_text_responses(serve_cluster):
+    @serve.deployment
+    def blob(body=None):
+        if body == "text":
+            return "plain text out"
+        return bytes([1, 2, 3, 4])
+
+    serve.run(blob.bind(), name="blob", route_prefix="/blob")
+
+    req = urllib.request.Request(
+        f"http://{_addr()}/blob", data=b'"text"',
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert r.read() == b"plain text out"
+
+    with _get("/blob") as r:
+        assert r.headers["Content-Type"] == "application/octet-stream"
+        assert r.read() == bytes([1, 2, 3, 4])
+
+
+def test_binary_request_passthrough(serve_cluster):
+    @serve.deployment
+    def size_of(body):
+        return {"n": len(body), "kind": type(body).__name__}
+
+    serve.run(size_of.bind(), name="sz", route_prefix="/sz")
+    payload = bytes(range(256)) * 4
+    req = urllib.request.Request(
+        f"http://{_addr()}/sz", data=payload,
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())["result"]
+    assert out == {"n": 1024, "kind": "bytes"}
+
+
+def test_streaming_response(serve_cluster):
+    from ray_tpu.serve.http_proxy import StreamingResponse
+
+    @serve.deployment
+    def stream(body=None):
+        return StreamingResponse(chunks=[f"tok{i} " for i in range(5)])
+
+    serve.run(stream.bind(), name="stream", route_prefix="/gen")
+    with _get("/gen") as r:
+        assert r.read().decode() == "tok0 tok1 tok2 tok3 tok4 "
