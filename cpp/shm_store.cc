@@ -468,6 +468,84 @@ int64_t shm_store_evict(void* handle, int64_t want_bytes) {
   return freed;
 }
 
+// Spills up to want_bytes of PINNED, sealed, unpinned-by-readers objects to
+// files under spill_dir (LRU order), then reaps their slab space. Pinned
+// data (ray.put, actor results) has no lineage, so under memory pressure it
+// moves to disk instead of being dropped — the reference's plasma spilling
+// (local_object_manager.h:110), collapsed to a synchronous file write by
+// the producer that needs the space. Readers fall back to the spill file
+// (serialization.materialize). Returns bytes reclaimed.
+int64_t shm_store_spill_pinned(void* handle, int64_t want_bytes,
+                               const char* spill_dir) {
+  auto* h = static_cast<StoreHandle*>(handle);
+  ControlBlock* cb = h->ctrl;
+  char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
+  if (base == nullptr) return 0;
+  int64_t freed = 0;
+  while (freed < want_bytes) {
+    lock_cb(cb);
+    ObjectEntry* best = nullptr;
+    int64_t best_tick = INT64_MAX;
+    for (int i = 0; i < kMaxObjects; ++i) {
+      ObjectEntry* e = &cb->entries[i];
+      if (e->name[0] && e->name[0] != kTombstone && e->sealed.load() == 1 &&
+          e->refs.load() <= 0 && e->pinned.load()) {
+        int64_t t = e->last_use_ns.load();
+        if (t < best_tick) {
+          best_tick = t;
+          best = e;
+        }
+      }
+    }
+    if (best == nullptr) {
+      unlock_cb(cb);
+      break;
+    }
+    char name_copy[kNameLen];
+    strncpy(name_copy, best->name, kNameLen);
+    int64_t size = best->size.load();
+    int64_t off = best->offset.load();
+    if (off < 0 || size < 0 || off + size > h->data_len) {
+      reap_entry(cb, best);  // corrupt entry: just reclaim
+      unlock_cb(cb);
+      continue;
+    }
+    best->refs.fetch_add(1);  // hold while writing outside the lock
+    unlock_cb(cb);
+    char path[kNameLen * 8];
+    snprintf(path, sizeof(path), "%s/%s.bin", spill_dir, name_copy);
+    char tmp[kNameLen * 8 + 8];
+    snprintf(tmp, sizeof(tmp), "%s.tmp", path);
+    FILE* f = fopen(tmp, "wb");
+    bool ok = f != nullptr;
+    if (ok && size > 0) {
+      ok = fwrite(base + off, 1, (size_t)size, f) == (size_t)size;
+    }
+    if (f != nullptr) ok = (fclose(f) == 0) && ok;
+    if (ok) ok = (rename(tmp, path) == 0);
+    lock_cb(cb);
+    ObjectEntry* e2 = find_entry(cb, name_copy, false);
+    if (e2 != nullptr) {
+      e2->refs.fetch_sub(1);
+      if (e2->refs.load() <= 0 &&
+          (ok || e2->sealed.load() == kPendingDelete)) {
+        // reap on success; ALSO honor a delete that raced our write-hold
+        // (deferred-delete contract: last release reaps) even if the spill
+        // write failed — otherwise the range leaks for the session
+        int64_t used_before = cb->used.load();
+        reap_entry(cb, e2);
+        freed += used_before - cb->used.load();
+      }
+    }
+    unlock_cb(cb);
+    if (!ok) {
+      remove(tmp);
+      break;  // disk trouble: stop spilling
+    }
+  }
+  return freed;
+}
+
 // Pre-faults the whole data slab (write one byte per page). Run once per
 // machine from a background thread at head startup — after this, creates
 // run at memcpy speed instead of paying first-touch zero-fill (plasma

@@ -190,15 +190,11 @@ class Agent:
 
     async def _h_read_buffers(self, msg):
         """Serve node-local shm buffers to the head (cross-node object pull)."""
-        from .shm import ShmBufferRef
 
         shm = self._shm_client()
         out: Dict[str, Optional[bytes]] = {}
         for name in msg["names"]:
-            if shm is None:
-                out[name] = None
-                continue
-            mv = shm.get(ShmBufferRef(name=name, size=0))
+            mv = None if shm is None else shm.get_or_spilled(name)
             out[name] = None if mv is None else bytes(mv)
         return out
 

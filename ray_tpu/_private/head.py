@@ -302,6 +302,7 @@ class Head:
         self.metrics_store: Dict[str, dict] = {}
         # submitted jobs: submission_id -> record (entrypoint subprocess)
         self.jobs: Dict[str, dict] = {}
+        self._prestart_tasks: List[asyncio.Task] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -366,12 +367,10 @@ class Head:
             except Exception:
                 return {name: None for name in names}
         # head node and logical nodes share the head machine's shm plane
-        from .shm import ShmBufferRef
-
         shm = self._shm_client()
         out = {}
         for name in names:
-            mv = None if shm is None else shm.get(ShmBufferRef(name=name, size=0))
+            mv = None if shm is None else shm.get_or_spilled(name)
             out[name] = None if mv is None else bytes(mv)
         return out
 
@@ -397,6 +396,7 @@ class Head:
             self._snapshot_task = asyncio.get_running_loop().create_task(
                 self._snapshot_loop()
             )
+        self._prestart_workers(self._head_node_id)
         if cfg.dashboard_enabled:
             from ..dashboard import Dashboard
 
@@ -613,6 +613,8 @@ class Head:
             self._health_task.cancel()
         if getattr(self, "_snapshot_task", None) is not None:
             self._snapshot_task.cancel()
+        for t in list(self._prestart_tasks):
+            t.cancel()  # no fresh workers after the kill sweep below
         for job in self.jobs.values():
             if job["status"] == "RUNNING":
                 job["status"] = "STOPPED"
@@ -741,8 +743,38 @@ class Head:
         self.nodes[node_id] = NodeRecord(
             node_id, dict(msg["resources"]), labels=msg.get("labels", {}), conn=conn
         )
+        self._prestart_workers(node_id)
         self._pump()
         return {"session": os.path.basename(self.session_dir)}
+
+    def _prestart_workers(self, node_id: str):
+        """Pre-warm the node's idle pool so first tasks skip the process
+        cold start (interpreter spawn + register, ~0.5-2s). Reference:
+        worker_pool.h:420 prestarts workers up to the soft limit."""
+        n = cfg.worker_pool_prestart
+        if n <= 0:
+            return
+
+        async def _one():
+            w = await self._spawn_worker(node_id)
+            try:
+                await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
+            except asyncio.TimeoutError:
+                await self._kill_worker(w, reason="prestart register timeout")
+                return
+            if w.state == "idle" and not self._shutdown:
+                self.idle_workers[node_id].append(w.worker_id)
+                self._pump()
+
+        async def _spawn_idle():
+            # concurrent spawns: the pool warms in ONE cold-start interval,
+            # and a hung worker doesn't serialize the rest
+            await asyncio.gather(*(_one() for _ in range(n)), return_exceptions=True)
+
+        # keep a strong reference (loop holds tasks weakly) and cancel at stop
+        task = asyncio.get_running_loop().create_task(_spawn_idle())
+        self._prestart_tasks.append(task)
+        task.add_done_callback(lambda t: self._prestart_tasks.remove(t))
 
     async def _h_register_worker(self, conn, msg):
         w = self.workers.get(msg["worker_id"])

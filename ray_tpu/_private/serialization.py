@@ -157,7 +157,7 @@ def materialize(env: SerializedObject, shm_client) -> SerializedObject:
     for buf in refs:
         if buf.name in resolved:
             continue
-        mv = shm_client.get(buf) if shm_client is not None else None
+        mv = shm_client.get_or_spilled(buf.name) if shm_client is not None else None
         if mv is not None:
             resolved[buf.name] = mv
         elif (buf.node or "") == my_node and shm_client is not None:

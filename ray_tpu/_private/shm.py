@@ -62,6 +62,10 @@ def _load_lib():
         lib.shm_store_destroy.argtypes = [ctypes.c_char_p]
         lib.shm_store_pretouch.restype = ctypes.c_int64
         lib.shm_store_pretouch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.shm_store_spill_pinned.restype = ctypes.c_int64
+        lib.shm_store_spill_pinned.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+        ]
         _lib = lib
     return _lib
 
@@ -112,6 +116,35 @@ class ShmClient:
         self.handle = self.lib.shm_store_connect(session.encode(), capacity_bytes)
         if not self.handle:
             raise OSError("failed to connect to shm store")
+        # node-local spill directory for pinned (lineage-free) objects under
+        # memory pressure (reference: local_object_manager.h:110 spilling)
+        from .config import GLOBAL_CONFIG as cfg
+
+        self.spill_dir = os.path.join(cfg.session_dir_root, "spill", session)
+
+    def _spill_file(self, name: str) -> str:
+        return os.path.join(self.spill_dir, f"{name}.bin")
+
+    def get_or_spilled(self, name: str) -> Optional[memoryview]:
+        """Resolve a buffer from shm, falling back to its spill file — THE
+        read path for every consumer (materialize, head fetch, agent fetch)
+        so spill semantics can't diverge between them."""
+        mv = self.get(ShmBufferRef(name=name, size=0))
+        return mv if mv is not None else self.read_spilled(name)
+
+    def read_spilled(self, name: str) -> Optional[memoryview]:
+        """Zero-copy mmap of a spilled object's file (None if not spilled)."""
+        import mmap as _mmap
+
+        try:
+            with open(self._spill_file(name), "rb") as f:
+                size = os.fstat(f.fileno()).st_size
+                if size == 0:
+                    return memoryview(b"")
+                mapped = _mmap.mmap(f.fileno(), size, access=_mmap.ACCESS_READ)
+                return memoryview(mapped)
+        except OSError:
+            return None
 
     def create(
         self, name: str, data: memoryview | bytes, pin: bool = False
@@ -125,10 +158,20 @@ class ShmClient:
         size = data.nbytes
         ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
         if not ptr:
-            # LRU-evict evictable objects and retry once (plasma eviction
-            # contract: the head reconstructs evicted ids on demand)
-            if self.lib.shm_store_evict(self.handle, max(size * 2, 1 << 20)) > 0:
+            # LRU-evict evictable objects and retry (plasma eviction
+            # contract: the head reconstructs evicted ids on demand); if
+            # everything left is pinned (no lineage), spill it to disk
+            want = max(size * 2, 1 << 20)
+            if self.lib.shm_store_evict(self.handle, want) > 0:
                 ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
+            if not ptr:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                if self.lib.shm_store_spill_pinned(
+                    self.handle, want, self.spill_dir.encode()
+                ) > 0:
+                    ptr = self.lib.shm_store_create(
+                        self.handle, name.encode(), size, int(pin)
+                    )
             if not ptr:
                 return None
         try:
@@ -170,6 +213,10 @@ class ShmClient:
 
     def delete(self, name: str):
         self.lib.shm_store_delete(self.handle, name.encode())
+        try:
+            os.unlink(self._spill_file(name))
+        except OSError:
+            pass
 
     def used(self) -> int:
         return self.lib.shm_store_used(self.handle)
@@ -212,12 +259,18 @@ class ShmClient:
     @staticmethod
     def destroy(session: str):
         """Remove the control segment AND sweep any leftover data segments
-        (objects still referenced by crashed/leaked handles)."""
+        (objects still referenced by crashed/leaked handles) + spill files."""
         _load_lib().shm_store_destroy(session.encode())
         import glob
+        import shutil
 
         for path in glob.glob(f"/dev/shm/rtpu_{session}_*"):
             try:
                 os.unlink(path)
             except OSError:
                 pass
+        from .config import GLOBAL_CONFIG as cfg
+
+        shutil.rmtree(
+            os.path.join(cfg.session_dir_root, "spill", session), ignore_errors=True
+        )

@@ -48,15 +48,21 @@ def test_full_store_evicts_then_creates(shm):
     assert mv is not None and bytes(mv[:1]) == b"b"
 
 
-def test_full_store_pinned_rejects_create(shm):
-    # pinned objects (ray.put data, no lineage) are never evicted: a store
-    # full of them rejects the create and the caller falls back to the
-    # socket path
+def test_full_store_pinned_spills_to_disk(shm):
+    # pinned objects (ray.put data, no lineage) are never dropped: a store
+    # full of them SPILLS the LRU pinned object to disk so the create
+    # succeeds, and the spilled object stays readable via its spill file
     refs = [
-        shm.create(f"pin{i}", b"a" * (20 * 1024 * 1024), pin=True) for i in range(3)
+        shm.create(f"pin{i}", bytes([65 + i]) * (20 * 1024 * 1024), pin=True)
+        for i in range(3)
     ]
     assert all(r is not None for r in refs)
-    assert shm.create("pin3", b"a" * (20 * 1024 * 1024), pin=True) is None
+    assert shm.create("pin3", b"Z" * (20 * 1024 * 1024), pin=True) is not None
+    # pin0 was LRU: now on disk, not in shm
+    assert shm.get(refs[0]) is None
+    spilled = shm.read_spilled("pin0")
+    assert spilled is not None and bytes(spilled[:2]) == b"AA"
+    assert len(spilled) == 20 * 1024 * 1024
 
 
 def test_explicit_eviction_lru(shm):
