@@ -300,6 +300,16 @@ class Head:
         self.objects.on_free_oid = self.object_lineage.pop
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
         self.metrics_store: Dict[str, dict] = {}
+        # named-channel pubsub (reference: src/ray/pubsub publisher.h:307 /
+        # subscriber.h:329; serve's long-poll rides the same channels,
+        # serve/_private/long_poll.py:68). Per channel: latest (seq, data)
+        # snapshot + push-subscribed connections + long-poll wakeup event.
+        self.channels: Dict[str, Tuple[int, Any]] = {}
+        self.channel_subscribers: Dict[str, Set[protocol.Connection]] = (
+            collections.defaultdict(set)
+        )
+        self._channel_events: Dict[str, asyncio.Event] = {}
+        self._push_tasks: Set[asyncio.Task] = set()
         # submitted jobs: submission_id -> record (entrypoint subprocess)
         self.jobs: Dict[str, dict] = {}
         self._prestart_tasks: List[asyncio.Task] = []
@@ -690,6 +700,8 @@ class Head:
         # can't resurrect the entry after an earlier prune
         for proc in getattr(conn, "_metric_procs", ()):
             self.metrics_store.pop(proc, None)
+        for ch in getattr(conn, "_subscribed_channels", ()):
+            self.channel_subscribers[ch].discard(conn)
         for n in list(self.nodes.values()):
             if n.conn is conn and n.alive:
                 await self._on_node_death(n, reason="agent connection closed")
@@ -1353,6 +1365,82 @@ class Head:
 
     async def _h_ping(self, conn, msg):
         return "pong"
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: src/ray/pubsub — long-poll publisher/subscriber
+    # for object-location/actor/node/log channels; serve's config push,
+    # serve/_private/long_poll.py:68, is the same mechanism)
+    # ------------------------------------------------------------------
+
+    async def _h_publish(self, conn, msg):
+        ch = msg["channel"]
+        seq, _ = self.channels.get(ch, (0, None))
+        seq += 1
+        self.channels[ch] = (seq, msg["data"])
+        # wake long-pollers (they loop and re-check the seq)
+        ev = self._channel_events.pop(ch, None)
+        if ev is not None:
+            ev.set()
+        # push to streaming subscribers (strong task refs: the loop holds
+        # tasks weakly, and a dropped push would silently strand a
+        # latest-snapshot subscriber on stale data)
+        loop = asyncio.get_running_loop()
+        for c in list(self.channel_subscribers.get(ch, ())):
+            if c.closed:
+                self.channel_subscribers[ch].discard(c)
+                continue
+            task = loop.create_task(
+                self._push_one(c, {"t": "pub", "channel": ch, "seq": seq,
+                                   "data": msg["data"]})
+            )
+            self._push_tasks.add(task)
+            task.add_done_callback(self._push_tasks.discard)
+        return seq
+
+    @staticmethod
+    async def _push_one(conn, msg):
+        try:
+            await conn.send(msg)
+        except Exception:
+            pass  # conn died mid-push; conn-close cleanup drops the sub
+
+    async def _h_subscribe(self, conn, msg):
+        ch = msg["channel"]
+        self.channel_subscribers[ch].add(conn)
+        if not hasattr(conn, "_subscribed_channels"):
+            conn._subscribed_channels = set()
+        conn._subscribed_channels.add(ch)
+        seq, data = self.channels.get(ch, (0, None))
+        return {"seq": seq, "data": data}
+
+    async def _h_unsubscribe(self, conn, msg):
+        ch = msg["channel"]
+        self.channel_subscribers[ch].discard(conn)
+        if hasattr(conn, "_subscribed_channels"):
+            conn._subscribed_channels.discard(ch)
+        return True
+
+    async def _h_poll_channel(self, conn, msg):
+        """Long-poll: return (seq, data) as soon as seq > last_seq, or
+        {"timeout": True} after `timeout` seconds (client re-polls)."""
+        ch = msg["channel"]
+        last = msg.get("last_seq", 0)
+        timeout = msg.get("timeout", 30.0)
+        deadline = time.monotonic() + timeout
+        while True:
+            seq, data = self.channels.get(ch, (0, None))
+            if seq > last:
+                return {"seq": seq, "data": data}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"seq": last, "timeout": True}
+            ev = self._channel_events.setdefault(ch, asyncio.Event())
+            try:
+                # no shield: cancelling Event.wait() is side-effect free, and
+                # shielding would leak one pending waiter per poll timeout
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return {"seq": last, "timeout": True}
 
     # ------------------------------------------------------------------
     # state API + observability (reference: dashboard/state_aggregator.py,

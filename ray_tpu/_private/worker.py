@@ -327,6 +327,11 @@ class Worker:
         # lands locally (get() waits here instead of round-tripping the head)
         self._local_pending: Dict[str, threading.Event] = {}
         self._local_lock = threading.Lock()
+        # pubsub: channel -> callbacks invoked on pushed messages
+        # (reference: src/ray/pubsub subscriber.h:329); one dispatcher
+        # thread drains a queue so callbacks run in publish order
+        self._pubsub_callbacks: Dict[str, List[Any]] = {}
+        self._pubsub_queue: Optional[Any] = None
 
     def _cache_local_object(self, oid: str, env) -> None:
         with self._local_lock:
@@ -435,7 +440,70 @@ class Worker:
         return conn
 
     async def _handle_push(self, msg):
+        if msg.get("t") == "pub":
+            self.dispatch_pub(msg)
+            return None
         raise ValueError(f"driver got unexpected message {msg.get('t')}")
+
+    def dispatch_pub(self, msg: dict) -> None:
+        """Deliver a pushed channel message to local subscriber callbacks.
+        Runs on the IO loop (or the worker's protocol loop) — callbacks run
+        on ONE daemon dispatcher thread, preserving publish order (a thread
+        per message could apply seq=1 after seq=2, stranding subscribers on
+        a stale snapshot) and keeping user code off the protocol loop."""
+        if not self._pubsub_callbacks.get(msg["channel"]):
+            return
+        with self._lock:
+            if self._pubsub_queue is None:
+                import queue as _queue
+
+                self._pubsub_queue = _queue.SimpleQueue()
+                threading.Thread(
+                    target=self._pubsub_dispatch_loop, daemon=True, name="pubsub-cb"
+                ).start()
+        self._pubsub_queue.put(msg)
+
+    def _pubsub_dispatch_loop(self):
+        while True:
+            msg = self._pubsub_queue.get()
+            for cb in list(self._pubsub_callbacks.get(msg["channel"], ())):
+                try:
+                    cb(msg["seq"], msg["data"])
+                except Exception:
+                    logger.exception("pubsub callback failed for %s", msg["channel"])
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: src/ray/pubsub; serve long-poll rides poll_channel)
+    # ------------------------------------------------------------------
+
+    def publish(self, channel: str, data) -> int:
+        return self.request({"t": "publish", "channel": channel, "data": data})
+
+    def subscribe(self, channel: str, callback) -> Tuple[int, Any]:
+        """Register a push callback(seq, data); returns the (seq, data)
+        snapshot at subscribe time (0, None if never published)."""
+        self._pubsub_callbacks.setdefault(channel, []).append(callback)
+        snap = self.request({"t": "subscribe", "channel": channel})
+        return snap["seq"], snap["data"]
+
+    def unsubscribe(self, channel: str) -> None:
+        self._pubsub_callbacks.pop(channel, None)
+        try:
+            self.request({"t": "unsubscribe", "channel": channel})
+        except Exception:
+            pass
+
+    def poll_channel(self, channel: str, last_seq: int = 0, timeout: float = 30.0):
+        """Long-poll for a publish newer than last_seq. Returns (seq, data)
+        or None on timeout (caller re-polls)."""
+        reply = self.request(
+            {"t": "poll_channel", "channel": channel, "last_seq": last_seq,
+             "timeout": timeout},
+            timeout=timeout + 10.0,
+        )
+        if reply.get("timeout"):
+            return None
+        return reply["seq"], reply["data"]
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
         if not self.conn or self.conn.closed:

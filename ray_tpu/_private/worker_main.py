@@ -104,6 +104,9 @@ class WorkerServer:
             return await self._run_task(msg)
         if t == "start_actor":
             return await self._start_actor(msg)
+        if t == "pub":
+            global_worker.dispatch_pub(msg)
+            return None
         if t == "ping":
             return "pong"
         if t == "shutdown":

@@ -152,6 +152,9 @@ def delete(name: str = "default"):
 def shutdown():
     import ray_tpu
 
+    from .long_poll import stop_watchers
+
+    stop_watchers()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:

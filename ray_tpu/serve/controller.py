@@ -98,6 +98,8 @@ class ServeController:
             state = self._deployments.pop(name, None)
             if state:
                 self._stop_replicas(state.replicas)
+                state.replicas = []
+                self._publish_replicas(state)
         return True
 
     def graceful_shutdown(self):
@@ -138,6 +140,18 @@ class ServeController:
         import ray_tpu
 
         ray_tpu.get([r.ready.remote() for r in state.replicas])
+        self._publish_replicas(state)
+
+    def _publish_replicas(self, state: _DeploymentState):
+        """Push the live replica set to handles/proxies over the long-poll
+        channel (reference: long_poll.py:68 — controller-side broadcast)."""
+        from .long_poll import replica_channel
+        from ..util import pubsub
+
+        try:
+            pubsub.publish(replica_channel(state.name), list(state.replicas))
+        except Exception:
+            pass  # handles fall back to their polling refresh
 
     def _autoscale(self, state: _DeploymentState):
         import ray_tpu

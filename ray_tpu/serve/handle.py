@@ -37,6 +37,7 @@ class DeploymentHandle:
         self._refreshed = 0.0
         self._inflight: deque = deque()  # (replica_index, ref)
         self._counts: dict = {}
+        self._seen_version = -1  # last adopted ReplicaWatcher.version
 
     # -- pickling: drop live state; reconnect lazily on the other side
     def __reduce__(self):
@@ -57,16 +58,34 @@ class DeploymentHandle:
 
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
+    def _adopt(self, replicas):
+        self._replicas = list(replicas)
+        self._refreshed = time.time()
+        self._counts = {i: self._counts.get(i, 0) for i in range(len(self._replicas))}
+
     def _refresh(self, force: bool = False):
-        if not force and time.time() - self._refreshed < 1.0 and self._replicas:
+        """Adopt the shared long-poll watcher's replica snapshot when it has
+        a newer one (reference: handle-side LongPollClient updating the
+        router, serve/_private/long_poll.py:68); only fall back to pulling
+        from the controller when the push pipeline isn't delivering."""
+        from .long_poll import get_watcher
+
+        watcher = get_watcher(self.deployment_name)
+        if watcher.version != self._seen_version and watcher.replicas is not None:
+            self._seen_version = watcher.version
+            self._adopt(watcher.replicas)
+            if not force:
+                return
+        # push healthy -> the long TTL is safe; push broken/unproven -> the
+        # 1s pull keeps routing at most one interval stale
+        ttl = 30.0 if watcher.healthy() else 1.0
+        if not force and time.time() - self._refreshed < ttl and self._replicas:
             return
         import ray_tpu
 
-        self._replicas = ray_tpu.get(
-            self._controller().get_replicas.remote(self.deployment_name)
+        self._adopt(
+            ray_tpu.get(self._controller().get_replicas.remote(self.deployment_name))
         )
-        self._refreshed = time.time()
-        self._counts = {i: self._counts.get(i, 0) for i in range(len(self._replicas))}
 
     def _prune(self):
         import ray_tpu

@@ -1,0 +1,92 @@
+"""Long-poll client: the controller->proxy/handle config push channel.
+
+Reference parity: serve/_private/long_poll.py:68 (LongPollClient) — the
+reference's controller broadcasts routing tables and replica sets to every
+proxy and handle over a long-poll RPC so the data plane reacts to scale
+events immediately instead of on a polling interval. ray_tpu's version
+rides the head's pubsub channels (util/pubsub.py): the controller publishes
+each deployment's replica list to `serve:replicas:<deployment>`.
+
+One ReplicaWatcher per (process, deployment) — NOT per handle: handles are
+created freely (`h.method` attribute access, options(), unpickling), so
+per-handle watcher threads would leak unboundedly. Handles read the shared
+watcher's snapshot; the watcher holds no handle references, so handles stay
+garbage-collectable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def replica_channel(deployment_name: str) -> str:
+    return f"serve:replicas:{deployment_name}"
+
+
+class ReplicaWatcher:
+    """Daemon thread long-polling one deployment's replica channel.
+
+    `replicas` is None until the first push lands; `version` bumps on every
+    push so readers can adopt new sets cheaply. `healthy()` reports whether
+    the poll loop is actually reaching the head (a timeout still counts —
+    it proves the channel round-trips), letting readers fall back to active
+    polling when the push pipeline is broken rather than trusting a dead
+    thread."""
+
+    def __init__(self, deployment_name: str):
+        self.channel = replica_channel(deployment_name)
+        self.replicas: Optional[List[Any]] = None
+        self.version = 0
+        self.last_result_ts = 0.0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"long-poll:{self.channel}"
+        )
+        self._thread.start()
+
+    def healthy(self, window_s: float = 15.0) -> bool:
+        return time.time() - self.last_result_ts < window_s
+
+    def _run(self):
+        from ..util import pubsub
+
+        while not self._stop.is_set():
+            try:
+                result = pubsub.poll(self.channel, self._seq, timeout=10.0)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(1.0)  # head briefly unreachable: back off
+                continue
+            self.last_result_ts = time.time()
+            if result is None:
+                continue  # poll timeout: re-arm
+            self._seq, data = result
+            self.replicas = list(data)
+            self.version += 1
+
+    def stop(self):
+        self._stop.set()
+
+
+_watchers: Dict[str, ReplicaWatcher] = {}
+_watchers_lock = threading.Lock()
+
+
+def get_watcher(deployment_name: str) -> ReplicaWatcher:
+    with _watchers_lock:
+        w = _watchers.get(deployment_name)
+        if w is None or w._stop.is_set():
+            w = _watchers[deployment_name] = ReplicaWatcher(deployment_name)
+        return w
+
+
+def stop_watchers() -> None:
+    """Called from serve.shutdown(): stop the poll threads promptly."""
+    with _watchers_lock:
+        for w in _watchers.values():
+            w.stop()
+        _watchers.clear()

@@ -1,0 +1,47 @@
+"""Named-channel pub/sub over the cluster control plane.
+
+Reference parity: src/ray/pubsub (publisher.h:307, subscriber.h:329) — the
+long-poll publisher/subscriber the reference uses for object-location,
+actor, node, log, and error channels. ray_tpu exposes the same mechanism as
+a small utility: named channels on the head, push delivery to subscribed
+processes, and a long-poll primitive (the transport under Serve's config
+push, serve/_private/long_poll.py:68).
+
+Channels retain only the LATEST published value (snapshot semantics, like
+the reference's long-poll "object state" channels) — subscribers that join
+late see the current snapshot plus all future publishes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from .._private.worker import global_worker
+
+
+def publish(channel: str, data: Any) -> int:
+    """Publish `data` to `channel`; returns the new sequence number."""
+    return global_worker.publish(channel, data)
+
+
+def subscribe(channel: str, callback: Callable[[int, Any], None]) -> Tuple[int, Any]:
+    """Register `callback(seq, data)` for pushes on `channel`.
+
+    Returns the (seq, data) snapshot at subscribe time — (0, None) if the
+    channel has never been published. The callback runs on a background
+    thread in this process.
+    """
+    return global_worker.subscribe(channel, callback)
+
+
+def unsubscribe(channel: str) -> None:
+    global_worker.unsubscribe(channel)
+
+
+def poll(
+    channel: str, last_seq: int = 0, timeout: float = 30.0
+) -> Optional[Tuple[int, Any]]:
+    """Block until `channel` has a publish newer than `last_seq`; returns
+    (seq, data), or None if `timeout` elapses first (re-poll to continue —
+    classic long-poll)."""
+    return global_worker.poll_channel(channel, last_seq, timeout)

@@ -1,0 +1,113 @@
+"""Named-channel pubsub + long-poll (reference: src/ray/pubsub,
+serve/_private/long_poll.py:68)."""
+
+import threading
+import time
+
+import pytest
+
+
+def test_publish_subscribe_push(ray_start_regular):
+    from ray_tpu.util import pubsub
+
+    got = []
+    ev = threading.Event()
+
+    def cb(seq, data):
+        got.append((seq, data))
+        ev.set()
+
+    seq, data = pubsub.subscribe("chan-a", cb)
+    assert seq == 0 and data is None
+    pubsub.publish("chan-a", {"x": 1})
+    assert ev.wait(5.0)
+    assert got[0][1] == {"x": 1}
+    assert got[0][0] == 1
+
+
+def test_subscribe_snapshot(ray_start_regular):
+    from ray_tpu.util import pubsub
+
+    pubsub.publish("chan-snap", "v1")
+    pubsub.publish("chan-snap", "v2")
+    seq, data = pubsub.subscribe("chan-snap", lambda s, d: None)
+    assert seq == 2 and data == "v2"
+
+
+def test_long_poll(ray_start_regular):
+    from ray_tpu.util import pubsub
+
+    # immediate return when newer data exists
+    pubsub.publish("chan-lp", 10)
+    out = pubsub.poll("chan-lp", last_seq=0, timeout=5.0)
+    assert out == (1, 10)
+    # timeout path
+    assert pubsub.poll("chan-lp", last_seq=1, timeout=0.2) is None
+
+    # blocked poll released by a publish
+    results = []
+
+    def poller():
+        results.append(pubsub.poll("chan-lp", last_seq=1, timeout=10.0))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    pubsub.publish("chan-lp", 11)
+    t.join(5.0)
+    assert results and results[0] == (2, 11)
+
+
+def test_pubsub_from_actor(ray_start_regular):
+    """Subscriptions work inside worker processes (actors) too."""
+    import ray_tpu
+    from ray_tpu.util import pubsub
+
+    @ray_tpu.remote
+    class Sub:
+        def __init__(self):
+            from ray_tpu.util import pubsub as ps
+
+            self.got = []
+            self.ev = threading.Event()
+            ps.subscribe("chan-actor", self._cb)
+
+        def _cb(self, seq, data):
+            self.got.append(data)
+            self.ev.set()
+
+        def wait_got(self, timeout=5.0):
+            self.ev.wait(timeout)
+            return list(self.got)
+
+    a = Sub.remote()
+    ray_tpu.get(a.wait_got.remote(0.01))  # ensure subscribed
+    pubsub.publish("chan-actor", "hello")
+    assert ray_tpu.get(a.wait_got.remote()) == ["hello"]
+
+
+def test_serve_handle_long_poll_scale_up(ray_start_regular):
+    """Scaling a deployment pushes the new replica set to live handles
+    without waiting for their polling interval."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    def hello(name):
+        return f"hi {name}"
+
+    from ray_tpu.serve.long_poll import get_watcher
+
+    handle = serve.run(hello.bind(), name="lp-app")
+    assert handle.remote("a").result() == "hi a"
+    assert len(handle._replicas) == 1
+    # redeploy at 3 replicas; the push should reach the shared watcher
+    serve.run(hello.options(num_replicas=3).bind(), name="lp-app")
+    watcher = get_watcher("hello")
+    deadline = time.time() + 10
+    while time.time() < deadline and len(watcher.replicas or []) != 3:
+        time.sleep(0.1)
+    assert len(watcher.replicas) == 3
+    # a live handle adopts the pushed set on its next call (no 1s pull)
+    assert handle.remote("b").result() == "hi b"
+    assert len(handle._replicas) == 3
+    serve.shutdown()
