@@ -86,8 +86,36 @@ class Agent:
             cfg.session_dir_root, self.session, "nodes", self.node_id
         )
         os.makedirs(self.scratch_dir, exist_ok=True)
+        memory_task = None
+        if cfg.memory_monitor_refresh_ms > 0:
+            memory_task = asyncio.get_running_loop().create_task(self._memory_loop())
         await self._stop.wait()
+        if memory_task is not None:
+            memory_task.cancel()
         self._cleanup()
+
+    async def _memory_loop(self):
+        """Sample this node's memory and report pressure to the head, which
+        owns the kill policy (reference: memory_monitor.h sampling in the
+        raylet; policy in worker_killing_policy.h)."""
+        from .memory_monitor import MemoryMonitor
+
+        mon = MemoryMonitor()
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        while not self._stop.is_set():
+            await asyncio.sleep(period)
+            try:
+                pressured, used, total = mon.is_pressured()
+            except Exception:
+                continue
+            if pressured and not self.conn.closed:
+                try:
+                    await self.conn.send(
+                        {"t": "memory_pressure", "node_id": self.node_id,
+                         "used": used, "total": total}
+                    )
+                except Exception:
+                    pass
 
     async def _on_close(self):
         self._stop.set()

@@ -113,6 +113,14 @@ D("head_tcp_port", int, 0, "bind port for the TCP control plane (0 = ephemeral)"
 D("dashboard_enabled", bool, True, "serve the dashboard-lite HTTP endpoint")
 D("dashboard_host", str, "127.0.0.1")
 D("dashboard_port", int, 0, "dashboard port (0 = ephemeral)")
+D("memory_monitor_refresh_ms", int, 1000,
+  "period for node memory-pressure sampling (reference: memory_monitor.h); "
+  "0 disables the OOM killer")
+D("memory_usage_threshold", float, 0.95,
+  "node memory fraction above which the OOM killing policy fires "
+  "(reference: ray_config_def.h memory_usage_threshold)")
+D("memory_monitor_test_path", str, "",
+  "test hook: file holding '<used> <total>' bytes used as the memory sample")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

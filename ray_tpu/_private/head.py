@@ -86,6 +86,9 @@ class WorkerRecord:
     # caller->worker push endpoint (unix path or host:port) for the direct
     # actor-call transport (direct_actor_task_submitter.h:67)
     direct_address: Optional[str] = None
+    # set by the OOM killing policy so the task-failure path can surface an
+    # OutOfMemoryError instead of a generic crash (worker_killing_policy.h)
+    kill_reason: Optional[str] = None
 
 
 @dataclass
@@ -419,6 +422,10 @@ class Head:
         # connection-close detection alone misses it (reference:
         # gcs_health_check_manager.h:39 periodic health checks)
         self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
+        if cfg.memory_monitor_refresh_ms > 0:
+            self._memory_task = asyncio.get_running_loop().create_task(
+                self._memory_loop()
+            )
         host = tcp_host if tcp_host is not None else cfg.head_tcp_host
         port = tcp_port if tcp_port is not None else cfg.head_tcp_port
         try:
@@ -597,6 +604,75 @@ class Head:
         finally:
             target.probing = False
 
+    # ------------------------------------------------------------------
+    # OOM killing policy (reference: memory_monitor.h:52 sampling +
+    # worker_killing_policy.h retriable-LIFO victim selection — kill the
+    # newest retriable task first so older work survives pressure)
+    # ------------------------------------------------------------------
+
+    async def _memory_loop(self):
+        from .memory_monitor import MemoryMonitor
+
+        mon = MemoryMonitor()
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                pressured, used, total = mon.is_pressured()
+            except Exception:
+                continue
+            if pressured:
+                await self._oom_kill(self._head_node_id, used, total)
+
+    async def _h_memory_pressure(self, conn, msg):
+        """A node agent's monitor reported pressure; run the policy there."""
+        await self._oom_kill(msg["node_id"], msg["used"], msg["total"])
+
+    async def _oom_kill(self, node_id: str, used: int, total: int):
+        # per-node cooldown: the previous victim's memory takes time to
+        # return to the OS, so killing once per sample would cascade through
+        # the pool — but pressure on one node must not shield another
+        now = time.monotonic()
+        if not hasattr(self, "_oom_cooldowns"):
+            self._oom_cooldowns: Dict[str, float] = {}
+        if now < self._oom_cooldowns.get(node_id, 0.0):
+            return
+        victim: Optional[TaskRecord] = None
+        # newest-first over running stateless tasks on the pressured node;
+        # retriable tasks are preferred victims (their work is recoverable)
+        for rec in reversed(list(self.tasks.values())):
+            if rec.state != "running" or rec.node_id != node_id:
+                continue
+            w = self.workers.get(rec.worker_id or "")
+            if w is None or w.state == "dead":
+                continue
+            if rec.retries_left > 0:
+                victim = rec
+                break
+            if victim is None:
+                victim = rec
+        if victim is None:
+            logger.warning(
+                "node %s under memory pressure (%.0f%%) but no killable task "
+                "worker found", node_id, 100.0 * used / max(total, 1),
+            )
+            return
+        w = self.workers[victim.worker_id]
+        w.kill_reason = (
+            f"worker OOM-killed on {node_id}: node memory {used}/{total} bytes "
+            f"({100.0 * used / max(total, 1):.0f}%) exceeded "
+            f"memory_usage_threshold={cfg.memory_usage_threshold}; task "
+            f"{victim.spec['task_id']} was the newest "
+            f"{'retriable' if victim.retries_left > 0 else 'running'} task"
+        )
+        logger.warning(w.kill_reason)
+        self._oom_cooldowns[node_id] = now + max(
+            2.0, 2 * cfg.memory_monitor_refresh_ms / 1000.0
+        )
+        # force-kill; the broken connection routes the running task through
+        # _retry_or_fail, which surfaces kill_reason as OutOfMemoryError
+        await self._terminate_worker(w, force=True)
+
     async def _declare_worker_hung(self, w: WorkerRecord):
         if w.state == "dead":
             return
@@ -621,6 +697,8 @@ class Head:
         self._shutdown = True
         if getattr(self, "_health_task", None) is not None:
             self._health_task.cancel()
+        if getattr(self, "_memory_task", None) is not None:
+            self._memory_task.cancel()
         if getattr(self, "_snapshot_task", None) is not None:
             self._snapshot_task.cancel()
         for t in list(self._prestart_tasks):
@@ -1822,8 +1900,11 @@ class Head:
         rec.mark("done")
 
     async def _retry_or_fail(self, rec: TaskRecord, error: Exception):
-        from ..exceptions import WorkerCrashedError
+        from ..exceptions import OutOfMemoryError, WorkerCrashedError
 
+        w = self.workers.get(rec.worker_id or "")
+        if w is not None and w.kill_reason:
+            error = OutOfMemoryError(w.kill_reason)
         if rec.retries_left > 0 and not self._shutdown:
             rec.retries_left -= 1
             await asyncio.sleep(cfg.task_retry_delay_ms / 1000.0)
@@ -1834,7 +1915,10 @@ class Head:
         rec.mark("failed")
         for oid in rec.spec.get("deps", []):
             self.objects.unpin(oid)
-        self._fail_task_returns(rec.spec, WorkerCrashedError(f"task failed: {error!r}"))
+        if isinstance(error, OutOfMemoryError):
+            self._fail_task_returns(rec.spec, error)
+        else:
+            self._fail_task_returns(rec.spec, WorkerCrashedError(f"task failed: {error!r}"))
 
     def _fail_task_returns(self, spec: dict, error: Exception):
         from .serialization import serialize

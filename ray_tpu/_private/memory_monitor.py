@@ -1,0 +1,109 @@
+"""Node memory monitor: detects host memory pressure for the OOM killer.
+
+Reference parity: src/ray/common/memory_monitor.h:52 (MemoryMonitor) — the
+reference samples /proc + cgroup limits on a timer inside the raylet and
+invokes a kill callback above `memory_usage_threshold`. ray_tpu samples the
+same sources (cgroup v2, then cgroup v1, then /proc/meminfo) from the head
+(head node) and each node agent (remote nodes); the kill *policy* runs
+centrally in the head (worker_killing_policy.h analogue) where the task
+table lives.
+
+Test hook: `cfg.memory_monitor_test_path` names a file holding
+"<used_bytes> <total_bytes>" — when set, samples come from that file so
+tests can stage pressure deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from .config import GLOBAL_CONFIG as cfg
+
+_CGROUP_V2 = "/sys/fs/cgroup"
+_CGROUP_V1_MEM = "/sys/fs/cgroup/memory"
+# cgroup files report "max" (v2) or a huge sentinel (v1) when unlimited
+_UNLIMITED_ABOVE = 1 << 60
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    if raw == "max":
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return None if n >= _UNLIMITED_ABOVE else n
+
+
+def _stat_value(path: str, key: str) -> int:
+    """One "key value" line from a cgroup stat file (0 if absent). Used to
+    subtract reclaimable page cache from the usage counter — the raw
+    cgroup counter includes file cache the kernel would reclaim long
+    before OOM, and counting it would fire false-positive kills (the
+    reference subtracts inactive_file the same way)."""
+    try:
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 2 and parts[0] == key:
+                    return int(parts[1])
+    except (OSError, ValueError):
+        pass
+    return 0
+
+
+def _proc_meminfo() -> Tuple[int, int]:
+    """(used, total) from /proc/meminfo, counting reclaimable page cache as
+    free (MemAvailable), like the reference."""
+    total = available = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                available = int(line.split()[1]) * 1024
+    return max(0, total - available), total
+
+
+class MemoryMonitor:
+    """Samples (used_bytes, total_bytes) for this node."""
+
+    def __init__(self):
+        self.threshold = cfg.memory_usage_threshold
+
+    def sample(self) -> Tuple[int, int]:
+        test_path = cfg.memory_monitor_test_path
+        if test_path:
+            try:
+                with open(test_path) as f:
+                    used, total = f.read().split()
+                return int(used), int(total)
+            except (OSError, ValueError):
+                return 0, 1
+        # cgroup v2 (unified hierarchy)
+        limit = _read_int(os.path.join(_CGROUP_V2, "memory.max"))
+        if limit:
+            used = _read_int(os.path.join(_CGROUP_V2, "memory.current")) or 0
+            used -= _stat_value(os.path.join(_CGROUP_V2, "memory.stat"), "inactive_file")
+            return max(0, used), limit
+        # cgroup v1
+        limit = _read_int(os.path.join(_CGROUP_V1_MEM, "memory.limit_in_bytes"))
+        if limit:
+            used = _read_int(
+                os.path.join(_CGROUP_V1_MEM, "memory.usage_in_bytes")
+            ) or 0
+            used -= _stat_value(
+                os.path.join(_CGROUP_V1_MEM, "memory.stat"), "total_inactive_file"
+            )
+            return max(0, used), limit
+        return _proc_meminfo()
+
+    def is_pressured(self) -> Tuple[bool, int, int]:
+        used, total = self.sample()
+        return (total > 0 and used / total >= self.threshold), used, total

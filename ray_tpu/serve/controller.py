@@ -188,12 +188,21 @@ class ServeController:
             self._reconcile(state)  # replace dead replicas
 
     def _reconcile_loop(self):
+        last_heartbeat = 0.0
         while not self._stop.is_set():
             time.sleep(0.25)
+            # heartbeat republish: watchers gauge push-pipeline health by
+            # data recency, so a periodic re-publish both self-heals a
+            # dropped publish and keeps healthy() honest (long_poll.py)
+            heartbeat = time.time() - last_heartbeat >= 5.0
+            if heartbeat:
+                last_heartbeat = time.time()
             for state in list(self._deployments.values()):
                 try:
                     if state.config.autoscaling_config is not None:
                         self._autoscale(state)
                     self._health_check(state)
+                    if heartbeat:
+                        self._publish_replicas(state)
                 except Exception:
                     pass

@@ -74,8 +74,9 @@ class DeploymentHandle:
         if watcher.version != self._seen_version and watcher.replicas is not None:
             self._seen_version = watcher.version
             self._adopt(watcher.replicas)
-            if not force:
-                return
+            # a just-landed push is at least as fresh as a pull started
+            # after it — even on the force (error-retry) path
+            return
         # push healthy -> the long TTL is safe; push broken/unproven -> the
         # 1s pull keeps routing at most one interval stale
         ttl = 30.0 if watcher.healthy() else 1.0

@@ -30,16 +30,16 @@ class ReplicaWatcher:
 
     `replicas` is None until the first push lands; `version` bumps on every
     push so readers can adopt new sets cheaply. `healthy()` reports whether
-    the poll loop is actually reaching the head (a timeout still counts —
-    it proves the channel round-trips), letting readers fall back to active
-    polling when the push pipeline is broken rather than trusting a dead
-    thread."""
+    pushed DATA is actually arriving (the controller re-publishes every ~5s
+    as a heartbeat) — a reachable head with a silent publisher is NOT
+    healthy, so readers fall back to actively pulling from the controller
+    rather than trusting a stale snapshot."""
 
     def __init__(self, deployment_name: str):
         self.channel = replica_channel(deployment_name)
         self.replicas: Optional[List[Any]] = None
         self.version = 0
-        self.last_result_ts = 0.0
+        self.last_data_ts = 0.0
         self._seq = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -48,7 +48,7 @@ class ReplicaWatcher:
         self._thread.start()
 
     def healthy(self, window_s: float = 15.0) -> bool:
-        return time.time() - self.last_result_ts < window_s
+        return time.time() - self.last_data_ts < window_s
 
     def _run(self):
         from ..util import pubsub
@@ -61,9 +61,9 @@ class ReplicaWatcher:
                     return
                 self._stop.wait(1.0)  # head briefly unreachable: back off
                 continue
-            self.last_result_ts = time.time()
             if result is None:
                 continue  # poll timeout: re-arm
+            self.last_data_ts = time.time()
             self._seq, data = result
             self.replicas = list(data)
             self.version += 1
