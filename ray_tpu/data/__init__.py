@@ -18,6 +18,13 @@ from .dataset import (  # noqa: F401
     read_numpy,
     read_parquet,
 )
+from .datasource import (  # noqa: F401
+    read_binary_files,
+    read_images,
+    read_sql,
+    read_tfrecords,
+    read_webdataset,
+)
 from .grouped_data import GroupedData  # noqa: F401
 
 range = range_  # noqa: A001 — mirror ray.data.range

@@ -1,0 +1,147 @@
+"""Logical-plan optimizer rules for Dataset op chains.
+
+Reference parity: python/ray/data/_internal/logical/ (optimizers.py and its
+rule set — OperatorFusionRule, limit pushdown) + planner/planner.py. The
+reference optimizes a DAG of logical operators before lowering to physical
+execution; ray_tpu's plan is a linear per-block op chain, so rules operate
+on that chain right before execution (`Dataset._iter_computed_blocks`).
+
+Rules:
+- fuse_row_ops: consecutive row-level ops (map / filter / flat_map) fold
+  into ONE "row_chain" op applied in a single pass per block — without it,
+  every op materializes a full intermediate row list per block.
+- fuse_map_batches: adjacent stateless map_batches with identical
+  batch_size/fn_kwargs-free signatures compose into one op, skipping a
+  slice+concat round per fused op.
+- push_limit: a per-block row cap hops over the longest suffix of
+  row-count-preserving ops (map, row_chain of maps) so remote tasks
+  transform only rows that can survive the limit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # circular import (dataset imports this module)
+    from .dataset import _Op
+
+_ROW_KINDS = ("map", "filter", "flat_map")
+
+
+def _make_row_chain(steps) -> Callable:
+    """Compose row steps into one generator-style pass (fn for row_chain)."""
+
+    def run(rows):
+        out = []
+        for row in rows:
+            emit = [row]
+            for kind, fn in steps:
+                if kind == "map":
+                    emit = [fn(r) for r in emit]
+                elif kind == "filter":
+                    emit = [r for r in emit if fn(r)]
+                else:  # flat_map
+                    nxt: List = []
+                    for r in emit:
+                        nxt.extend(fn(r))
+                    emit = nxt
+                if not emit:
+                    break
+            out.extend(emit)
+        return out
+
+    run._steps = steps  # introspection for explain()/tests
+    return run
+
+
+def fuse_row_ops(ops: List["_Op"]) -> List["_Op"]:
+    from .dataset import _Op
+
+    out: List[_Op] = []
+    pending = []  # (kind, fn) steps to fuse
+    for op in ops:
+        if op.kind in _ROW_KINDS:
+            pending.append((op.kind, op.fn))
+            continue
+        if pending:
+            out.append(_make_chain_op(pending))
+            pending = []
+        out.append(op)
+    if pending:
+        out.append(_make_chain_op(pending))
+    return out
+
+
+def _make_chain_op(pending) -> "_Op":
+    from .dataset import _Op
+
+    if len(pending) == 1:  # nothing to fuse: keep the original kind
+        return _Op(pending[0][0], pending[0][1])
+    return _Op("row_chain", _make_row_chain(list(pending)))
+
+
+def fuse_map_batches(ops: List["_Op"]) -> List["_Op"]:
+    from .dataset import _Op
+
+    out: List[_Op] = []
+    for op in ops:
+        prev = out[-1] if out else None
+        if (
+            prev is not None
+            and op.kind == "map_batches" == prev.kind
+            and op.compute == "tasks" == prev.compute
+            and not isinstance(op.fn, type) and not isinstance(prev.fn, type)
+            # fuse only whole-block ops: with a batch_size, the second op
+            # re-slices the first's output, so if fn #1 changes row counts
+            # fn #2 would stop seeing its declared batch shape when fused
+            and op.batch_size is None and prev.batch_size is None
+            and not op.fn_kwargs and not prev.fn_kwargs
+        ):
+            f, g = prev.fn, op.fn
+            out[-1] = _Op("map_batches", lambda b, _f=f, _g=g: _g(_f(b)))
+            continue
+        out.append(op)
+    return out
+
+
+def _preserves_row_count(op: "_Op") -> bool:
+    if op.kind == "map":
+        return True
+    if op.kind == "row_chain":
+        return all(kind == "map" for kind, _ in getattr(op.fn, "_steps", [(None, None)]))
+    return False
+
+
+def push_limit(ops: List["_Op"], n: int) -> List["_Op"]:
+    """Insert a per-block `limit` cap as early as row-count preservation
+    allows. The global (cross-block) limit stays with the consumer."""
+    from .dataset import _Op
+
+    cap = _Op("limit", None, batch_size=n)
+    i = len(ops)
+    while i > 0 and _preserves_row_count(ops[i - 1]):
+        i -= 1
+    return ops[:i] + [cap] + ops[i:]
+
+
+def optimize(ops: List["_Op"]) -> List["_Op"]:
+    """The rule pipeline applied before execution."""
+    return fuse_map_batches(fuse_row_ops(ops))
+
+
+def explain(ops: List["_Op"]) -> str:
+    """Human-readable plan: original -> optimized (reference: the logical
+    plan dumps used by Dataset.explain/stats)."""
+    def fmt(chain):
+        parts = []
+        for op in chain:
+            if op.kind == "row_chain":
+                steps = "+".join(k for k, _ in getattr(op.fn, "_steps", []))
+                parts.append(f"row_chain[{steps}]")
+            else:
+                parts.append(op.kind)
+        return " -> ".join(parts) if parts else "(read only)"
+
+    return f"logical: {fmt(ops)}\noptimized: {fmt(optimize(ops))}"

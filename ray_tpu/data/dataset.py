@@ -166,6 +166,16 @@ def _apply_ops(block, ops: List[_Op], cache: Optional[Dict[int, Callable]] = Non
             for row in _block_to_rows(block):
                 out.extend(op.fn(row))
             block = out
+        elif op.kind == "row_chain":
+            # fused map/filter/flat_map pipeline (_plan.fuse_row_ops):
+            # one pass per block instead of one intermediate list per op
+            block = op.fn(_block_to_rows(block))
+        elif op.kind == "limit":
+            # per-block cap pushed down by _plan.push_limit; the global
+            # cross-block limit is enforced by the consumer
+            n = op.batch_size or 0
+            if _block_num_rows(block) > n:
+                block = _block_slice(block, 0, n)
         else:
             raise ValueError(f"unknown op {op.kind}")
     return block
@@ -432,10 +442,15 @@ class Dataset:
         return sorted(out)
 
     def limit(self, n: int) -> "Dataset":
-        """First n rows (materializes only what it needs)."""
+        """First n rows (materializes only what it needs; a per-block cap
+        is pushed below row-preserving ops so tasks transform only rows
+        that can survive — _plan.push_limit)."""
+        from ._plan import push_limit
+
+        capped = Dataset(self._block_fns, push_limit(self._ops, n))
         taken = []
         remaining = n
-        for block in self._iter_computed_blocks():
+        for block in capped._iter_computed_blocks():
             rows = _block_num_rows(block)
             take = min(rows, remaining)
             if take > 0:
@@ -540,6 +555,21 @@ class Dataset:
 
         return self._write_files(path, "json", write_one)
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        from .datasource import _write_tfrecords
+
+        return _write_tfrecords(self, path)
+
+    def write_sql(self, table: str, connection_factory, **kwargs) -> int:
+        from .datasource import _write_sql
+
+        return _write_sql(self, table, connection_factory, **kwargs)
+
+    def write_webdataset(self, path: str) -> List[str]:
+        from .datasource import _write_webdataset
+
+        return _write_webdataset(self, path)
+
     def iter_torch_batches(self, *, batch_size: int = 256, drop_last: bool = False):
         """Batches as dicts of torch CPU tensors (reference:
         iter_torch_batches; the TPU path is iter_device_batches)."""
@@ -586,7 +616,9 @@ class Dataset:
         a pool of stateful _MapWorker actors (round-robin, same windowing)."""
         import ray_tpu
 
-        ops = self._ops
+        from ._plan import optimize
+
+        ops = optimize(self._ops)
         use_cluster = parallel and ray_tpu.is_initialized() and len(self._block_fns) > 1
 
         if not use_cluster:
@@ -735,8 +767,11 @@ class Dataset:
             yield queue.popleft()
 
     def take(self, limit: int = 20) -> List[Any]:
+        from ._plan import push_limit
+
+        capped = Dataset(self._block_fns, push_limit(self._ops, limit))
         out = []
-        for row in self.iter_rows():
+        for row in capped.iter_rows():
             out.append(row)
             if len(out) >= limit:
                 break
@@ -746,7 +781,23 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        return sum(_block_num_rows(b) for b in self._iter_computed_blocks())
+        # count pushdown: the TRAILING suffix of row-count-preserving ops
+        # (map) never changes the answer, so skip running it (_plan rule;
+        # earlier preserving ops must still run — downstream filters read
+        # their output shapes)
+        from ._plan import _preserves_row_count
+
+        ops = list(self._ops)
+        while ops and _preserves_row_count(ops[-1]):
+            ops.pop()
+        pruned = Dataset(self._block_fns, ops)
+        return sum(_block_num_rows(b) for b in pruned._iter_computed_blocks())
+
+    def explain(self) -> str:
+        """The logical -> optimized plan (reference: logical plan dumps)."""
+        from ._plan import explain
+
+        return explain(self._ops)
 
     def schema(self):
         for block in self._iter_computed_blocks(parallel=False):

@@ -1,0 +1,106 @@
+"""Logical-plan optimizer rules (reference: _internal/logical/optimizers)."""
+
+import numpy as np
+import pytest
+
+
+def _ops_of(ds):
+    from ray_tpu.data._plan import optimize
+
+    return optimize(ds._ops)
+
+
+def test_fuse_row_ops(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = (
+        rd.from_items(list(range(20)), override_num_blocks=2)
+        .map(lambda x: x + 1)
+        .filter(lambda x: x % 2 == 0)
+        .flat_map(lambda x: [x, x])
+    )
+    fused = _ops_of(ds)
+    assert [op.kind for op in fused] == ["row_chain"]
+    out = sorted(ds.take_all())
+    expected = sorted(v for x in range(20) for v in ([x + 1] * 2) if (x + 1) % 2 == 0)
+    assert out == expected
+
+
+def test_fuse_map_batches(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = (
+        rd.range(100, override_num_blocks=4)
+        .map_batches(lambda b: {"id": b["id"] * 2})
+        .map_batches(lambda b: {"id": b["id"] + 1})
+    )
+    fused = _ops_of(ds)
+    assert [op.kind for op in fused] == ["map_batches"]
+    rows = sorted(r["id"] for r in ds.take_all())
+    assert rows[:3] == [1, 3, 5]
+
+
+def test_no_fuse_across_actor_ops(ray_start_regular):
+    import ray_tpu.data as rd
+
+    class AddOne:
+        def __call__(self, b):
+            return {"id": b["id"] + 1}
+
+    ds = (
+        rd.range(10, override_num_blocks=2)
+        .map_batches(lambda b: b, compute="tasks")
+        .map_batches(AddOne, compute="actors", num_actors=1)
+    )
+    fused = _ops_of(ds)
+    assert len(fused) == 2  # stateful op must not fuse away
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 11))
+
+
+def test_limit_pushdown_caps_map_work(ray_start_regular):
+    import ray_tpu.data as rd
+    from ray_tpu.data._plan import push_limit
+
+    calls = []
+
+    def spy(x):
+        calls.append(x)
+        return x
+
+    ds = rd.from_items(list(range(1000)), override_num_blocks=1).map(spy)
+    # plan shape: the cap lands before the map
+    ops = push_limit(ds._ops, 5)
+    assert [op.kind for op in ops] == ["limit", "map"]
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert len(calls) <= 5  # map ran only on capped rows
+
+    # but never before a filter (count-changing)
+    ds2 = rd.from_items(list(range(10))).filter(lambda x: x >= 8)
+    ops2 = push_limit(ds2._ops, 1)
+    assert [op.kind for op in ops2] == ["filter", "limit"]
+    assert ds2.take(1) == [8]
+
+
+def test_count_skips_maps(ray_start_regular):
+    import ray_tpu.data as rd
+
+    calls = []
+
+    def spy(x):
+        calls.append(x)
+        return x * 100
+
+    ds = rd.from_items(list(range(50)), override_num_blocks=2).map(spy)
+    assert ds.count() == 50
+    assert calls == []  # map never executed for count
+    # filters still run (they change the count)
+    assert rd.from_items(list(range(50))).filter(lambda x: x < 10).count() == 10
+
+
+def test_explain(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(10).map(lambda r: r).filter(lambda r: True)
+    text = ds.explain()
+    assert "logical: map -> filter" in text
+    assert "row_chain[map+filter]" in text
