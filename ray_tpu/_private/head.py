@@ -1093,6 +1093,8 @@ class Head:
         asyncio.get_running_loop().create_task(self._start_actor(rec))
 
     async def _start_actor(self, rec: ActorRecord):
+        if rec.state == "dead":
+            return  # killed while queued for (re)start — stay dead
         rec.state = "starting"
         rec.node_acquired = False
         spec = rec.spec
@@ -1100,6 +1102,11 @@ class Head:
             await self.objects.wait_available(oid)
         resources = dict(spec.get("resources") or {})
         node_id = await self._acquire_node(resources, spec.get("scheduling_strategy"))
+        if rec.state == "dead":
+            # kill_actor landed during the waits above (worker not yet
+            # assigned, so the kill path couldn't release this acquisition)
+            self._release_node(node_id, resources, spec.get("scheduling_strategy"))
+            return
         rec.node_acquired = True  # stop counting as unmet autoscaler demand
         w = await self._spawn_worker(
             node_id,
@@ -1107,14 +1114,21 @@ class Head:
             runtime_env=spec.get("runtime_env"),
             needs_tpu=resources.get("TPU", 0) > 0,
         )
+        rec.worker_id = w.worker_id  # visible to the kill path from here on
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
         except asyncio.TimeoutError:
             pass
+        if rec.state == "dead":
+            # killed mid-spawn: _h_kill_actor released the node resources
+            # (worker_id was set) — just reap the fresh worker
+            await self._kill_worker(w, reason="actor killed during start")
+            return
         if w.state not in ("idle", "starting") or w.conn is None:
             rec.state = "dead"
             rec.death_reason = "worker failed to start"
-            self._release_node(node_id, resources)
+            rec.node_acquired = False
+            self._release_node(node_id, resources, spec.get("scheduling_strategy"))
             return
         w.state = "actor"
         rec.worker_id = w.worker_id
@@ -1128,10 +1142,16 @@ class Head:
                     "max_concurrency": spec.get("max_concurrency", 1),
                 }
             )
-        except Exception as e:  # init failed
-            rec.state = "dead"
-            rec.death_reason = f"__init__ failed: {e!r}"
+        except Exception as e:  # init failed (or killed mid-init)
+            if rec.state != "dead":
+                rec.state = "dead"
+                rec.death_reason = f"__init__ failed: {e!r}"
+            self._release_actor_node(rec, w)
+            await self._kill_worker(w, reason="actor init failed")
             await self._fail_backlog(rec)
+            return
+        if rec.state == "dead":  # killed while __init__ was running
+            await self._kill_worker(w, reason="actor killed during start")
             return
         rec.state = "alive"
         backlog, rec.backlog = rec.backlog, []
@@ -1256,10 +1276,28 @@ class Head:
         if rec.name:
             self._unregister_name(rec)
         w = self.workers.get(rec.worker_id or "")
+        # release the actor's node resources NOW: state is already "dead",
+        # so the worker-death path's release is skipped — without this the
+        # resources leak and pending actors starve (deadlock under kill-
+        # and-replace loops like Tune teardown / Serve scale-down)
+        self._release_actor_node(rec, w)
         if w is not None:
             await self._kill_worker(w, reason="actor killed")
         await self._fail_backlog(rec)
         return True
+
+    def _release_actor_node(self, rec: ActorRecord, w: Optional[WorkerRecord]):
+        """Idempotently return an actor's acquired node resources
+        (node_acquired guards double release across the kill and
+        worker-death paths)."""
+        if not rec.node_acquired or w is None:
+            return
+        rec.node_acquired = False
+        self._release_node(
+            w.node_id,
+            dict(rec.spec.get("resources") or {}),
+            rec.spec.get("scheduling_strategy"),
+        )
 
     async def _h_actor_state(self, conn, msg):
         rec = self.actors.get(msg["actor_id"])
@@ -2103,8 +2141,7 @@ class Head:
                 if self._shutdown:
                     rec.state = "dead"
                     continue
-                spec_res = dict(rec.spec.get("resources") or {})
-                self._release_node(w.node_id, spec_res, rec.spec.get("scheduling_strategy"))
+                self._release_actor_node(rec, w)
                 if rec.restarts_left != 0:
                     if rec.restarts_left > 0:
                         rec.restarts_left -= 1

@@ -109,10 +109,18 @@ class _ActorChannel:
         self.task = asyncio.get_running_loop().create_task(self._consume())
 
     async def _resolve(self) -> Optional[str]:
-        """Poll the head until the actor is alive (with an address), dead,
-        or the register timeout elapses. Returns the address or None."""
-        deadline = asyncio.get_running_loop().time() + cfg.worker_register_timeout_s
+        """Poll the head until the actor is alive (with an address) or dead.
+        Returns the address or None.
+
+        No wall-clock deadline while the actor is pending/starting: actor
+        startup is legitimately slow (worker spawn + heavy imports under
+        host contention), and giving up would fail calls on an actor that
+        is about to come up. If the actor truly never starts, the head
+        marks it dead (spawn failure / init failure / node death) and the
+        poll observes that (reference: submitter buffers calls until the
+        GCS publishes the actor address, direct_actor_task_submitter.h:67)."""
         delay = 0.02
+        warn_at = asyncio.get_running_loop().time() + cfg.worker_register_timeout_s
         while True:
             route = await self.worker.conn.request(
                 {"t": "get_actor_route", "actor_id": self.actor_id}
@@ -126,8 +134,13 @@ class _ActorChannel:
                 ):
                     return None  # unix socket on another machine
                 return addr
-            if asyncio.get_running_loop().time() > deadline:
-                return None
+            if warn_at is not None and asyncio.get_running_loop().time() > warn_at:
+                warn_at = None
+                logger.warning(
+                    "actor %s still %s after %.0fs; calls will block until it "
+                    "is scheduled (check cluster resources) or killed",
+                    self.actor_id, route["state"], cfg.worker_register_timeout_s,
+                )
             await asyncio.sleep(delay)
             delay = min(delay * 2, 0.5)
 

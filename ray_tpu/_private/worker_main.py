@@ -120,7 +120,9 @@ class WorkerServer:
         blob = await self.conn.request({"t": "kv_get", "ns": ns, "key": key})
         if blob is None:
             raise RuntimeError(f"function/class {key} not found in KV")
-        obj = cloudpickle.loads(blob)
+        # unpickle OFF the protocol loop: loads() may import heavy modules
+        # (jax etc.), and a blocked loop can't answer health-check pings
+        obj = await self._loop.run_in_executor(self._executor, cloudpickle.loads, blob)
         cache[key] = obj
         return obj
 

@@ -1,0 +1,243 @@
+"""Model catalog: config-driven policy/value network construction.
+
+Reference parity: rllib/models/catalog.py:204 (ModelCatalog.get_model_v2 —
+picks fcnet/vision/recurrent models from the observation space + model
+config) and rllib/core/models/catalog.py:28 (new-stack Catalog building
+encoder + heads). ray_tpu's catalog returns (init_fn, apply_fn) pairs of
+pure JAX functions over a params pytree, so one definition runs jitted on
+CPU rollout actors and pjit'ed on the learner mesh.
+
+Selection mirrors the reference:
+- rank-3 obs (H, W, C)  -> conv encoder (conv_filters or an auto scheme)
+- flat obs              -> MLP encoder (fcnet_hiddens/fcnet_activation)
+- use_lstm=True         -> LSTM core between encoder and heads; apply then
+  threads a recurrent state: apply(params, obs, state) -> (out, state').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ACTIVATIONS = {
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.swish,
+    "elu": jax.nn.elu,
+}
+
+
+@dataclass
+class ModelConfig:
+    """Subset of the reference's MODEL_DEFAULTS that shapes the network."""
+
+    fcnet_hiddens: Sequence[int] = (64, 64)
+    fcnet_activation: str = "tanh"
+    # [(out_channels, kernel, stride), ...]; None = auto scheme by obs size
+    conv_filters: Optional[Sequence[Tuple[int, int, int]]] = None
+    conv_activation: str = "relu"
+    use_lstm: bool = False
+    lstm_cell_size: int = 128
+
+
+def _act(name: str) -> Callable:
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r} (supported: {sorted(_ACTIVATIONS)})"
+        ) from None
+
+
+def _dense_init(rng, fan_in: int, fan_out: int, scale: float):
+    w = jax.nn.initializers.orthogonal(scale)(rng, (fan_in, fan_out), jnp.float32)
+    return {"w": w, "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def _auto_conv_filters(hw: Tuple[int, int]):
+    """Reference-style defaults: Atari-ish for >=64px, small otherwise."""
+    if min(hw) >= 64:
+        return [(16, 8, 4), (32, 4, 2), (64, 3, 2)]
+    return [(16, 4, 2), (32, 3, 2)]
+
+
+# --------------------------------------------------------------------------
+# encoders
+# --------------------------------------------------------------------------
+
+
+def _mlp_encoder(cfg: ModelConfig, obs_dim: int):
+    hidden = list(cfg.fcnet_hiddens)
+    act = _act(cfg.fcnet_activation)
+
+    def init(rng):
+        layers = []
+        dims = [obs_dim, *hidden]
+        for i in range(len(dims) - 1):
+            rng, sub = jax.random.split(rng)
+            layers.append(_dense_init(sub, dims[i], dims[i + 1], np.sqrt(2)))
+        return {"layers": layers}
+
+    def apply(params, obs):
+        x = obs.reshape(obs.shape[0], -1)
+        for layer in params["layers"]:
+            x = act(x @ layer["w"] + layer["b"])
+        return x
+
+    return init, apply, (hidden[-1] if hidden else obs_dim)
+
+
+def _conv_encoder(cfg: ModelConfig, obs_shape: Tuple[int, int, int]):
+    h, w, c = obs_shape
+    filters = list(cfg.conv_filters or _auto_conv_filters((h, w)))
+    act = _act(cfg.conv_activation)
+
+    def out_hw(size, kernel, stride):  # SAME padding
+        return -(-size // stride)
+
+    shapes = []
+    ch, hh, ww = c, h, w
+    for out_ch, k, s in filters:
+        shapes.append((ch, out_ch, k, s))
+        hh, ww, ch = out_hw(hh, k, s), out_hw(ww, k, s), out_ch
+    flat_dim = hh * ww * ch
+
+    def init(rng):
+        convs = []
+        for in_ch, out_ch, k, s in shapes:
+            rng, sub = jax.random.split(rng)
+            wgt = jax.nn.initializers.orthogonal(np.sqrt(2))(
+                sub, (k, k, in_ch, out_ch), jnp.float32
+            )
+            convs.append({"w": wgt, "b": jnp.zeros((out_ch,), jnp.float32)})
+        return {"convs": convs}
+
+    def apply(params, obs):
+        x = obs.astype(jnp.float32)
+        for (in_ch, out_ch, k, s), layer in zip(shapes, params["convs"]):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + layer["b"]
+            x = act(x)
+        return x.reshape(x.shape[0], -1)
+
+    return init, apply, flat_dim
+
+
+def _lstm_core(cell_size: int, in_dim: int):
+    def init(rng):
+        rng1, rng2 = jax.random.split(rng)
+        scale = 1.0 / np.sqrt(in_dim + cell_size)
+        return {
+            "wx": jax.random.normal(rng1, (in_dim, 4 * cell_size)) * scale,
+            "wh": jax.random.normal(rng2, (cell_size, 4 * cell_size)) * scale,
+            "b": jnp.zeros((4 * cell_size,), jnp.float32),
+        }
+
+    def apply(params, x, state):
+        h, c = state
+        gates = x @ params["wx"] + h @ params["wh"] + params["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def initial_state(batch: int):
+        return (
+            jnp.zeros((batch, cell_size), jnp.float32),
+            jnp.zeros((batch, cell_size), jnp.float32),
+        )
+
+    return init, apply, initial_state
+
+
+# --------------------------------------------------------------------------
+# catalog entry points
+# --------------------------------------------------------------------------
+
+
+def _encoder_for(obs_shape: Sequence[int], cfg: ModelConfig):
+    obs_shape = tuple(int(s) for s in obs_shape)
+    if len(obs_shape) == 3:
+        return _conv_encoder(cfg, obs_shape)  # (H, W, C) image
+    return _mlp_encoder(cfg, int(np.prod(obs_shape)))
+
+
+def get_actor_critic(
+    obs_shape: Sequence[int],
+    num_actions: int,
+    config: Optional[ModelConfig] = None,
+):
+    """Returns (init_fn, apply_fn[, initial_state_fn]).
+
+    Stateless (default): apply(params, obs) -> (logits [B, A], value [B]).
+    use_lstm: apply(params, obs, state) -> ((logits, value), state'), plus
+    an initial_state(batch) third return (reference: use_lstm wrapper in
+    ModelCatalog / recurrent encoders in the new-stack catalog).
+    """
+    cfg = config or ModelConfig()
+    enc_init, enc_apply, enc_dim = _encoder_for(obs_shape, cfg)
+    head_in = cfg.lstm_cell_size if cfg.use_lstm else enc_dim
+    if cfg.use_lstm:
+        lstm_init, lstm_apply, lstm_state = _lstm_core(cfg.lstm_cell_size, enc_dim)
+
+    def init(rng):
+        rng_e, rng_l, rng_pi, rng_vf = jax.random.split(rng, 4)
+        params = {
+            "encoder": enc_init(rng_e),
+            "pi": _dense_init(rng_pi, head_in, num_actions, 0.01),
+            "vf": _dense_init(rng_vf, head_in, 1, 1.0),
+        }
+        if cfg.use_lstm:
+            params["lstm"] = lstm_init(rng_l)
+        return params
+
+    def heads(params, x):
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    if not cfg.use_lstm:
+
+        def apply(params, obs):
+            return heads(params, enc_apply(params["encoder"], obs))
+
+        return init, apply
+
+    def apply_recurrent(params, obs, state):
+        x = enc_apply(params["encoder"], obs)
+        x, state = lstm_apply(params["lstm"], x, state)
+        return heads(params, x), state
+
+    return init, apply_recurrent, lstm_state
+
+
+def get_q_model(
+    obs_shape: Sequence[int],
+    num_actions: int,
+    config: Optional[ModelConfig] = None,
+):
+    """Returns (init_fn, apply_fn): apply(params, obs) -> Q-values [B, A]."""
+    cfg = config or ModelConfig()
+    if cfg.use_lstm:
+        raise ValueError("recurrent Q networks are not supported")
+    enc_init, enc_apply, enc_dim = _encoder_for(obs_shape, cfg)
+
+    def init(rng):
+        rng_e, rng_q = jax.random.split(rng)
+        return {
+            "encoder": enc_init(rng_e),
+            "q": _dense_init(rng_q, enc_dim, num_actions, 1.0),
+        }
+
+    def apply(params, obs):
+        x = enc_apply(params["encoder"], obs)
+        return x @ params["q"]["w"] + params["q"]["b"]
+
+    return init, apply

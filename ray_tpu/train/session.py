@@ -42,9 +42,15 @@ def get_context() -> TrainContext:
     return ctx
 
 
-def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
+def report(
+    metrics: Optional[Dict[str, Any]] = None, checkpoint: Optional[Any] = None, **kwargs
+) -> None:
+    """Accepts both calling styles the reference has shipped:
+    report({"loss": x}) (AIR session API, air/session.py:43) and
+    report(loss=x) (classic tune.report kwargs)."""
+    merged = {**(metrics or {}), **kwargs}
     ctx = get_context()
-    ctx.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+    ctx.results.put({"metrics": merged, "checkpoint": checkpoint})
 
 
 def get_checkpoint():

@@ -34,6 +34,7 @@ from .search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .tpe import TPESearcher  # noqa: F401
 from .trainable import Trainable, report  # noqa: F401
 from .trial import Trial  # noqa: F401
 
@@ -42,7 +43,9 @@ from .trial import Trial  # noqa: F401
 class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
-    num_samples: int = 1
+    # None = default: 1 for the built-in variant generator, UNCAPPED for a
+    # user-supplied search_alg (which then runs to its own exhaustion)
+    num_samples: Optional[int] = None
     max_concurrent_trials: int = 0
     search_alg: Optional[Searcher] = None
     scheduler: Optional[TrialScheduler] = None
@@ -106,9 +109,22 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self._tune_config
         metric = tc.metric or "_metric"
-        searcher = tc.search_alg or BasicVariantGenerator(
-            self._space, num_samples=tc.num_samples, seed=tc.seed
-        )
+        if tc.search_alg is not None:
+            searcher = tc.search_alg
+            if tc.num_samples is not None:
+                from .search import SampleLimiter
+
+                # an explicit num_samples caps ANY searcher — suggestion-
+                # based ones (TPE etc.) never self-exhaust, and without a
+                # cap the experiment would run forever (reference:
+                # tune/tune.py applies num_samples to search algorithms);
+                # self-exhausting searchers keep their own limit when
+                # num_samples is left unset
+                searcher = SampleLimiter(searcher, tc.num_samples)
+        else:
+            searcher = BasicVariantGenerator(
+                self._space, num_samples=tc.num_samples or 1, seed=tc.seed
+            )
         controller = TuneController(
             self._trainable,
             searcher=searcher,
@@ -171,7 +187,7 @@ def run(
     trainable: Callable,
     *,
     config: Optional[Dict[str, Any]] = None,
-    num_samples: int = 1,
+    num_samples: Optional[int] = None,
     metric: Optional[str] = None,
     mode: str = "max",
     scheduler: Optional[TrialScheduler] = None,

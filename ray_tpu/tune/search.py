@@ -209,6 +209,36 @@ class RandomSearch(BasicVariantGenerator):
     pass
 
 
+class SampleLimiter(Searcher):
+    """Caps the TOTAL suggestions from a custom searcher at num_samples —
+    suggestion-based searchers (TPE and friends) never self-exhaust, and
+    the controller stops only when suggest() returns None (reference: Tune
+    applies num_samples to every search algorithm, tune/tune.py)."""
+
+    def __init__(self, searcher: Searcher, num_samples: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.num_samples = num_samples
+        self._issued = 0
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        if self._issued >= self.num_samples:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "PENDING":
+            self._issued += 1
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
 class ConcurrencyLimiter(Searcher):
     """Caps in-flight suggestions (reference: tune/search/concurrency_limiter.py)."""
 

@@ -1,0 +1,237 @@
+"""TPE (Tree-structured Parzen Estimator) suggestion algorithm.
+
+Reference parity: the reference ships Bayesian-optimization searchers as
+thin wrappers over external libraries (tune/search/optuna/, hyperopt/,
+bayesopt/ — optuna's and hyperopt's default sampler IS TPE). ray_tpu
+implements the algorithm directly (numpy-only) behind the same Searcher
+interface, so model-based HPO works with zero extra dependencies.
+
+The algorithm (Bergstra et al., "Algorithms for Hyper-Parameter
+Optimization", NeurIPS 2011): split observed trials into the best gamma
+fraction (l) and the rest (g); model each as a Parzen window (per-dimension
+kernel density); sample candidates from l and keep the one maximizing
+l(x)/g(x) — the expected-improvement-optimal choice under this model.
+Categorical dimensions use smoothed category frequencies instead of KDEs;
+log-scale floats are modeled in log space; unknown/Function domains fall
+back to random sampling.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .search import (
+    Categorical,
+    Domain,
+    Float,
+    Function,
+    Integer,
+    Quantized,
+    Searcher,
+    _is_grid,
+)
+
+
+def _flatten_domains(space: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    """Dotted-path -> Domain or fixed value (grid leaves rejected)."""
+    out: Dict[str, Any] = {}
+    for k, v in space.items():
+        path = prefix + k
+        if _is_grid(v):
+            raise ValueError("TPESearcher does not accept grid_search leaves")
+        if isinstance(v, dict):
+            out.update(_flatten_domains(v, path + "."))
+        else:
+            out[path] = v
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        keys = path.split(".")
+        d = out
+        for k in keys[:-1]:
+            d = d.setdefault(k, {})
+        d[keys[-1]] = v
+    return out
+
+
+class _NumericDim:
+    """Parzen-window model over one numeric dimension."""
+
+    def __init__(self, domain):
+        self.quant: Optional[float] = None
+        if isinstance(domain, Quantized):
+            self.quant = float(domain.q)
+            domain = domain.inner
+        self.integer = isinstance(domain, Integer)
+        self.log = bool(getattr(domain, "log", False))
+        # original-value bounds for clamping (exp(log(x)) round-trips can
+        # land a hair outside the domain)
+        self.value_lo = float(domain.lower)
+        self.value_hi = float(domain.upper) - (1 if self.integer else 0)
+        lo, hi = self.value_lo, self.value_hi
+        if self.log:
+            lo, hi = math.log(lo), math.log(max(hi, lo + 1e-12))
+        self.lo, self.hi = lo, hi
+
+    def to_unit(self, value: float) -> float:
+        v = math.log(max(value, 1e-300)) if self.log else float(value)
+        if self.hi <= self.lo:
+            return 0.5
+        return min(1.0, max(0.0, (v - self.lo) / (self.hi - self.lo)))
+
+    def from_unit(self, u: float):
+        v = self.lo + u * (self.hi - self.lo)
+        if self.log:
+            v = math.exp(v)
+        v = min(max(v, self.value_lo), self.value_hi)
+        if self.quant:
+            # rounding may step just past a bound; Domain.sample has the
+            # same semantics (Quantized rounds the inner sample), so clamp
+            # to the rounded grid of the bounds
+            q = self.quant
+            v = round(v / q) * q
+            v = min(max(v, round(self.value_lo / q) * q), round(self.value_hi / q) * q)
+        if self.integer:
+            v = int(round(v))
+        return v
+
+    @staticmethod
+    def _kde(points: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Kernel centers + per-kernel bandwidths (unit space)."""
+        n = len(points)
+        # Scott-style rule with a floor: tight clusters must keep exploring
+        bw = max(0.03, 1.0 / max(2.0, n ** 1.2))
+        return points, np.full(n, bw)
+
+    @staticmethod
+    def _pdf(x: np.ndarray, centers: np.ndarray, bw: np.ndarray) -> np.ndarray:
+        # truncated-gaussian mixture on [0, 1] (renormalization constants
+        # cancel enough in the l/g ratio to skip for ranking purposes)
+        diff = x[:, None] - centers[None, :]
+        dens = np.exp(-0.5 * (diff / bw[None, :]) ** 2) / bw[None, :]
+        return dens.mean(axis=1) + 1e-12
+
+    def sample_candidates(self, rng: np.random.Generator, good: np.ndarray,
+                          n: int) -> np.ndarray:
+        centers, bw = self._kde(good)
+        idx = rng.integers(0, len(centers), size=n)
+        cand = rng.normal(centers[idx], bw[idx])
+        return np.clip(cand, 0.0, 1.0)
+
+    def score(self, cand: np.ndarray, good: np.ndarray, bad: np.ndarray) -> np.ndarray:
+        gc, gb = self._kde(good)
+        bc, bb = self._kde(bad)
+        return np.log(self._pdf(cand, gc, gb)) - np.log(self._pdf(cand, bc, bb))
+
+
+class TPESearcher(Searcher):
+    """Model-based searcher: random for `n_startup_trials`, then TPE.
+
+    Drop-in for search_alg= in Tuner/tune.run (reference analogue:
+    OptunaSearch/HyperOptSearch with their default TPE samplers).
+
+    Leave `mode` unset to inherit the experiment's mode via
+    set_search_properties (a preset mode here would silently win over the
+    TuneConfig mode — Searcher.set_search_properties only fills Nones);
+    unset resolves to "min" if nothing ever provides one.
+    """
+
+    def __init__(
+        self,
+        space: Dict[str, Any],
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        *,
+        n_startup_trials: int = 10,
+        n_ei_candidates: int = 24,
+        gamma: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric, mode)
+        self._space = dict(space or {})
+        self._flat = _flatten_domains(self._space)
+        self._dims: Dict[str, Any] = {}
+        for path, dom in self._flat.items():
+            base = dom.inner if isinstance(dom, Quantized) else dom
+            if isinstance(base, (Float, Integer)):
+                self._dims[path] = _NumericDim(dom)
+            elif isinstance(base, Categorical):
+                self._dims[path] = base
+            # Function/fixed values: sampled/passed through
+        self.n_startup_trials = n_startup_trials
+        self.n_ei_candidates = n_ei_candidates
+        self.gamma = gamma
+        self._rng = random.Random(seed)
+        self._nprng = np.random.default_rng(seed)
+        self._suggested: Dict[str, Dict[str, Any]] = {}  # trial_id -> flat cfg
+        self._observed: List[Tuple[Dict[str, Any], float]] = []
+
+    # -- observation bookkeeping ----------------------------------------
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        flat = self._suggested.pop(trial_id, None)
+        if flat is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score
+        self._observed.append((flat, score))
+
+    # -- suggestion ------------------------------------------------------
+
+    def _random_flat(self) -> Dict[str, Any]:
+        out = {}
+        for path, dom in self._flat.items():
+            out[path] = dom.sample(self._rng) if isinstance(dom, Domain) else dom
+        return out
+
+    def _split(self):
+        ordered = sorted(self._observed, key=lambda t: t[1])
+        n_good = max(1, int(math.ceil(self.gamma * len(ordered))))
+        return ordered[:n_good], ordered[n_good:]
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._observed) < self.n_startup_trials:
+            flat = self._random_flat()
+        else:
+            good, bad = self._split()
+            flat = {}
+            for path, dom in self._flat.items():
+                dim = self._dims.get(path)
+                if dim is None or not bad:
+                    flat[path] = dom.sample(self._rng) if isinstance(dom, Domain) else dom
+                elif isinstance(dim, Categorical):
+                    flat[path] = self._suggest_categorical(dim, path, good, bad)
+                else:
+                    flat[path] = self._suggest_numeric(dim, path, good, bad)
+        self._suggested[trial_id] = flat
+        return _unflatten(flat)
+
+    def _suggest_numeric(self, dim: _NumericDim, path, good, bad):
+        g = np.array([dim.to_unit(cfg[path]) for cfg, _ in good])
+        b = np.array([dim.to_unit(cfg[path]) for cfg, _ in bad])
+        cand = dim.sample_candidates(self._nprng, g, self.n_ei_candidates)
+        best = cand[int(np.argmax(dim.score(cand, g, b)))]
+        return dim.from_unit(float(best))
+
+    def _suggest_categorical(self, dom: Categorical, path, good, bad):
+        cats = dom.categories
+        # smoothed frequency ratio (the categorical analogue of l/g)
+        def weights(obs):
+            w = np.ones(len(cats))  # +1 smoothing
+            for cfg, _ in obs:
+                try:
+                    w[cats.index(cfg[path])] += 1
+                except ValueError:
+                    pass
+            return w / w.sum()
+
+        ratio = weights(good) / weights(bad)
+        return cats[int(np.argmax(ratio * self._nprng.dirichlet(np.ones(len(cats)))))]

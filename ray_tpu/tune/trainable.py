@@ -15,11 +15,14 @@ from typing import Any, Callable, Dict, Optional
 from ..train.session import TrainContext, _set_context
 
 
-def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
-    """tune.report — usable from function trainables (and train loops)."""
+def report(
+    metrics: Optional[Dict[str, Any]] = None, checkpoint: Optional[Any] = None, **kwargs
+) -> None:
+    """tune.report — usable from function trainables (and train loops).
+    Takes a metrics dict and/or keyword metrics (both reference styles)."""
     from ..train import session
 
-    session.report(metrics, checkpoint=checkpoint)
+    session.report(metrics, checkpoint=checkpoint, **kwargs)
 
 
 def get_checkpoint():
