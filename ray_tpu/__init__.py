@@ -50,6 +50,7 @@ def init(
     namespace: str = "",
     ignore_reinit_error: bool = False,
     _system_config: Optional[Dict[str, Any]] = None,
+    _tracing_startup_hook=None,
     **_kwargs,
 ):
     """Start (or connect to) a ray_tpu runtime.
@@ -70,15 +71,25 @@ def init(
         # (e.g. a test's aggressive prober) must not leak into the next
         _overrides_before_init = dict(GLOBAL_CONFIG._overrides)
         GLOBAL_CONFIG.apply(_system_config)
+    if _tracing_startup_hook is not None:
+        # reference: ray.init(_tracing_startup_hook=...) — the hook installs
+        # the app's opentelemetry SDK provider, then tracing turns on
+        from .util import tracing as _tracing
+
+        _tracing.enable(_tracing_startup_hook)
     address = address or _os.environ.get("RAY_TPU_ADDRESS")
     if address:
         socket_path = _resolve_address(address)
         global_worker.connect_existing(socket_path, namespace=namespace)
+        if GLOBAL_CONFIG.log_to_driver:
+            global_worker.start_log_forwarding()
         return _ctx()
     from ._private.node import Node, default_resources
 
     node = Node(default_resources(num_cpus, num_tpus, resources))
     global_worker.connect_driver(node, namespace=namespace)
+    if GLOBAL_CONFIG.log_to_driver:
+        global_worker.start_log_forwarding()
     return _ctx()
 
 

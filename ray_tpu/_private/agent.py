@@ -75,6 +75,7 @@ class Agent:
         info = await self.conn.request(
             {
                 "t": "register_node",
+                "proto": protocol.PROTOCOL_VERSION,
                 "node_id": self.node_id,
                 "resources": self.resources,
                 "labels": self.labels,
@@ -86,12 +87,18 @@ class Agent:
             cfg.session_dir_root, self.session, "nodes", self.node_id
         )
         os.makedirs(self.scratch_dir, exist_ok=True)
-        memory_task = None
+        aux_tasks = []
         if cfg.memory_monitor_refresh_ms > 0:
-            memory_task = asyncio.get_running_loop().create_task(self._memory_loop())
+            aux_tasks.append(
+                asyncio.get_running_loop().create_task(self._memory_loop())
+            )
+        if cfg.log_to_driver:
+            aux_tasks.append(
+                asyncio.get_running_loop().create_task(self._log_forward_loop())
+            )
         await self._stop.wait()
-        if memory_task is not None:
-            memory_task.cancel()
+        for t in aux_tasks:
+            t.cancel()
         self._cleanup()
 
     async def _memory_loop(self):
@@ -201,9 +208,53 @@ class Agent:
             parts.append(env["PYTHONPATH"])
         parts.extend(p for p in sys.path if p)
         env["PYTHONPATH"] = os.pathsep.join(parts)
-        proc = subprocess.Popen(argv, env=env, cwd=cwd)
+        if cfg.log_to_driver:
+            # per-worker log file; _log_forward_loop tails it and sends
+            # increments to the head, which republishes to drivers
+            # (reference: the per-node log monitor)
+            log_dir = os.path.join(self.scratch_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            env["PYTHONUNBUFFERED"] = "1"
+            logf = open(os.path.join(log_dir, f"{worker_id}.out"), "ab")
+            proc = subprocess.Popen(
+                argv, env=env, cwd=cwd, stdout=logf, stderr=subprocess.STDOUT
+            )
+            logf.close()
+        else:
+            proc = subprocess.Popen(argv, env=env, cwd=cwd)
         self.workers[worker_id] = proc
         return {"pid": proc.pid}
+
+    async def _log_forward_loop(self):
+        from . import log_tail
+
+        log_dir = os.path.join(self.scratch_dir, "logs")
+        offsets: Dict[str, int] = {}
+        wanted = False
+        wanted_checked = float("-inf")  # first tick polls immediately
+        while not self._stop.is_set():
+            await asyncio.sleep(0.3)
+            if self.conn is None or self.conn.closed:
+                continue
+            now = time.monotonic()
+            if now - wanted_checked >= 5.0:
+                wanted_checked = now
+                try:
+                    wanted = await self.conn.request({"t": "logs_wanted"}, timeout=5)
+                except Exception:
+                    wanted = False
+            if not wanted:
+                # no driver subscribed: ship nothing over TCP, but keep the
+                # offsets current so subscription starts with live output
+                log_tail.fast_forward(log_dir, offsets)
+                continue
+            for worker_id, data in log_tail.read_increments(log_dir, offsets):
+                try:
+                    await self.conn.send(
+                        {"t": "worker_logs", "worker_id": worker_id, "data": data}
+                    )
+                except Exception:
+                    pass
 
     async def _h_kill_worker(self, msg):
         proc = self.workers.pop(msg["worker_id"], None)

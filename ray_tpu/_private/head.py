@@ -313,6 +313,8 @@ class Head:
         )
         self._channel_events: Dict[str, asyncio.Event] = {}
         self._push_tasks: Set[asyncio.Task] = set()
+        # handler name -> {count, total_ms, max_ms} (event_stats.h analogue)
+        self.event_stats: Dict[str, dict] = {}
         # submitted jobs: submission_id -> record (entrypoint subprocess)
         self.jobs: Dict[str, dict] = {}
         self._prestart_tasks: List[asyncio.Task] = []
@@ -425,6 +427,10 @@ class Head:
         if cfg.memory_monitor_refresh_ms > 0:
             self._memory_task = asyncio.get_running_loop().create_task(
                 self._memory_loop()
+            )
+        if cfg.log_to_driver:
+            self._log_tail_task = asyncio.get_running_loop().create_task(
+                self._log_tail_loop()
             )
         host = tcp_host if tcp_host is not None else cfg.head_tcp_host
         port = tcp_port if tcp_port is not None else cfg.head_tcp_port
@@ -628,6 +634,45 @@ class Head:
         """A node agent's monitor reported pressure; run the policy there."""
         await self._oom_kill(msg["node_id"], msg["used"], msg["total"])
 
+    # ------------------------------------------------------------------
+    # worker log forwarding (reference: _private/log_monitor.py tails
+    # per-process files and pushes lines to the driver for printing)
+    # ------------------------------------------------------------------
+
+    async def _publish_logs(self, worker_id: str, data: str):
+        await self._h_publish(
+            None, {"channel": "__logs__",
+                   "data": {"worker_id": worker_id, "data": data}}
+        )
+
+    async def _h_worker_logs(self, conn, msg):
+        """Remote agents forward their workers' output here."""
+        await self._publish_logs(msg["worker_id"], msg["data"])
+
+    async def _log_tail_loop(self):
+        from . import log_tail
+
+        log_dir = os.path.join(self.session_dir, "logs")
+        offsets: Dict[str, int] = {}
+        loop = asyncio.get_running_loop()
+        while not self._shutdown:
+            await asyncio.sleep(0.3)
+            if not self.channel_subscribers.get("__logs__"):
+                # nobody listening: don't read content, but keep offsets at
+                # the file ends — a later subscriber gets LIVE output, not
+                # the accumulated backlog of the unsubscribed gap
+                log_tail.fast_forward(log_dir, offsets)
+                continue
+            for worker_id, data in await loop.run_in_executor(
+                None, log_tail.read_increments, log_dir, offsets
+            ):
+                await self._publish_logs(worker_id, data)
+
+    async def _h_logs_wanted(self, conn, msg):
+        """Agents poll this to gate their log forwarding (no subscribers ->
+        no cross-host log traffic)."""
+        return bool(self.channel_subscribers.get("__logs__"))
+
     async def _oom_kill(self, node_id: str, used: int, total: int):
         # per-node cooldown: the previous victim's memory takes time to
         # return to the OS, so killing once per sample would cascade through
@@ -699,6 +744,8 @@ class Head:
             self._health_task.cancel()
         if getattr(self, "_memory_task", None) is not None:
             self._memory_task.cancel()
+        if getattr(self, "_log_tail_task", None) is not None:
+            self._log_tail_task.cancel()
         if getattr(self, "_snapshot_task", None) is not None:
             self._snapshot_task.cancel()
         for t in list(self._prestart_tasks):
@@ -816,17 +863,39 @@ class Head:
         fn = getattr(self, f"_h_{t}", None)
         if fn is None:
             raise ValueError(f"unknown message type {t!r}")
-        return await fn(conn, msg)
+        # per-handler latency/count accounting (reference: event_stats.h
+        # instruments the asio loops); total-time includes awaits, so slow
+        # entries here mean "long-running", busy_ms means "loop-hogging"
+        start = time.perf_counter()
+        try:
+            return await fn(conn, msg)
+        finally:
+            dt = (time.perf_counter() - start) * 1000.0
+            st = self.event_stats.get(t)
+            if st is None:
+                st = self.event_stats[t] = {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            st["count"] += 1
+            st["total_ms"] += dt
+            if dt > st["max_ms"]:
+                st["max_ms"] = dt
+
+    async def _h_event_stats(self, conn, msg):
+        return {
+            t: dict(st, avg_ms=st["total_ms"] / max(1, st["count"]))
+            for t, st in self.event_stats.items()
+        }
 
     # --- registration ---
 
     async def _h_register_driver(self, conn, msg):
+        protocol.check_protocol_version(msg, "driver")
         self._driver_conn = conn
         return {"node_id": self._head_node_id, "job_config": self.job_config}
 
     async def _h_register_node(self, conn, msg):
         """A per-host agent joined over TCP (reference: raylet registration
         with GcsNodeManager)."""
+        protocol.check_protocol_version(msg, f"node agent {msg.get('node_id')}")
         node_id = msg["node_id"]
         if node_id in self.nodes and self.nodes[node_id].alive:
             raise ValueError(f"node id {node_id!r} already registered")
@@ -867,6 +936,7 @@ class Head:
         task.add_done_callback(lambda t: self._prestart_tasks.remove(t))
 
     async def _h_register_worker(self, conn, msg):
+        protocol.check_protocol_version(msg, f"worker {msg.get('worker_id')}")
         w = self.workers.get(msg["worker_id"])
         if w is None:
             raise ValueError(f"unknown worker {msg['worker_id']}")
@@ -1097,15 +1167,29 @@ class Head:
             return  # killed while queued for (re)start — stay dead
         rec.state = "starting"
         rec.node_acquired = False
+        # a restart must not leave the PREVIOUS incarnation's worker id
+        # visible: a concurrent kill would otherwise release resources
+        # against the old worker's node
+        rec.worker_id = None
         spec = rec.spec
+        strategy = spec.get("scheduling_strategy")
+        resources = dict(spec.get("resources") or {})
+
+        def release_here():
+            # release against the node id THIS start acquired (the kill
+            # path can only release once worker_id is assigned; these two
+            # are mutually exclusive via node_acquired)
+            if rec.node_acquired:
+                rec.node_acquired = False
+                self._release_node(node_id, resources, strategy)
+
         for oid in spec.get("deps", []):
             await self.objects.wait_available(oid)
-        resources = dict(spec.get("resources") or {})
-        node_id = await self._acquire_node(resources, spec.get("scheduling_strategy"))
+        node_id = await self._acquire_node(resources, strategy)
         if rec.state == "dead":
             # kill_actor landed during the waits above (worker not yet
             # assigned, so the kill path couldn't release this acquisition)
-            self._release_node(node_id, resources, spec.get("scheduling_strategy"))
+            self._release_node(node_id, resources, strategy)
             return
         rec.node_acquired = True  # stop counting as unmet autoscaler demand
         w = await self._spawn_worker(
@@ -1114,21 +1198,27 @@ class Head:
             runtime_env=spec.get("runtime_env"),
             needs_tpu=resources.get("TPU", 0) > 0,
         )
+        if rec.state == "dead":
+            # killed during the spawn await, before worker_id was visible
+            # to the kill path: release here and reap the fresh worker
+            release_here()
+            await self._kill_worker(w, reason="actor killed during start")
+            return
         rec.worker_id = w.worker_id  # visible to the kill path from here on
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
         except asyncio.TimeoutError:
             pass
         if rec.state == "dead":
-            # killed mid-spawn: _h_kill_actor released the node resources
-            # (worker_id was set) — just reap the fresh worker
+            # killed mid-registration: _h_kill_actor saw worker_id and
+            # released (node_acquired guard makes a second release a no-op)
+            release_here()
             await self._kill_worker(w, reason="actor killed during start")
             return
         if w.state not in ("idle", "starting") or w.conn is None:
             rec.state = "dead"
             rec.death_reason = "worker failed to start"
-            rec.node_acquired = False
-            self._release_node(node_id, resources, spec.get("scheduling_strategy"))
+            release_here()
             return
         w.state = "actor"
         rec.worker_id = w.worker_id
@@ -1203,6 +1293,7 @@ class Head:
                         "method": spec["method"],
                         "args": self._resolve_args(spec),
                         "return_ids": spec["return_ids"],
+                        "trace_ctx": spec.get("trace_ctx"),
                     }
                 )
             )
@@ -1228,6 +1319,7 @@ class Head:
                         "method": spec["method"],
                         "args": self._resolve_args(spec),
                         "return_ids": spec["return_ids"],
+                        "trace_ctx": spec.get("trace_ctx"),
                     }
                 )
             if "results" not in reply:
@@ -1907,6 +1999,7 @@ class Head:
                     "fn_key": spec["fn_key"],
                     "args": self._resolve_args(spec),
                     "return_ids": spec["return_ids"],
+                    "trace_ctx": spec.get("trace_ctx"),
                 }
             )
         except Exception as e:
@@ -2072,6 +2165,14 @@ class Head:
             parts.extend(p for p in sys.path if p)
             env["PYTHONPATH"] = os.pathsep.join(parts)
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        log_file = None
+        if cfg.log_to_driver:
+            # per-worker log file, tailed by _log_tail_loop and pushed to
+            # drivers over the "__logs__" pubsub channel (reference:
+            # _private/log_monitor.py tail + worker.py print redirection)
+            log_dir = os.path.join(self.session_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            log_file = open(os.path.join(log_dir, f"{worker_id}.out"), "ab")
         if needs_tpu:
             # TPU workers get the full interpreter (site hooks may register
             # the PJRT plugin) and inherit JAX_PLATFORMS as-is.
@@ -2088,7 +2189,14 @@ class Head:
             if "PYTHONPATH" not in user_env_vars and not extra_paths:
                 env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
             argv.insert(1, "-S")
-        w.proc = subprocess.Popen(argv, env=env, cwd=cwd)
+        if log_file is not None:
+            env["PYTHONUNBUFFERED"] = "1"  # prints reach the tail promptly
+            w.proc = subprocess.Popen(
+                argv, env=env, cwd=cwd, stdout=log_file, stderr=subprocess.STDOUT
+            )
+            log_file.close()  # child holds its own fd
+        else:
+            w.proc = subprocess.Popen(argv, env=env, cwd=cwd)
         return w
 
     def _stage_dir(self, src: str) -> str:

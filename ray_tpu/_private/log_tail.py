@@ -1,0 +1,54 @@
+"""Shared worker-log tailing used by the head and node agents.
+
+Reference parity: _private/log_monitor.py — tail per-process log files and
+forward increments for driver printing. One implementation serves both the
+head's local tail loop and each agent's forward loop so the chunking /
+offset semantics can't drift.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+_CHUNK = 256 * 1024
+_SUFFIX = ".out"
+
+
+def _log_files(log_dir: str) -> List[str]:
+    try:
+        return [n for n in os.listdir(log_dir) if n.endswith(_SUFFIX)]
+    except OSError:
+        return []
+
+
+def fast_forward(log_dir: str, offsets: Dict[str, int]) -> None:
+    """Advance offsets to the current file ends WITHOUT reading content —
+    used at startup and across unsubscribed gaps so a (re)subscribing
+    driver gets live output, not a megabyte backlog dump."""
+    for name in _log_files(log_dir):
+        try:
+            offsets[name] = os.path.getsize(os.path.join(log_dir, name))
+        except OSError:
+            pass
+
+
+def read_increments(log_dir: str, offsets: Dict[str, int]) -> List[Tuple[str, str]]:
+    """New content per worker since the recorded offsets:
+    [(worker_id, text)], at most _CHUNK bytes per file per call."""
+    out: List[Tuple[str, str]] = []
+    for name in _log_files(log_dir):
+        path = os.path.join(log_dir, name)
+        try:
+            size = os.path.getsize(path)
+            pos = offsets.get(name, 0)
+            if size <= pos:
+                continue
+            with open(path, "rb") as f:
+                f.seek(pos)
+                data = f.read(_CHUNK)
+            offsets[name] = pos + len(data)
+            out.append((name[: -len(_SUFFIX)], data.decode(errors="replace")))
+        except OSError:
+            continue
+    return out

@@ -22,6 +22,24 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 _LEN = struct.Struct("<Q")
 MAX_MSG = 1 << 40
 
+# Wire-format version, carried in every registration message and checked by
+# the head (reference: the protobuf schema + gRPC service versioning of
+# src/ray/protobuf). Bump whenever message shapes change incompatibly —
+# cross-version control planes must fail fast with a clear error, not
+# corrupt state mid-protocol (mixed versions happen when a multi-host
+# deployment upgrades hosts one at a time).
+PROTOCOL_VERSION = 2
+
+
+def check_protocol_version(msg: dict, peer: str) -> None:
+    got = msg.get("proto", 1)
+    if got != PROTOCOL_VERSION:
+        raise ConnectionError(
+            f"{peer} speaks control-plane protocol v{got}, this head speaks "
+            f"v{PROTOCOL_VERSION}; upgrade all hosts to the same ray_tpu "
+            f"version before joining them to one cluster"
+        )
+
 
 def is_tcp_address(address: str) -> bool:
     """'host:port' (TCP) vs a filesystem path (unix socket)."""

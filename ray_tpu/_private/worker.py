@@ -205,6 +205,7 @@ class _ActorChannel:
             "method": spec["method"],
             "args": {"env": spec["args"], "resolved": resolved},
             "return_ids": spec["return_ids"],
+            "trace_ctx": spec.get("trace_ctx"),
         }
         loop = asyncio.get_running_loop()
         fut = loop.create_task(self.conn.request(msg))
@@ -394,7 +395,9 @@ class Worker:
         self.session_dir = node.session_dir
         self.namespace = namespace
         self.conn = self.io.run(self._open_conn(node.socket_path))
-        info = self.request({"t": "register_driver"})
+        info = self.request(
+            {"t": "register_driver", "proto": protocol.PROTOCOL_VERSION}
+        )
         self.node_id = info["node_id"]
         self.connected = True
 
@@ -425,7 +428,9 @@ class Worker:
         )
         self.namespace = namespace
         self.conn = self.io.run(self._open_conn(socket_path))
-        info = self.request({"t": "register_driver"})
+        info = self.request(
+            {"t": "register_driver", "proto": protocol.PROTOCOL_VERSION}
+        )
         self.node_id = info["node_id"]
         if os.environ.get("RAY_TPU_JOB_RUNTIME_ENV"):
             import json
@@ -506,6 +511,22 @@ class Worker:
         except Exception:
             pass
 
+    def start_log_forwarding(self) -> None:
+        """Print workers' stdout/stderr in this driver, prefixed with the
+        worker id (reference: worker.py print redirection fed by the log
+        monitor). Subscribes to the head's "__logs__" channel."""
+
+        def on_log(seq, entry):
+            prefix = f"({entry['worker_id']}) "
+            text = entry["data"]
+            for line in text.splitlines():
+                print(prefix + line, flush=True)
+
+        try:
+            self.subscribe("__logs__", on_log)
+        except Exception:
+            pass  # logs are best-effort; never fail init over them
+
     def poll_channel(self, channel: str, last_seq: int = 0, timeout: float = 30.0):
         """Long-poll for a publish newer than last_seq. Returns (seq, data)
         or None on timeout (caller re-polls)."""
@@ -550,6 +571,7 @@ class Worker:
                     self.io.run(ch.close(), timeout=2)
                 except Exception:
                     pass
+        self._pubsub_callbacks.clear()
         with self._local_lock:
             self._local_objects.clear()
             pending, self._local_pending = dict(self._local_pending), {}
@@ -772,10 +794,18 @@ class Worker:
         task_id = TaskID.for_task(self.job_id)
         return_ids = [ObjectID.for_return(task_id, i).hex() for i in range(num_returns)]
         env, deps = self._prepare_args(args, kwargs)
+        from ..util import tracing
+
+        with tracing.span_for_submission(
+            f"task_submit.{name or getattr(function, '__name__', 'task')}",
+            task_id=task_id.hex(),
+        ):
+            trace_ctx = tracing.inject_current_context()
         spec = {
             "task_id": task_id.hex(),
             "name": name,
             "fn_key": fn_key,
+            "trace_ctx": trace_ctx,
             "args": env,
             "deps": deps,
             "return_ids": return_ids,
@@ -844,10 +874,17 @@ class Worker:
         task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
         return_ids = [ObjectID.for_return(task_id, i).hex() for i in range(num_returns)]
         env, deps = self._prepare_args(args, kwargs)
+        from ..util import tracing
+
+        with tracing.span_for_submission(
+            f"actor_submit.{method}", task_id=task_id.hex(), actor_id=actor_id
+        ):
+            trace_ctx = tracing.inject_current_context()
         spec = {
             "task_id": task_id.hex(),
             "actor_id": actor_id,
             "method": method,
+            "trace_ctx": trace_ctx,
             "args": env,
             "deps": deps,
             "return_ids": return_ids,

@@ -88,6 +88,7 @@ class WorkerServer:
         await self.conn.request(
             {
                 "t": "register_worker",
+                "proto": protocol.PROTOCOL_VERSION,
                 "worker_id": self.worker_id,
                 "pid": os.getpid(),
                 "node_id": self.node_id,
@@ -147,6 +148,8 @@ class WorkerServer:
         return True
 
     async def _run_task(self, msg):
+        from ..util import tracing
+
         if "actor_id" in msg and msg.get("actor_id"):
             method_name = msg["method"]
 
@@ -159,18 +162,24 @@ class WorkerServer:
                     self._loop.call_soon_threadsafe(self._loop.call_later, 0.05, sys.exit, 0)
                     return {"results": []}
                 fn = getattr(inst, method_name)
-                return execute_and_package(
-                    fn, method_name, msg["args"], msg["return_ids"], pin_results=True
-                )
+                with tracing.span_for_execution(
+                    f"actor_method.{method_name}", msg.get("trace_ctx"),
+                    task_id=msg["task_id"], actor_id=msg["actor_id"],
+                ):
+                    return execute_and_package(
+                        fn, method_name, msg["args"], msg["return_ids"], pin_results=True
+                    )
 
             return await self._loop.run_in_executor(self._executor, _call)
         fn = await self._fetch_blob("fn", msg["fn_key"], self._fn_cache)
 
         def _run():
             global_worker.current_task_id = msg["task_id"]
-            return execute_and_package(
-                fn, getattr(fn, "__name__", "task"), msg["args"], msg["return_ids"]
-            )
+            name = getattr(fn, "__name__", "task")
+            with tracing.span_for_execution(
+                f"task.{name}", msg.get("trace_ctx"), task_id=msg["task_id"]
+            ):
+                return execute_and_package(fn, name, msg["args"], msg["return_ids"])
 
         return await self._loop.run_in_executor(self._executor, _run)
 

@@ -54,9 +54,7 @@ def _act(name: str) -> Callable:
         ) from None
 
 
-def _dense_init(rng, fan_in: int, fan_out: int, scale: float):
-    w = jax.nn.initializers.orthogonal(scale)(rng, (fan_in, fan_out), jnp.float32)
-    return {"w": w, "b": jnp.zeros((fan_out,), jnp.float32)}
+from .models import _dense_init  # single source for the orthogonal {w, b} init
 
 
 def _auto_conv_filters(hw: Tuple[int, int]):

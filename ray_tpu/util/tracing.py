@@ -1,0 +1,118 @@
+"""OpenTelemetry task/actor tracing.
+
+Reference parity: python/ray/util/tracing/tracing_helper.py — the reference
+lazily imports opentelemetry (:35-59), wraps task submission and execution
+in spans, and propagates the W3C tracecontext inside the TaskSpec so a
+driver's trace continues across worker processes. ray_tpu does the same:
+enable with `ray_tpu.util.tracing.enable()` (or
+init(_tracing_startup_hook=...)); the hook is where an application installs
+its opentelemetry SDK TracerProvider/exporter — without an SDK the API's
+no-op tracer makes every call here free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Optional
+
+_enabled = False
+_import_failed = False
+
+
+def _otel():
+    """(trace, propagator) or None when opentelemetry isn't importable."""
+    global _import_failed
+    if _import_failed:
+        return None
+    try:
+        from opentelemetry import trace
+        from opentelemetry.trace.propagation.tracecontext import (
+            TraceContextTextMapPropagator,
+        )
+
+        return trace, TraceContextTextMapPropagator()
+    except ImportError:
+        _import_failed = True
+        return None
+
+
+def enable(startup_hook: Optional[Callable[[], None]] = None) -> bool:
+    """Turn on trace propagation for this process. `startup_hook` typically
+    installs the opentelemetry SDK provider/exporter (the reference's
+    _tracing_startup_hook). Returns False when opentelemetry is missing."""
+    global _enabled
+    if startup_hook is not None:
+        startup_hook()
+    if _otel() is None:
+        return False
+    _enabled = True
+    return True
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def inject_current_context() -> Optional[Dict[str, str]]:
+    """W3C tracecontext carrier for the caller's current span (None when
+    tracing is off or there is no recording span) — attached to task specs
+    at submission (reference: _inject_tracing_into_function wrapping at
+    remote_function.py:244)."""
+    if not _enabled:
+        return None
+    otel = _otel()
+    if otel is None:
+        return None
+    carrier: Dict[str, str] = {}
+    otel[1].inject(carrier)
+    return carrier or None
+
+
+@contextlib.contextmanager
+def span_for_execution(name: str, trace_ctx: Optional[Dict[str, str]], **attrs: Any):
+    """Worker-side execution span, parented to the submitter's span via the
+    propagated carrier (reference: _tracing_task_execution wrapping the
+    execute path)."""
+    if trace_ctx and not _enabled:
+        # a propagated context implies the submitter traces: auto-enable so
+        # worker processes join the trace without their own enable() call
+        # (an SDK provider, if wanted in workers, comes via a runtime_env
+        # worker setup hook — same split as the reference)
+        enable()
+    if not _enabled:
+        yield None
+        return
+    otel = _otel()
+    if otel is None:
+        yield None
+        return
+    trace, propagator = otel
+    parent = propagator.extract(trace_ctx) if trace_ctx else None
+    tracer = trace.get_tracer("ray_tpu")
+    with tracer.start_as_current_span(name, context=parent) as span:
+        for k, v in attrs.items():
+            try:
+                span.set_attribute(k, v)
+            except Exception:
+                pass
+        yield span
+
+
+@contextlib.contextmanager
+def span_for_submission(name: str, **attrs: Any):
+    """Driver-side submission span (cheap no-op when disabled)."""
+    if not _enabled:
+        yield None
+        return
+    otel = _otel()
+    if otel is None:
+        yield None
+        return
+    trace, _ = otel
+    with trace.get_tracer("ray_tpu").start_as_current_span(name) as span:
+        for k, v in attrs.items():
+            try:
+                span.set_attribute(k, v)
+            except Exception:
+                pass
+        yield span
