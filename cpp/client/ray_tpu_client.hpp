@@ -1,0 +1,553 @@
+// ray_tpu C++ client API.
+//
+// Reference parity: cpp/ (the C++ worker API — cpp/include/ray/api/*.h,
+// runtime in cpp/src/ray/runtime). Scope here is the CLIENT surface: a C++
+// process attaches to a running ray_tpu head over TCP and can
+//   - register as a driver (protocol-version checked),
+//   - use the cluster KV store,
+//   - put/get objects shared with Python workers (raw bytes or JSON),
+//   - inspect cluster state (nodes, resources),
+//   - submit jobs (shell entrypoints run by the head's job manager).
+// Task/actor execution stays in Python workers (the compute path is
+// JAX/XLA); this matches how the reference's C++ API is a thin frontend
+// over the shared runtime rather than a second scheduler.
+//
+// Wire format: the same length-prefixed frames as the Python control plane
+// (8-byte little-endian length), with JSON bodies — the head detects JSON
+// frames by their leading '{' and replies in kind (protocol.py read_msg).
+//
+// Header-only; no dependencies beyond POSIX sockets and C++17.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+static constexpr int kProtocolVersion = 2;
+
+// ---------------------------------------------------------------------------
+// Minimal JSON value + parser/writer (only what the control plane needs).
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum class Type { Null, Bool, Int, Double, Str, Arr, Obj };
+  Type type = Type::Null;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  static Json null() { return Json{}; }
+  static Json of(bool v) { Json j; j.type = Type::Bool; j.b = v; return j; }
+  static Json of(int64_t v) { Json j; j.type = Type::Int; j.i = v; return j; }
+  static Json of(int v) { return of(static_cast<int64_t>(v)); }
+  static Json of(double v) { Json j; j.type = Type::Double; j.d = v; return j; }
+  static Json of(const std::string &v) { Json j; j.type = Type::Str; j.s = v; return j; }
+  static Json of(const char *v) { return of(std::string(v)); }
+  static Json array() { Json j; j.type = Type::Arr; return j; }
+  static Json object() { Json j; j.type = Type::Obj; return j; }
+
+  bool is_null() const { return type == Type::Null; }
+  bool as_bool() const { return type == Type::Bool ? b : i != 0; }
+  int64_t as_int() const { return type == Type::Int ? i : static_cast<int64_t>(d); }
+  double as_double() const { return type == Type::Double ? d : static_cast<double>(i); }
+  const std::string &as_str() const { return s; }
+  const Json *get(const std::string &key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+
+  void dump(std::string &out) const {
+    switch (type) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += b ? "true" : "false"; break;
+      case Type::Int: out += std::to_string(i); break;
+      case Type::Double: {
+        std::ostringstream ss;
+        ss << d;
+        out += ss.str();
+        break;
+      }
+      case Type::Str: dump_str(s, out); break;
+      case Type::Arr: {
+        out += '[';
+        for (size_t k = 0; k < arr.size(); ++k) {
+          if (k) out += ',';
+          arr[k].dump(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Obj: {
+        out += '{';
+        bool first = true;
+        for (const auto &kv : obj) {
+          if (!first) out += ',';
+          first = false;
+          dump_str(kv.first, out);
+          out += ':';
+          kv.second.dump(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  static void dump_str(const std::string &v, std::string &out) {
+    out += '"';
+    for (char c : v) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    out += '"';
+  }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string &text) : t_(text) {}
+
+  Json parse() {
+    Json v = value();
+    ws();
+    if (pos_ != t_.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  const std::string &t_;
+  size_t pos_ = 0;
+
+  void ws() {
+    while (pos_ < t_.size() && (t_[pos_] == ' ' || t_[pos_] == '\n' ||
+                                t_[pos_] == '\t' || t_[pos_] == '\r'))
+      ++pos_;
+  }
+  char peek() {
+    ws();
+    if (pos_ >= t_.size()) throw std::runtime_error("unexpected end of JSON");
+    return t_[pos_];
+  }
+  void expect(char c) {
+    if (peek() != c) throw std::runtime_error(std::string("expected ") + c);
+    ++pos_;
+  }
+
+  Json value() {
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return Json::of(string());
+    if (c == 't' || c == 'f') return boolean();
+    if (c == 'n') { literal("null"); return Json::null(); }
+    return number();
+  }
+
+  void literal(const char *lit) {
+    size_t n = std::strlen(lit);
+    if (t_.compare(pos_, n, lit) != 0) throw std::runtime_error("bad literal");
+    pos_ += n;
+  }
+
+  Json boolean() {
+    if (t_[pos_] == 't') { literal("true"); return Json::of(true); }
+    literal("false");
+    return Json::of(false);
+  }
+
+  Json number() {
+    size_t start = pos_;
+    bool is_double = false;
+    while (pos_ < t_.size()) {
+      char c = t_[pos_];
+      if (c == '-' || c == '+' || (c >= '0' && c <= '9')) {
+        ++pos_;
+      } else if (c == '.' || c == 'e' || c == 'E') {
+        is_double = true;
+        ++pos_;
+      } else {
+        break;
+      }
+    }
+    std::string num = t_.substr(start, pos_ - start);
+    if (is_double) return Json::of(std::stod(num));
+    return Json::of(static_cast<int64_t>(std::stoll(num)));
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= t_.size()) throw std::runtime_error("unterminated string");
+      char c = t_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = t_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            unsigned code = std::stoul(t_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // BMP-only UTF-8 encode (control-plane strings are ASCII-ish)
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Json object() {
+    expect('{');
+    Json j = Json::object();
+    if (peek() == '}') { ++pos_; return j; }
+    while (true) {
+      std::string key = string();
+      expect(':');
+      j.obj[key] = value();
+      char c = peek();
+      ++pos_;
+      if (c == '}') return j;
+      if (c != ',') throw std::runtime_error("expected , or }");
+    }
+  }
+
+  Json array() {
+    expect('[');
+    Json j = Json::array();
+    if (peek() == ']') { ++pos_; return j; }
+    while (true) {
+      j.arr.push_back(value());
+      char c = peek();
+      ++pos_;
+      if (c == ']') return j;
+      if (c != ',') throw std::runtime_error("expected , or ]");
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// base64 (for raw object payloads)
+// ---------------------------------------------------------------------------
+
+inline std::string B64Encode(const std::string &in) {
+  static const char *tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8) |
+                 static_cast<unsigned char>(in[i + 2]);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  size_t rem = in.size() - i;
+  if (rem == 1) {
+    uint32_t v = static_cast<unsigned char>(in[i]) << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (rem == 2) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+inline std::string B64Decode(const std::string &in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = val(c);
+    if (v < 0) continue;  // '=' padding / whitespace
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  // address: "host:port" of the head's TCP control plane
+  // (<session_dir>/head_addr on the head machine).
+  explicit Client(const std::string &address) {
+    auto colon = address.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("address must be host:port");
+    const std::string host = address.substr(0, colon);
+    const std::string port = address.substr(colon + 1);
+
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo *res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+      throw std::runtime_error("failed to resolve " + address);
+    fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("failed to connect to " + address);
+    }
+    freeaddrinfo(res);
+
+    Json reg = Json::object();
+    reg.obj["t"] = Json::of("register_driver");
+    reg.obj["proto"] = Json::of(kProtocolVersion);
+    Json info = Request(reg);
+    const Json *nid = info.get("node_id");
+    node_id_ = nid ? nid->as_str() : "";
+  }
+
+  ~Client() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  const std::string &node_id() const { return node_id_; }
+
+  // ---- KV (GcsKVManager parity) ----
+
+  bool KvPut(const std::string &key, const std::string &value,
+             const std::string &ns = "cpp") {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("kv_put");
+    m.obj["ns"] = Json::of(ns);
+    m.obj["key"] = Json::of(key);
+    m.obj["value"] = Json::of(value);
+    return Request(m).as_bool();
+  }
+
+  std::string KvGet(const std::string &key, const std::string &ns = "cpp") {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("kv_get");
+    m.obj["ns"] = Json::of(ns);
+    m.obj["key"] = Json::of(key);
+    Json v = Request(m);
+    if (v.is_null()) return "";
+    if (v.type == Json::Type::Obj) {  // bytes come back base64-tagged
+      const Json *b = v.get("__b64__");
+      if (b) return B64Decode(b->as_str());
+    }
+    return v.as_str();
+  }
+
+  // ---- objects (shared with Python via the head's directory) ----
+
+  std::string PutBytes(const std::string &data) {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("xput_object");
+    m.obj["object_id"] = Json::of(NewObjectId());
+    m.obj["format"] = Json::of("raw");
+    m.obj["data"] = Json::of(B64Encode(data));
+    return Request(m).as_str();
+  }
+
+  std::string PutJson(const Json &value) {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("xput_object");
+    m.obj["object_id"] = Json::of(NewObjectId());
+    m.obj["format"] = Json::of("json");
+    m.obj["value"] = value;
+    return Request(m).as_str();
+  }
+
+  // Returns {"format": "raw"|"json"|"error", ...} per object.
+  std::vector<Json> GetObjects(const std::vector<std::string> &ids,
+                               double timeout_s = 60.0) {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("xget_objects");
+    Json arr = Json::array();
+    for (const auto &id : ids) arr.arr.push_back(Json::of(id));
+    m.obj["object_ids"] = arr;
+    m.obj["timeout"] = Json::of(timeout_s);
+    Json out = Request(m);
+    return out.arr;
+  }
+
+  std::string GetBytes(const std::string &id, double timeout_s = 60.0) {
+    Json v = GetObjects({id}, timeout_s).at(0);
+    const Json *fmt = v.get("format");
+    if (fmt && fmt->as_str() == "error")
+      throw std::runtime_error("object error: " + v.get("error")->as_str());
+    if (fmt && fmt->as_str() == "raw") return B64Decode(v.get("data")->as_str());
+    std::string s;
+    v.get("value")->dump(s);
+    return s;
+  }
+
+  // ---- cluster state ----
+
+  Json ClusterResources() {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("cluster_resources");
+    return Request(m);
+  }
+
+  Json Nodes() {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("nodes");
+    return Request(m);
+  }
+
+  // ---- jobs (JobSupervisor parity: shell entrypoints on the head) ----
+
+  std::string SubmitJob(const std::string &entrypoint) {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("submit_job");
+    m.obj["entrypoint"] = Json::of(entrypoint);
+    return Request(m).as_str();
+  }
+
+  std::string JobStatus(const std::string &submission_id) {
+    Json m = Json::object();
+    m.obj["t"] = Json::of("job_status");
+    m.obj["submission_id"] = Json::of(submission_id);
+    return Request(m).as_str();
+  }
+
+  // ---- low-level request/response ----
+
+  Json Request(Json msg) {
+    msg.obj["rid"] = Json::of(++rid_);
+    std::string body;
+    msg.dump(body);
+    SendFrame(body);
+    while (true) {
+      Json reply = JsonParser(RecvFrame()).parse();
+      const Json *t = reply.get("t");
+      if (!t || t->as_str() != "reply") continue;  // ignore pushes
+      const Json *ok = reply.get("ok");
+      if (!ok || !ok->as_bool()) {
+        const Json *err = reply.get("error");
+        throw std::runtime_error("head error: " +
+                                 (err ? err->as_str() : "unknown"));
+      }
+      const Json *v = reply.get("value");
+      return v ? *v : Json::null();
+    }
+  }
+
+ private:
+  int fd_ = -1;
+  int64_t rid_ = 0;
+  int64_t oid_counter_ = 0;
+  std::string node_id_;
+
+  std::string NewObjectId() {
+    // any unique key works for the head's object directory; scope by pid
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "cppobj-%d-%lld", getpid(),
+                  static_cast<long long>(++oid_counter_));
+    return buf;
+  }
+
+  void SendFrame(const std::string &body) {
+    uint64_t n = body.size();
+    char hdr[8];
+    for (int k = 0; k < 8; ++k) hdr[k] = static_cast<char>((n >> (8 * k)) & 0xFF);
+    WriteAll(hdr, 8);
+    WriteAll(body.data(), body.size());
+  }
+
+  std::string RecvFrame() {
+    char hdr[8];
+    ReadAll(hdr, 8);
+    uint64_t n = 0;
+    for (int k = 0; k < 8; ++k)
+      n |= static_cast<uint64_t>(static_cast<unsigned char>(hdr[k])) << (8 * k);
+    std::string body(n, '\0');
+    ReadAll(body.data(), n);
+    return body;
+  }
+
+  void WriteAll(const char *p, size_t n) {
+    while (n) {
+      ssize_t w = ::write(fd_, p, n);
+      if (w <= 0) throw std::runtime_error("connection write failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void ReadAll(char *p, size_t n) {
+    while (n) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+};
+
+}  // namespace ray_tpu

@@ -1049,6 +1049,54 @@ class Head:
             oid for oid in ids if oid not in ready_set
         ]
 
+    # --- cross-language object exchange (JSON-codec clients, cpp/client/;
+    # reference: the msgpack cross-language serialization the C++/Java
+    # worker APIs use, cpp/src/ray/runtime) ---
+
+    async def _h_xput_object(self, conn, msg):
+        """Put from a non-Python client: "raw" = base64 bytes (stored as
+        Python bytes), "json" = a JSON value. Stored as a normal envelope,
+        so Python consumers just ray_tpu.get() it."""
+        import base64
+
+        from .serialization import serialize
+
+        if msg.get("format") == "raw":
+            value = base64.b64decode(msg["data"])
+        else:
+            value = msg.get("value")
+        oid = msg["object_id"]
+        self.objects.put(oid, serialize(value))
+        self.objects.add_ref(oid, msg.get("initial_refs", 1))
+        return oid
+
+    async def _h_xget_objects(self, conn, msg):
+        """Get for a non-Python client: values come back as JSON when they
+        are JSON-representable, base64-tagged bytes otherwise."""
+        import base64
+
+        from .serialization import deserialize, materialize
+
+        envs = await self._h_get_objects(conn, msg)
+        out = []
+        loop = asyncio.get_running_loop()
+        for env in envs:
+            # materialize OFF the loop: fetching cross-node buffers performs
+            # a blocking round-trip back through this very event loop, so
+            # doing it inline would deadlock the whole control plane
+            def _load(env=env):
+                e = materialize(env, self._shm_client())
+                return e, deserialize(e)
+
+            env, value = await loop.run_in_executor(None, _load)
+            if getattr(env, "is_error", False):
+                out.append({"format": "error", "error": repr(value)})
+            elif isinstance(value, bytes):
+                out.append({"format": "raw", "data": base64.b64encode(value).decode()})
+            else:
+                out.append({"format": "json", "value": value})
+        return out
+
     async def _h_add_refs(self, conn, msg):
         for oid, n in msg["counts"].items():
             self.objects.add_ref(oid, n)
@@ -1221,7 +1269,6 @@ class Head:
             release_here()
             return
         w.state = "actor"
-        rec.worker_id = w.worker_id
         try:
             await w.conn.request(
                 {

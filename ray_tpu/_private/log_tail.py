@@ -35,7 +35,14 @@ def fast_forward(log_dir: str, offsets: Dict[str, int]) -> None:
 
 def read_increments(log_dir: str, offsets: Dict[str, int]) -> List[Tuple[str, str]]:
     """New content per worker since the recorded offsets:
-    [(worker_id, text)], at most _CHUNK bytes per file per call."""
+    [(worker_id, text)], at most _CHUNK bytes per file per call.
+
+    Emits only COMPLETE lines: a partially-written trailing line (or a
+    multi-byte UTF-8 character straddling the chunk edge) stays in the file
+    for the next call — splitting it would print corrupted half-lines in
+    the driver (the reference log monitor buffers to newlines the same
+    way). A full newline-free chunk is emitted as-is so one giant line
+    can't stall the tail forever."""
     out: List[Tuple[str, str]] = []
     for name in _log_files(log_dir):
         path = os.path.join(log_dir, name)
@@ -47,6 +54,11 @@ def read_increments(log_dir: str, offsets: Dict[str, int]) -> List[Tuple[str, st
             with open(path, "rb") as f:
                 f.seek(pos)
                 data = f.read(_CHUNK)
+            if len(data) < _CHUNK:
+                cut = data.rfind(b"\n") + 1
+                if cut == 0:
+                    continue  # no complete line yet; retry next tick
+                data = data[:cut]
             offsets[name] = pos + len(data)
             out.append((name[: -len(_SUFFIX)], data.decode(errors="replace")))
         except OSError:

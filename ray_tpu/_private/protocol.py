@@ -62,17 +62,51 @@ async def open_stream(address: str):
     return await asyncio.open_unix_connection(address)
 
 
-async def read_msg(reader: asyncio.StreamReader) -> dict:
+CODEC_PICKLE = "pickle"
+CODEC_JSON = "json"
+
+
+async def read_msg(reader: asyncio.StreamReader) -> Tuple[dict, str]:
+    """Returns (msg, codec). Frames are pickle by default; a body whose
+    first byte is '{' is a JSON frame from a cross-language client (the
+    C++ API, cpp/client/) — unambiguous because pickle protocol >= 2
+    always starts with 0x80. Replies go back in the codec of the request
+    (reference: the protobuf wire format serves every worker language)."""
     hdr = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(hdr)
     if n > MAX_MSG:
         raise ConnectionError(f"oversized frame: {n}")
     body = await reader.readexactly(n)
-    return pickle.loads(body)
+    if body[:1] == b"{":
+        import json
+
+        return json.loads(body), CODEC_JSON
+    return pickle.loads(body), CODEC_PICKLE
 
 
-def _frame(msg: dict) -> bytes:
-    body = pickle.dumps(msg, protocol=5)
+def _json_safe(value):
+    """Best-effort JSON view of a reply value for cross-language clients
+    (bytes -> base64 under a tag; unknown objects -> repr)."""
+    import base64
+
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, bytes):
+        return {"__b64__": base64.b64encode(value).decode()}
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    return repr(value)
+
+
+def _frame(msg: dict, codec: str = CODEC_PICKLE) -> bytes:
+    if codec == CODEC_JSON:
+        import json
+
+        body = json.dumps(_json_safe(msg)).encode()
+    else:
+        body = pickle.dumps(msg, protocol=5)
     return _LEN.pack(len(body)) + body
 
 
@@ -105,6 +139,10 @@ class Connection:
         self._send_lock = asyncio.Lock()
         self._closed = False
         self._reader_task: Optional[asyncio.Task] = None
+        # sticky peer codec: once a JSON frame arrives, pushes (pubsub,
+        # kill notices) go back as JSON too — a cross-language subscriber
+        # must never receive a pickle frame it can't parse
+        self.codec = CODEC_PICKLE
 
     def start(self):
         self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -113,7 +151,9 @@ class Connection:
     async def _read_loop(self):
         try:
             while True:
-                msg = await read_msg(self.reader)
+                msg, codec = await read_msg(self.reader)
+                if codec == CODEC_JSON:
+                    self.codec = CODEC_JSON
                 if msg.get("t") == "reply":
                     fut = self._pending.pop(msg["rid"], None)
                     if fut is not None and not fut.done():
@@ -122,28 +162,33 @@ class Connection:
                         else:
                             fut.set_exception(msg["error"])
                 else:
-                    asyncio.get_running_loop().create_task(self._dispatch(msg))
+                    asyncio.get_running_loop().create_task(self._dispatch(msg, codec))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
             await self._close()
 
-    async def _dispatch(self, msg: dict):
+    async def _dispatch(self, msg: dict, codec: str = CODEC_PICKLE):
         rid = msg.get("rid")
         try:
             result = await self.handler(msg)
             if rid is not None:
-                await self.send({"t": "reply", "rid": rid, "ok": True, "value": result})
+                await self.send(
+                    {"t": "reply", "rid": rid, "ok": True, "value": result}, codec
+                )
         except Exception as e:  # noqa: BLE001 - errors propagate to the peer
             if rid is not None:
                 try:
-                    await self.send({"t": "reply", "rid": rid, "ok": False, "error": e})
+                    err = repr(e) if codec == CODEC_JSON else e
+                    await self.send(
+                        {"t": "reply", "rid": rid, "ok": False, "error": err}, codec
+                    )
                 except Exception:
                     pass
 
-    async def send(self, msg: dict):
+    async def send(self, msg: dict, codec: Optional[str] = None):
         async with self._send_lock:
-            self.writer.write(_frame(msg))
+            self.writer.write(_frame(msg, codec or self.codec))
             await self.writer.drain()
 
     async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
