@@ -27,6 +27,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <map>
 #include <memory>
 #include <sstream>
@@ -79,6 +80,9 @@ struct Json {
       case Type::Int: out += std::to_string(i); break;
       case Type::Double: {
         std::ostringstream ss;
+        // max_digits10: round-trip exact — default 6-digit precision would
+        // silently corrupt timestamps/offsets crossing the wire
+        ss.precision(std::numeric_limits<double>::max_digits10);
         ss << d;
         out += ss.str();
         break;
@@ -533,7 +537,9 @@ class Client {
 
   void WriteAll(const char *p, size_t n) {
     while (n) {
-      ssize_t w = ::write(fd_, p, n);
+      // MSG_NOSIGNAL: a half-closed socket (head restart) must surface as
+      // the documented exception, not kill the process with SIGPIPE
+      ssize_t w = ::send(fd_, p, n, MSG_NOSIGNAL);
       if (w <= 0) throw std::runtime_error("connection write failed");
       p += w;
       n -= static_cast<size_t>(w);

@@ -83,13 +83,16 @@ def init(
         global_worker.connect_existing(socket_path, namespace=namespace)
         if GLOBAL_CONFIG.log_to_driver:
             global_worker.start_log_forwarding()
-        return _ctx()
-    from ._private.node import Node, default_resources
+    else:
+        from ._private.node import Node, default_resources
 
-    node = Node(default_resources(num_cpus, num_tpus, resources))
-    global_worker.connect_driver(node, namespace=namespace)
-    if GLOBAL_CONFIG.log_to_driver:
-        global_worker.start_log_forwarding()
+        node = Node(default_resources(num_cpus, num_tpus, resources))
+        global_worker.connect_driver(node, namespace=namespace)
+        if GLOBAL_CONFIG.log_to_driver:
+            global_worker.start_log_forwarding()
+    from ._private import usage as _usage
+
+    _usage.set_session_dir(global_worker.session_dir)
     return _ctx()
 
 

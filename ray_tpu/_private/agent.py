@@ -96,6 +96,10 @@ class Agent:
             aux_tasks.append(
                 asyncio.get_running_loop().create_task(self._log_forward_loop())
             )
+        if cfg.resource_report_period_ms > 0:
+            aux_tasks.append(
+                asyncio.get_running_loop().create_task(self._resource_report_loop())
+            )
         await self._stop.wait()
         for t in aux_tasks:
             t.cancel()
@@ -123,6 +127,36 @@ class Agent:
                     )
                 except Exception:
                     pass
+
+    async def _resource_report_loop(self):
+        """Periodic node load report to the head (reference: ray_syncer
+        resource gossip, ray_syncer.h:86 — collapsed to agent->head pushes
+        since scheduling is centralized; the head folds the reports into
+        the node table for the state API / dashboard / autoscaler)."""
+        from .memory_monitor import MemoryMonitor
+
+        mon = MemoryMonitor()
+        while not self._stop.is_set():
+            await asyncio.sleep(cfg.resource_report_period_ms / 1000.0)
+            if self.conn is None or self.conn.closed:
+                continue
+            try:
+                used, total = mon.sample()
+                report = {
+                    "load_1m": os.getloadavg()[0],
+                    "mem_used": used,
+                    "mem_total": total,
+                    "workers": sum(
+                        1 for p in self.workers.values() if p.poll() is None
+                    ),
+                    "ts": time.time(),
+                }
+                await self.conn.send(
+                    {"t": "resource_report", "node_id": self.node_id,
+                     "report": report}
+                )
+            except Exception:
+                pass
 
     async def _on_close(self):
         self._stop.set()
@@ -230,6 +264,7 @@ class Agent:
 
         log_dir = os.path.join(self.scratch_dir, "logs")
         offsets: Dict[str, int] = {}
+        pending: Dict[str, tuple] = {}
         wanted = False
         wanted_checked = float("-inf")  # first tick polls immediately
         while not self._stop.is_set():
@@ -248,7 +283,7 @@ class Agent:
                 # offsets current so subscription starts with live output
                 log_tail.fast_forward(log_dir, offsets)
                 continue
-            for worker_id, data in log_tail.read_increments(log_dir, offsets):
+            for worker_id, data in log_tail.read_increments(log_dir, offsets, pending):
                 try:
                     await self.conn.send(
                         {"t": "worker_logs", "worker_id": worker_id, "data": data}

@@ -121,6 +121,8 @@ D("memory_usage_threshold", float, 0.95,
   "(reference: ray_config_def.h memory_usage_threshold)")
 D("memory_monitor_test_path", str, "",
   "test hook: file holding '<used> <total>' bytes used as the memory sample")
+D("resource_report_period_ms", int, 2000,
+  "agent->head node load report period (ray_syncer gossip analogue)")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

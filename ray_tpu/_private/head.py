@@ -60,6 +60,8 @@ class NodeRecord:
     conn: Optional["protocol.Connection"] = None
     health_failures: int = 0
     probing: bool = False
+    # last load report from the node's agent (ray_syncer analogue)
+    load_report: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         if not self.available:
@@ -654,6 +656,7 @@ class Head:
 
         log_dir = os.path.join(self.session_dir, "logs")
         offsets: Dict[str, int] = {}
+        pending: Dict[str, tuple] = {}
         loop = asyncio.get_running_loop()
         while not self._shutdown:
             await asyncio.sleep(0.3)
@@ -664,7 +667,7 @@ class Head:
                 log_tail.fast_forward(log_dir, offsets)
                 continue
             for worker_id, data in await loop.run_in_executor(
-                None, log_tail.read_increments, log_dir, offsets
+                None, log_tail.read_increments, log_dir, offsets, pending
             ):
                 await self._publish_logs(worker_id, data)
 
@@ -1594,6 +1597,13 @@ class Head:
                     avail[k] += v
         return {"total": dict(total), "available": dict(avail)}
 
+    async def _h_resource_report(self, conn, msg):
+        """Fold an agent's periodic load report into the node table
+        (reference: ray_syncer resource gossip landing in GCS)."""
+        node = self.nodes.get(msg["node_id"])
+        if node is not None:
+            node.load_report = msg["report"]
+
     async def _h_nodes(self, conn, msg):
         return [
             {
@@ -1602,6 +1612,7 @@ class Head:
                 "resources": n.resources,
                 "available": n.available,
                 "labels": n.labels,
+                "load_report": n.load_report,
             }
             for n in self.nodes.values()
         ]

@@ -9,7 +9,7 @@ offset semantics can't drift.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 _CHUNK = 256 * 1024
 _SUFFIX = ".out"
@@ -33,32 +33,64 @@ def fast_forward(log_dir: str, offsets: Dict[str, int]) -> None:
             pass
 
 
-def read_increments(log_dir: str, offsets: Dict[str, int]) -> List[Tuple[str, str]]:
+_FLUSH_PARTIAL_AFTER_S = 1.0
+
+
+def read_increments(
+    log_dir: str,
+    offsets: Dict[str, int],
+    pending: Optional[Dict[str, Tuple[int, float]]] = None,
+) -> List[Tuple[str, str]]:
     """New content per worker since the recorded offsets:
     [(worker_id, text)], at most _CHUNK bytes per file per call.
 
-    Emits only COMPLETE lines: a partially-written trailing line (or a
-    multi-byte UTF-8 character straddling the chunk edge) stays in the file
-    for the next call — splitting it would print corrupted half-lines in
-    the driver (the reference log monitor buffers to newlines the same
-    way). A full newline-free chunk is emitted as-is so one giant line
-    can't stall the tail forever."""
+    Emits COMPLETE lines: a partially-written trailing line (or a
+    multi-byte UTF-8 character straddling the chunk edge) is held back —
+    splitting it would print corrupted half-lines in the driver (the
+    reference log monitor buffers to newlines the same way). Two escape
+    hatches keep output flowing: a held partial line that stops growing
+    for ~1s is flushed anyway (a crashed worker's final un-terminated
+    diagnostic must not be withheld forever), and a newline-free chunk of
+    the full _CHUNK size is emitted whole (one giant line must not stall
+    the tail). Callers pass a persistent `pending` dict for the
+    stale-partial tracking."""
+    import time
+
     out: List[Tuple[str, str]] = []
+    if pending is None:
+        pending = {}
     for name in _log_files(log_dir):
         path = os.path.join(log_dir, name)
         try:
             size = os.path.getsize(path)
             pos = offsets.get(name, 0)
             if size <= pos:
+                pending.pop(name, None)
                 continue
             with open(path, "rb") as f:
                 f.seek(pos)
                 data = f.read(_CHUNK)
-            if len(data) < _CHUNK:
-                cut = data.rfind(b"\n") + 1
-                if cut == 0:
-                    continue  # no complete line yet; retry next tick
-                data = data[:cut]
+            cut = data.rfind(b"\n") + 1
+            if cut < len(data):
+                # trailing partial line: trim it off — unless the file has
+                # stopped growing (crash tail) or the whole chunk is one
+                # giant newline-free line
+                seen = pending.get(name)
+                stale = (
+                    seen is not None
+                    and seen[0] == size
+                    and time.monotonic() - seen[1] >= _FLUSH_PARTIAL_AFTER_S
+                )
+                if not stale and not (cut == 0 and len(data) == _CHUNK):
+                    if seen is None or seen[0] != size:
+                        pending[name] = (size, time.monotonic())
+                    data = data[:cut]
+                    if not data:
+                        continue  # partial only; wait (or flush when stale)
+                else:
+                    pending.pop(name, None)
+            else:
+                pending.pop(name, None)
             offsets[name] = pos + len(data)
             out.append((name[: -len(_SUFFIX)], data.decode(errors="replace")))
         except OSError:

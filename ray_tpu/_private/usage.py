@@ -1,0 +1,72 @@
+"""Local usage recording (opt-out), no network egress.
+
+Reference parity: python/ray/_private/usage/usage_lib.py — the reference
+collects feature-usage tags and reports them (opt-out via env,
+usage_lib.py:292-297). ray_tpu keeps the same tag surface but records to a
+LOCAL file only (<session_dir>/usage.json): the data answers "which
+subsystems did this session touch" for operators and tests without any
+phone-home.
+
+Opt out with RAY_TPU_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_tags: Dict[str, str] = {}
+_session_dir: Optional[str] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in ("0", "false")
+
+
+def set_session_dir(path: Optional[str]) -> None:
+    global _session_dir
+    _session_dir = path
+    if path is not None:
+        _flush()  # tags recorded before init (library imports) land now
+
+
+def record_library_usage(name: str) -> None:
+    """Tag a subsystem as used this session (train/tune/serve/data/...)."""
+    record_extra_usage_tag(f"library_{name}", "1")
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    if not enabled():
+        return
+    with _lock:
+        _tags[key] = str(value)
+    _flush()
+
+
+def usage_stats() -> Dict[str, str]:
+    with _lock:
+        return dict(_tags)
+
+
+def _flush() -> None:
+    sd = _session_dir
+    if sd is None or not os.path.isdir(sd):
+        return
+    try:
+        with _lock:
+            payload = {"time": time.time(), "tags": dict(_tags)}
+        tmp = os.path.join(sd, ".usage.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(sd, "usage.json"))
+    except OSError:
+        pass
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _tags.clear()

@@ -28,3 +28,7 @@ from .datasource import (  # noqa: F401
 from .grouped_data import GroupedData  # noqa: F401
 
 range = range_  # noqa: A001 — mirror ray.data.range
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("data")

@@ -31,3 +31,7 @@ from .rollout_worker import RolloutWorker  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401
 from . import offline  # noqa: F401,E402
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("rl")

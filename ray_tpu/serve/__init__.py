@@ -173,3 +173,7 @@ def shutdown():
         ray_tpu.kill(proxy)
     except Exception:
         pass
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("serve")

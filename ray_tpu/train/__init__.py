@@ -12,3 +12,7 @@ from .config import ScalingConfig, RunConfig, FailureConfig, CheckpointConfig  #
 from .session import report, get_context  # noqa: F401
 from .checkpoint import Checkpoint, save_checkpoint, restore_checkpoint  # noqa: F401
 from .batch_predictor import BatchPredictor, JaxPredictor, Predictor  # noqa: F401,E402
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("train")

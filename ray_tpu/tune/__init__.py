@@ -223,3 +223,7 @@ def with_parameters(fn: Callable, **params) -> Callable:
         return fn(config, **params)
 
     return wrapped
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("tune")

@@ -21,3 +21,7 @@ from .api import (  # noqa: F401
     run_async,
     WorkflowStatus,
 )
+
+from .._private.usage import record_library_usage as _rlu  # noqa: E402
+
+_rlu("workflow")

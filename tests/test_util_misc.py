@@ -1,0 +1,117 @@
+"""Accelerator helpers, usage recording, agent load reports
+(reference: util/accelerators/, _private/usage/usage_lib.py,
+common/ray_syncer)."""
+
+import json
+import os
+import time
+
+import pytest
+
+
+def test_accelerator_parsing():
+    from ray_tpu.util import accelerators as acc
+
+    assert acc.parse_accelerator_type("v4-32") == (acc.TPU_V4, 16)
+    assert acc.parse_accelerator_type("v5e-16") == (acc.TPU_V5E, 16)
+    assert acc.parse_accelerator_type("v5p-128") == (acc.TPU_V5P, 64)
+    assert acc.slice_hosts("v4-32") == 4  # 16 chips / 4 per host
+    assert acc.slice_hosts("v5e-16") == 2
+    bundles = acc.slice_bundles("v4-32", cpus_per_host=2)
+    assert len(bundles) == 4
+    assert all(b == {"CPU": 2, "TPU": 4.0} for b in bundles)
+    with pytest.raises(ValueError):
+        acc.parse_accelerator_type("h100-8")
+
+
+def test_slice_bundles_gang_schedule(ray_start_cluster):
+    """A v5e-16 slice gang-schedules over 2 simulated TPU hosts."""
+    import ray_tpu
+    from ray_tpu.util import accelerators as acc
+    from ray_tpu.util.placement_group import placement_group
+
+    cluster = ray_start_cluster
+    for _ in range(2):
+        cluster.add_node(num_cpus=4, num_tpus=8)
+    pg = placement_group(acc.slice_bundles("v5e-16", cpus_per_host=1),
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+
+
+def test_usage_recording(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import usage
+
+    usage.record_library_usage("testlib")
+    path = os.path.join(ray_tpu._private.worker.global_worker.session_dir, "usage.json")
+    deadline = time.time() + 5
+    data = {}
+    while time.time() < deadline:
+        if os.path.exists(path):
+            data = json.load(open(path))
+            if "library_testlib" in data.get("tags", {}):
+                break
+        time.sleep(0.1)
+    assert data["tags"]["library_testlib"] == "1"
+    # libraries imported in this process were tagged too
+    import ray_tpu.data  # noqa: F401
+
+    usage.record_extra_usage_tag("custom", "x")
+    assert usage.usage_stats()["library_data"] == "1"
+
+
+def test_usage_opt_out(monkeypatch):
+    from ray_tpu._private import usage
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    usage.reset_for_tests()
+    usage.record_extra_usage_tag("should_not_exist", "1")
+    assert "should_not_exist" not in usage.usage_stats()
+
+
+def test_agent_load_reports(ray_start_cluster):
+    """Agents gossip load reports that land in the node table."""
+    import ray_tpu
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"reporting": 1})
+    deadline = time.time() + 20
+    report = None
+    while time.time() < deadline:
+        for n in ray_tpu.nodes():
+            if n["resources"].get("reporting") and n.get("load_report"):
+                report = n["load_report"]
+                break
+        if report:
+            break
+        time.sleep(0.2)
+    assert report is not None
+    assert report["mem_total"] > 0
+    assert "load_1m" in report and "workers" in report
+
+
+def test_log_tail_partial_line_semantics(tmp_path):
+    """Complete lines emit immediately; a growing partial line is held;
+    a stalled partial line (crash tail) flushes after ~1s."""
+    import time as _time
+
+    from ray_tpu._private import log_tail
+
+    d = str(tmp_path)
+    p = os.path.join(d, "worker-1.out")
+    offsets, pending = {}, {}
+    open(p, "wb").write(b"line1\nline2\npartial")
+    assert log_tail.read_increments(d, offsets, pending) == [
+        ("worker-1", "line1\nline2\n")
+    ]
+    assert log_tail.read_increments(d, offsets, pending) == []
+    open(p, "ab").write(b"-done\n")
+    assert log_tail.read_increments(d, offsets, pending) == [
+        ("worker-1", "partial-done\n")
+    ]
+    open(p, "ab").write(b"FATAL no newline")
+    assert log_tail.read_increments(d, offsets, pending) == []
+    _time.sleep(1.1)
+    assert log_tail.read_increments(d, offsets, pending) == [
+        ("worker-1", "FATAL no newline")
+    ]
