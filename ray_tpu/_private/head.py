@@ -1186,8 +1186,14 @@ class Head:
             raise
         finally:
             self._reconstructing.pop(oid, None)
-            # the future may never be awaited by anyone else
-            if fut.done() and fut.exception() is not None:
+            if not fut.done():
+                # this task was CANCELLED mid-reconstruction (its consumer's
+                # connection died); concurrent waiters on the shared future
+                # must not hang forever — they see the cancellation and
+                # their own clients can retry
+                fut.cancel()
+            elif fut.exception() is not None:
+                # the future may never be awaited by anyone else
                 fut.exception()  # mark retrieved
 
     # --- actors ---

@@ -30,6 +30,14 @@ MAX_MSG = 1 << 40
 # deployment upgrades hosts one at a time).
 PROTOCOL_VERSION = 2
 
+# Handler types that may PARK indefinitely waiting for cluster events and
+# only read state — safe (and necessary) to cancel when their connection
+# dies. Everything else runs to completion even if the peer is gone.
+PARKABLE_TYPES = frozenset(
+    {"poll_channel", "get_objects", "wait_objects", "pg_ready",
+     "reconstruct_objects", "xget_objects"}
+)
+
 
 def check_protocol_version(msg: dict, peer: str) -> None:
     got = msg.get("proto", 1)
@@ -143,6 +151,13 @@ class Connection:
         # kill notices) go back as JSON too — a cross-language subscriber
         # must never receive a pickle frame it can't parse
         self.codec = CODEC_PICKLE
+        # in-flight PARKABLE handler tasks, cancelled at close — otherwise
+        # a blocked handler (e.g. a parked long-poll) outlives its
+        # connection and is "destroyed but pending" at loop teardown.
+        # Non-parkable (state-mutating) dispatches are left to run to
+        # completion: cancelling e.g. kill_actor mid-flight would strand
+        # half-applied state transitions.
+        self._dispatch_tasks: set = set()
 
     def start(self):
         self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
@@ -162,7 +177,12 @@ class Connection:
                         else:
                             fut.set_exception(msg["error"])
                 else:
-                    asyncio.get_running_loop().create_task(self._dispatch(msg, codec))
+                    task = asyncio.get_running_loop().create_task(
+                        self._dispatch(msg, codec)
+                    )
+                    if msg.get("t") in PARKABLE_TYPES:
+                        self._dispatch_tasks.add(task)
+                        task.add_done_callback(self._dispatch_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -206,6 +226,10 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        current = asyncio.current_task()
+        for t in list(self._dispatch_tasks):
+            if t is not current:  # _close may run inside a dispatch task
+                t.cancel()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
