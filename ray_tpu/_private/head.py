@@ -1187,12 +1187,15 @@ class Head:
         finally:
             self._reconstructing.pop(oid, None)
             if not fut.done():
-                # this task was CANCELLED mid-reconstruction (its consumer's
-                # connection died); concurrent waiters on the shared future
-                # must not hang forever — they see the cancellation and
-                # their own clients can retry
-                fut.cancel()
-            elif fut.exception() is not None:
+                # this task died mid-reconstruction (e.g. head shutdown);
+                # concurrent waiters on the shared future must not hang —
+                # set a real exception, NOT cancel(): CancelledError would
+                # escape the waiters' `except Exception` handlers and
+                # strand their clients without a reply
+                from ..exceptions import ObjectLostError
+
+                fut.set_exception(ObjectLostError(oid))
+            if fut.done() and fut.exception() is not None:
                 # the future may never be awaited by anyone else
                 fut.exception()  # mark retrieved
 
@@ -2027,14 +2030,32 @@ class Head:
         if n is not None:
             _release(n.available, resources)
 
+    @staticmethod
+    def _demand_sig(rec: TaskRecord):
+        strategy = rec.spec.get("scheduling_strategy")
+        return (
+            tuple(sorted(rec.resources.items())),
+            strategy if isinstance(strategy, str) else repr(strategy),
+        )
+
     def _pump(self):
         if self._shutdown:
             return
         still_pending = collections.deque()
+        # demand signatures that already failed THIS pass: with thousands
+        # of queued same-shape tasks, one placement miss proves the rest
+        # can't place either — without this the pump is O(pending x nodes)
+        # per call and the head melts at 10k+ queued tasks
+        blocked: Set[Any] = set()
         while self.pending_queue:
             rec = self.pending_queue.popleft()
+            sig = self._demand_sig(rec)
+            if sig in blocked:
+                still_pending.append(rec)
+                continue
             nid = self._select_node(rec.resources, rec.spec.get("scheduling_strategy"))
             if nid is None:
+                blocked.add(sig)
                 still_pending.append(rec)
                 continue
             rec.node_id = nid

@@ -33,9 +33,10 @@ PROTOCOL_VERSION = 2
 # Handler types that may PARK indefinitely waiting for cluster events and
 # only read state — safe (and necessary) to cancel when their connection
 # dies. Everything else runs to completion even if the peer is gone.
+# reconstruct_objects is deliberately NOT here: it pins deps and mutates
+# task records across awaits, so cancelling it mid-flight would leak pins.
 PARKABLE_TYPES = frozenset(
-    {"poll_channel", "get_objects", "wait_objects", "pg_ready",
-     "reconstruct_objects", "xget_objects"}
+    {"poll_channel", "get_objects", "wait_objects", "pg_ready", "xget_objects"}
 )
 
 

@@ -322,6 +322,9 @@ class Worker:
         self.conn: Optional[protocol.Connection] = None
         self.node = None  # driver-only: the Node supervisor
         self._fn_exported: Dict[str, bool] = {}
+        import weakref
+
+        self._export_keys: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self.current_actor = None
         self.current_actor_id: Optional[str] = None
         self.current_task_id: Optional[str] = None
@@ -383,6 +386,7 @@ class Worker:
     def connect_driver(self, node, namespace: str = ""):
         self.mode = MODE_DRIVER
         self._fn_exported.clear()
+        self._export_keys.clear()
         if self._shm is not None:
             try:
                 self._shm.disconnect()
@@ -411,6 +415,7 @@ class Worker:
 
         self.mode = MODE_DRIVER
         self._fn_exported.clear()
+        self._export_keys.clear()
         if self._shm is not None:
             try:
                 self._shm.disconnect()
@@ -747,12 +752,28 @@ class Worker:
     # ------------------------------------------------------------------
 
     def _export_callable(self, obj, ns: str) -> str:
+        # identity memo: re-pickling the same function on EVERY submit just
+        # to recompute its content hash dominates the submit hot path. A
+        # function's captured globals/closures therefore FREEZE at first
+        # export — the reference has the same semantics (function_manager
+        # exports once per function object and workers cache by hash).
+        # Keyed per (object, ns) so 'fn' and 'cls' namespaces can't alias.
+        try:
+            memo = self._export_keys.get(obj)
+        except TypeError:  # not weakref-able
+            memo = None
+        if memo is not None and ns in memo:
+            return memo[ns]
         blob = cloudpickle.dumps(obj)
         key = hashlib.sha1(blob).hexdigest()
         with self._lock:
             if key not in self._fn_exported:
                 self.request({"t": "kv_put", "ns": ns, "key": key, "value": blob, "overwrite": False})
                 self._fn_exported[key] = True
+        try:
+            self._export_keys.setdefault(obj, {})[ns] = key
+        except TypeError:
+            pass
         return key
 
     def _prepare_args(self, args: tuple, kwargs: dict):
