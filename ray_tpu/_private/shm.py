@@ -83,6 +83,59 @@ class ShmBufferRef:
     node: str = ""
 
 
+_COPY_POOL = None
+_COPY_POOL_LOCK = threading.Lock()
+_PARALLEL_COPY_MIN = 32 << 20  # below this, thread fan-out costs more than it saves
+
+
+def _copy_chunk(ptr: int, data: memoryview, off: int, n: int) -> None:
+    chunk = data[off : off + n]
+    try:
+        # zero-copy source view when the buffer is writable & contiguous
+        src: object = (ctypes.c_char * n).from_buffer(chunk)
+        ctypes.memmove(ptr + off, src, n)
+        del src
+    except (TypeError, BufferError):
+        # read-only source (e.g. np.frombuffer views): numpy copies
+        # straight into the mapping — no intermediate bytes object
+        import numpy as np
+
+        dst = np.ctypeslib.as_array((ctypes.c_ubyte * n).from_address(ptr + off))
+        np.copyto(dst, np.frombuffer(chunk, dtype=np.uint8))
+
+
+def _copy_into(ptr: int, data: memoryview, size: int) -> None:
+    """Copy into the shm mapping, fanning large copies across threads —
+    memmove/numpy copies release the GIL, so on multicore hosts the put
+    path runs at aggregate memory bandwidth instead of one core's
+    (reference: plasma clients get the same effect from parallel client
+    processes writing disjoint objects)."""
+    if data.itemsize != 1 or data.ndim != 1:
+        # chunk offsets are BYTE offsets: flatten to a byte view first or
+        # element-indexed slicing would copy the wrong regions
+        data = data.cast("B")
+    workers = min(8, os.cpu_count() or 1)
+    if size < _PARALLEL_COPY_MIN or workers < 2:
+        _copy_chunk(ptr, data, 0, size)
+        return
+    global _COPY_POOL
+    with _COPY_POOL_LOCK:
+        if _COPY_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _COPY_POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="shm-copy"
+            )
+    per = -(-size // workers)
+    per += (-per) % (1 << 20)  # 1MB-align chunk boundaries
+    futures = [
+        _COPY_POOL.submit(_copy_chunk, ptr, data, off, min(per, size - off))
+        for off in range(0, size, per)
+    ]
+    for f in futures:
+        f.result()
+
+
 def _release_mapping(lib, handle, name_bytes, ptr):
     try:
         lib.shm_store_release(handle, name_bytes, ptr)
@@ -174,20 +227,7 @@ class ShmClient:
                     )
             if not ptr:
                 return None
-        try:
-            # zero-copy source view when the buffer is writable & contiguous
-            src: object = (ctypes.c_char * size).from_buffer(data)
-            ctypes.memmove(ptr, src, size)
-            del src
-        except (TypeError, BufferError):
-            # read-only source (e.g. np.frombuffer views): numpy copies
-            # straight into the mapping — no intermediate bytes object
-            import numpy as np
-
-            dst = np.ctypeslib.as_array(
-                (ctypes.c_ubyte * size).from_address(ptr)
-            )
-            np.copyto(dst, np.frombuffer(data, dtype=np.uint8))
+        _copy_into(ptr, data, size)
         self.lib.shm_store_seal(self.handle, name.encode())
         self.lib.shm_store_release(self.handle, name.encode(), ptr)
         return ShmBufferRef(name=name, size=size)
