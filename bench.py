@@ -125,5 +125,37 @@ def main():
     )
 
 
+def _supervise() -> int:
+    """Run the real bench in a watched child. When the TPU tunnel is down,
+    the site hook's plugin registration blocks `import jax` forever — the
+    supervisor contains that hang and swaps in a CPU fallback (marked in
+    the JSON) instead of eating the whole driver timeout. Healthy runs pay
+    nothing extra: the child does all the work exactly once."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, RAY_TPU_BENCH_CHILD="1")
+    try:
+        # healthy TPU runs finish in ~90s (compile included); 240s of
+        # silence means the import is wedged on a dead tunnel
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env, timeout=240
+        ).returncode
+    except subprocess.TimeoutExpired:
+        pass
+    print("[bench] TPU backend unreachable (child hung); CPU fallback",
+          file=sys.stderr)
+    env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
+    env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+    return subprocess.run(
+        [sys.executable, "-S", os.path.abspath(__file__)], env=env, timeout=600
+    ).returncode
+
+
 if __name__ == "__main__":
-    main()
+    import os
+
+    if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.exit(_supervise())

@@ -88,6 +88,16 @@ _COPY_POOL_LOCK = threading.Lock()
 _PARALLEL_COPY_MIN = 32 << 20  # below this, thread fan-out costs more than it saves
 
 
+def _reset_copy_pool_after_fork():
+    """A forked child inherits the pool object but NOT its threads;
+    submitting to it would queue work nobody drains (silent hang)."""
+    global _COPY_POOL
+    _COPY_POOL = None
+
+
+os.register_at_fork(after_in_child=_reset_copy_pool_after_fork)
+
+
 def _copy_chunk(ptr: int, data: memoryview, off: int, n: int) -> None:
     chunk = data[off : off + n]
     try:
@@ -132,8 +142,19 @@ def _copy_into(ptr: int, data: memoryview, size: int) -> None:
         _COPY_POOL.submit(_copy_chunk, ptr, data, off, min(per, size - off))
         for off in range(0, size, per)
     ]
-    for f in futures:
-        f.result()
+    try:
+        for f in futures:
+            f.result()
+    except BaseException:
+        # one chunk failed: the caller will abandon the mapping, so NO
+        # thread may still be writing into it (use-after-free) — cancel
+        # what hasn't started and wait out what has
+        from concurrent.futures import wait as _fwait
+
+        for f in futures:
+            f.cancel()
+        _fwait(futures)
+        raise
 
 
 def _release_mapping(lib, handle, name_bytes, ptr):

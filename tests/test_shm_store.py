@@ -153,7 +153,8 @@ def test_parallel_copy_into_correctness():
 
     from ray_tpu._private import shm
 
-    size = 40 << 20
+    # +3: the final chunk is short AND unaligned, exercising the tail clamp
+    size = (40 << 20) + 3
     src_arr = np.random.default_rng(0).integers(0, 256, size, dtype=np.uint8)
     dst = ctypes.create_string_buffer(size)
     ptr = ctypes.addressof(dst)
@@ -164,7 +165,8 @@ def test_parallel_copy_into_correctness():
         shm._copy_into(ptr, memoryview(src_arr.tobytes()), size)  # read-only
         assert bytes(dst.raw) == src_arr.tobytes()
         # itemsize > 1: offsets are BYTE offsets; view must be cast first
-        src16 = np.arange(size // 2, dtype=np.int16)
+        even = size - (size % 2)
+        src16 = np.arange(even // 2, dtype=np.int16)
         ctypes.memset(ptr, 0, size)
-        shm._copy_into(ptr, memoryview(src16), size)
-        assert bytes(dst.raw) == src16.tobytes()
+        shm._copy_into(ptr, memoryview(src16), even)
+        assert bytes(dst.raw[:even]) == src16.tobytes()
