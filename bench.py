@@ -135,18 +135,24 @@ def _supervise() -> int:
     import subprocess
 
     env = dict(os.environ, RAY_TPU_BENCH_CHILD="1")
+    # healthy TPU runs finish in ~90s (compile included); prolonged silence
+    # means the import is wedged on a dead tunnel. Overridable for hosts
+    # with cold compile caches (a too-small value silently swaps in the
+    # CPU-fallback number, so err generous).
+    tpu_timeout = float(os.environ.get("RAY_TPU_BENCH_TPU_TIMEOUT_S", "300"))
     try:
-        # healthy TPU runs finish in ~90s (compile included); 240s of
-        # silence means the import is wedged on a dead tunnel
         return subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env, timeout=240
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=tpu_timeout,
         ).returncode
     except subprocess.TimeoutExpired:
         pass
     print("[bench] TPU backend unreachable (child hung); CPU fallback",
           file=sys.stderr)
     env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
-    env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+    from ray_tpu._private.spawn import child_pythonpath
+
+    env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
     return subprocess.run(
         [sys.executable, "-S", os.path.abspath(__file__)], env=env, timeout=600
     ).returncode

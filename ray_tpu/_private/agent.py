@@ -237,11 +237,12 @@ class Agent:
                 env["JAX_PLATFORMS"] = "cpu"
             argv.insert(1, "-S")
         # workers run -S: carry this agent's sys.path (plus staged dirs first)
-        parts = list(extra_paths)
-        if "PYTHONPATH" in user_env_vars:
-            parts.append(env["PYTHONPATH"])
-        parts.extend(p for p in sys.path if p)
-        env["PYTHONPATH"] = os.pathsep.join(parts)
+        from .spawn import child_pythonpath
+
+        env["PYTHONPATH"] = child_pythonpath(
+            extra_paths,
+            inherited=env["PYTHONPATH"] if "PYTHONPATH" in user_env_vars else None,
+        )
         if cfg.log_to_driver:
             # per-worker log file; _log_forward_loop tails it and sends
             # increments to the head, which republishes to drivers

@@ -1838,9 +1838,9 @@ class Head:
             env[k] = str(v)
         # the job runs a fresh interpreter: the cluster's code (this package)
         # must stay importable, MERGED with any user-supplied PYTHONPATH
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in sys.path if p] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-        )
+        from .spawn import child_pythonpath
+
+        env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
         cwd = os.getcwd()
         loop = asyncio.get_running_loop()
         if runtime_env.get("working_dir"):
@@ -2244,11 +2244,12 @@ class Head:
             # workers run -S, so PYTHONPATH must carry the full driver
             # sys.path (site-packages included), with staged dirs first and
             # any user-specified PYTHONPATH in between
-            parts = list(extra_paths)
-            if "PYTHONPATH" in user_env_vars:
-                parts.append(env["PYTHONPATH"])
-            parts.extend(p for p in sys.path if p)
-            env["PYTHONPATH"] = os.pathsep.join(parts)
+            from .spawn import child_pythonpath
+
+            env["PYTHONPATH"] = child_pythonpath(
+                extra_paths,
+                inherited=env["PYTHONPATH"] if "PYTHONPATH" in user_env_vars else None,
+            )
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         log_file = None
         if cfg.log_to_driver:
@@ -2272,7 +2273,9 @@ class Head:
             if "JAX_PLATFORMS" not in user_env_vars:
                 env["JAX_PLATFORMS"] = "cpu"
             if "PYTHONPATH" not in user_env_vars and not extra_paths:
-                env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+                from .spawn import child_pythonpath
+
+                env["PYTHONPATH"] = child_pythonpath()
             argv.insert(1, "-S")
         if log_file is not None:
             env["PYTHONUNBUFFERED"] = "1"  # prints reach the tail promptly

@@ -90,9 +90,12 @@ _PARALLEL_COPY_MIN = 32 << 20  # below this, thread fan-out costs more than it s
 
 def _reset_copy_pool_after_fork():
     """A forked child inherits the pool object but NOT its threads;
-    submitting to it would queue work nobody drains (silent hang)."""
-    global _COPY_POOL
+    submitting to it would queue work nobody drains (silent hang). The
+    lock is replaced too — a fork while another thread held it would
+    leave the child's copy permanently locked."""
+    global _COPY_POOL, _COPY_POOL_LOCK
     _COPY_POOL = None
+    _COPY_POOL_LOCK = threading.Lock()
 
 
 os.register_at_fork(after_in_child=_reset_copy_pool_after_fork)
@@ -248,7 +251,14 @@ class ShmClient:
                     )
             if not ptr:
                 return None
-        _copy_into(ptr, data, size)
+        try:
+            _copy_into(ptr, data, size)
+        except BaseException:
+            # an unsealed object is never LRU-evictable: without cleanup a
+            # failed copy would leak its capacity forever
+            self.lib.shm_store_release(self.handle, name.encode(), ptr)
+            self.delete(name)
+            raise
         self.lib.shm_store_seal(self.handle, name.encode())
         self.lib.shm_store_release(self.handle, name.encode(), ptr)
         return ShmBufferRef(name=name, size=size)

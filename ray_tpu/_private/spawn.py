@@ -1,0 +1,26 @@
+"""Helpers for spawning `-S` child interpreters.
+
+Children skip `site` (hooks can be arbitrarily slow, pin the wrong jax
+backend, or hang outright on a dead TPU tunnel), so the parent's sys.path
+must ride down via PYTHONPATH. One implementation — the merge rules used
+to be hand-rolled at every spawn site and drifted.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def child_pythonpath(
+    prefix_paths: Sequence[str] = (), inherited: Optional[str] = None
+) -> str:
+    """PYTHONPATH for a `-S` child: explicit prefixes first (staged dirs,
+    repo roots), then any inherited/user PYTHONPATH, then this process's
+    full sys.path (site-packages included — the child skips `site`)."""
+    parts = [p for p in prefix_paths if p]
+    if inherited:
+        parts.append(inherited)
+    parts.extend(p for p in sys.path if p)
+    return os.pathsep.join(parts)

@@ -77,7 +77,9 @@ class Cluster:
             json.dumps(labels or {}),
         ]
         env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        from ._private.spawn import child_pythonpath
+
+        env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
         # agents never own the chips; workers they spawn default to cpu jax
         env.setdefault("JAX_PLATFORMS", "cpu")
         # own process group: kill_node(force) can take the whole node (agent
