@@ -1840,7 +1840,9 @@ class Head:
         # must stay importable, MERGED with any user-supplied PYTHONPATH
         from .spawn import child_pythonpath
 
-        env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
+        env["PYTHONPATH"] = child_pythonpath(
+            inherited=env.get("PYTHONPATH"), inherited_last=True
+        )
         cwd = os.getcwd()
         loop = asyncio.get_running_loop()
         if runtime_env.get("working_dir"):
@@ -2272,10 +2274,16 @@ class Head:
             # driver's sys.path instead.
             if "JAX_PLATFORMS" not in user_env_vars:
                 env["JAX_PLATFORMS"] = "cpu"
-            if "PYTHONPATH" not in user_env_vars and not extra_paths:
+            if not extra_paths:
+                # always hand down sys.path: with -S and only a user
+                # PYTHONPATH the child could not even import ray_tpu
                 from .spawn import child_pythonpath
 
-                env["PYTHONPATH"] = child_pythonpath()
+                env["PYTHONPATH"] = child_pythonpath(
+                    inherited=env["PYTHONPATH"]
+                    if "PYTHONPATH" in user_env_vars
+                    else None,
+                )
             argv.insert(1, "-S")
         if log_file is not None:
             env["PYTHONUNBUFFERED"] = "1"  # prints reach the tail promptly

@@ -14,13 +14,22 @@ from typing import Optional, Sequence
 
 
 def child_pythonpath(
-    prefix_paths: Sequence[str] = (), inherited: Optional[str] = None
+    prefix_paths: Sequence[str] = (),
+    inherited: Optional[str] = None,
+    inherited_last: bool = False,
 ) -> str:
     """PYTHONPATH for a `-S` child: explicit prefixes first (staged dirs,
     repo roots), then any inherited/user PYTHONPATH, then this process's
-    full sys.path (site-packages included — the child skips `site`)."""
+    full sys.path (site-packages included — the child skips `site`).
+
+    inherited_last=True puts the user's PYTHONPATH AFTER sys.path instead:
+    used where the cluster's own packages must win over user paths (job
+    drivers must never import a stale vendored ray_tpu over the cluster's).
+    """
     parts = [p for p in prefix_paths if p]
-    if inherited:
+    if inherited and not inherited_last:
         parts.append(inherited)
     parts.extend(p for p in sys.path if p)
+    if inherited and inherited_last:
+        parts.append(inherited)
     return os.pathsep.join(parts)

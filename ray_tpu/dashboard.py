@@ -133,6 +133,8 @@ class Dashboard:
             "cluster": {"t": "cluster_resources"},
             "timeline": {"t": "timeline"},
             "metrics": {"t": "get_metrics"},
+            "event_stats": {"t": "event_stats"},
+            "pgs": {"t": "pg_table"},
         }
         msg = handlers.get(kind)
         if msg is None:

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Union
 from .batching import batch  # noqa: F401
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
 from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
 

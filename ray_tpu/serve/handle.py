@@ -30,26 +30,56 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(
+        self,
+        deployment_name: str,
+        method_name: str = "__call__",
+        multiplexed_model_id: str = "",
+    ):
         self.deployment_name = deployment_name
         self.method_name = method_name
+        self.multiplexed_model_id = multiplexed_model_id
         self._replicas = []
         self._refreshed = 0.0
         self._inflight: deque = deque()  # (replica_index, ref)
         self._counts: dict = {}
         self._seen_version = -1  # last adopted ReplicaWatcher.version
+        # model affinity: id -> replica actor_id last used (keeps a loaded
+        # model's traffic on the replica that holds it — serve/multiplex.py)
+        self._model_affinity: dict = {}
 
     # -- pickling: drop live state; reconnect lazily on the other side
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.method_name))
+        return (
+            DeploymentHandle,
+            (self.deployment_name, self.method_name, self.multiplexed_model_id),
+        )
 
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, method_name or self.method_name)
+    def options(
+        self,
+        *,
+        method_name: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
+    ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            method_name or self.method_name,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self.multiplexed_model_id,
+        )
+        h._model_affinity = self._model_affinity  # shared map across options()
+        return h
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name)
+        # method handles keep the multiplexed model id and SHARE the
+        # affinity map — h.options(multiplexed_model_id=...).generate must
+        # route/identify exactly like h itself
+        h = DeploymentHandle(self.deployment_name, name, self.multiplexed_model_id)
+        h._model_affinity = self._model_affinity
+        return h
 
     # ------------------------------------------------------------- routing
 
@@ -105,6 +135,13 @@ class DeploymentHandle:
         n = len(self._replicas)
         if n == 1:
             return 0
+        model_id = self.multiplexed_model_id
+        if model_id:
+            # affinity first: keep a loaded model's traffic on its replica
+            want = self._model_affinity.get(model_id)
+            for i, r in enumerate(self._replicas):
+                if getattr(r, "_actor_id", None) == want:
+                    return i
         a, b = random.sample(range(n), 2)
         return a if self._counts.get(a, 0) <= self._counts.get(b, 0) else b
 
@@ -117,13 +154,18 @@ class DeploymentHandle:
             idx = self._pick_replica()
             try:
                 ref = self._replicas[idx].handle_request.remote(
-                    self.method_name, args, kwargs
+                    self.method_name, args, kwargs,
+                    model_id=self.multiplexed_model_id,
                 )
                 break
             except Exception:
                 if attempt == 1:
                     raise
                 self._refresh(force=True)  # replica set changed under us
+        if self.multiplexed_model_id:
+            self._model_affinity[self.multiplexed_model_id] = getattr(
+                self._replicas[idx], "_actor_id", None
+            )
         self._counts[idx] = self._counts.get(idx, 0) + 1
         self._inflight.append((idx, ref))
         return DeploymentResponse(ref)

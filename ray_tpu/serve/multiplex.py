@@ -1,0 +1,146 @@
+"""Model multiplexing: many models per replica with LRU loading.
+
+Reference parity: serve/_private/multiplex.py (_ModelMultiplexWrapper) and
+the public @serve.multiplexed / serve.get_multiplexed_model_id() API — one
+deployment serves MANY fine-tuned models; each replica lazily loads the
+models it is asked for and LRU-evicts beyond max_num_models_per_replica.
+On TPU serving this is the standard shape for LoRA fleets: one base-model
+replica per host, adapters multiplexed on top.
+
+Routing: handles keep model->replica affinity (a model already loaded on a
+replica keeps receiving that model's traffic) with power-of-two-choices as
+the fallback for unseen models — a handle-side simplification of the
+reference's router, which learns replica model sets from replica pushes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ray_tpu_serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id the caller routed with
+    (handle.options(multiplexed_model_id=...)); "" when not set."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    _current_model_id.set(model_id or "")
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models; loads are deduplicated so two
+    concurrent requests for the same cold model trigger one load."""
+
+    def __init__(self, max_models: int):
+        self.max_models = max_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._loading: dict = {}  # model_id -> threading.Event
+
+    def loaded_ids(self):
+        with self._lock:
+            return list(self._models)
+
+    def get(self, model_id: str, load: Callable[[], Any]):
+        while True:
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                ev = self._loading.get(model_id)
+                if ev is None:
+                    self._loading[model_id] = threading.Event()
+                    break
+            ev.wait()  # another thread is loading this model; then re-check
+        try:
+            model = load()
+            if inspect.iscoroutine(model):
+                model = asyncio.run(model)
+            with self._lock:
+                self._models[model_id] = model
+                while len(self._models) > self.max_models:
+                    self._models.popitem(last=False)  # LRU evict; GC tears down
+            return model
+        finally:
+            with self._lock:
+                ev = self._loading.pop(model_id, None)
+            if ev is not None:
+                ev.set()
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for the model-loading method of a deployment:
+
+        @serve.deployment
+        class LoRAServer:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id: str):
+                return load_adapter(model_id)
+
+            def __call__(self, prompt):
+                model = self.get_model(serve.get_multiplexed_model_id())
+                return model(prompt)
+
+    The wrapped loader takes the model id and returns the loaded model,
+    cached per replica with LRU eviction (async loaders supported).
+    """
+
+    def decorate(fn):
+        params = list(inspect.signature(fn).parameters)
+        takes_self = bool(params) and params[0] == "self"
+
+        if takes_self:
+
+            def wrapper(self, model_id: str):
+                # per-INSTANCE cache: two instances in one process must not
+                # cross-serve models built against each other's state
+                caches = self.__dict__.setdefault("_ray_tpu_mux_caches", {})
+                cache = caches.get(id(wrapper))
+                if cache is None:
+                    cache = caches[id(wrapper)] = _ModelCache(
+                        wrapper._multiplex_max_models
+                    )
+                return cache.get(model_id, lambda: fn(self, model_id))
+
+        else:
+
+            def wrapper(model_id: str):
+                return cache_of(wrapper).get(model_id, lambda: fn(model_id))
+
+        # only picklable config rides on the function — the cache itself
+        # (locks, loaded models) is built lazily PER PROCESS via cache_of,
+        # so deployment classes carrying this method still cloudpickle
+        wrapper._multiplex_max_models = max_num_models_per_replica
+        return wrapper
+
+    return decorate
+
+
+_caches: "weakref.WeakKeyDictionary" = None  # type: ignore[assignment]
+_caches_lock = threading.Lock()
+
+
+def cache_of(wrapper) -> _ModelCache:
+    """The per-process model cache behind a @multiplexed wrapper."""
+    import weakref
+
+    global _caches
+    with _caches_lock:
+        if _caches is None:
+            _caches = weakref.WeakKeyDictionary()
+        cache = _caches.get(wrapper)
+        if cache is None:
+            cache = _caches[wrapper] = _ModelCache(
+                getattr(wrapper, "_multiplex_max_models", 3)
+            )
+        return cache

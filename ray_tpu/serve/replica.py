@@ -28,10 +28,14 @@ class Replica:
     def ready(self):
         return True
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def handle_request(self, method_name: str, args, kwargs, model_id: str = ""):
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        if model_id:
+            from .multiplex import _set_model_id
+
+            _set_model_id(model_id)
         try:
             if self.is_function:
                 return self.callable(*args, **kwargs)
@@ -41,6 +45,10 @@ class Replica:
                 fn = getattr(self.callable, method_name)
             return fn(*args, **kwargs)
         finally:
+            if model_id:
+                from .multiplex import _set_model_id
+
+                _set_model_id("")
             with self._lock:
                 self._ongoing -= 1
 
