@@ -1838,10 +1838,13 @@ class Head:
             env[k] = str(v)
         # the job runs a fresh interpreter: the cluster's code (this package)
         # must stay importable, MERGED with any user-supplied PYTHONPATH
-        from .spawn import child_pythonpath
+        from .spawn import child_pythonpath, framework_root
 
+        # framework root FIRST (a stale vendored ray_tpu must not shadow
+        # the cluster's), then the user's PYTHONPATH with its normal
+        # precedence over site-packages, then this process's sys.path
         env["PYTHONPATH"] = child_pythonpath(
-            inherited=env.get("PYTHONPATH"), inherited_last=True
+            [framework_root()], inherited=env.get("PYTHONPATH")
         )
         cwd = os.getcwd()
         loop = asyncio.get_running_loop()

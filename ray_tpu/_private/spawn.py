@@ -14,22 +14,22 @@ from typing import Optional, Sequence
 
 
 def child_pythonpath(
-    prefix_paths: Sequence[str] = (),
-    inherited: Optional[str] = None,
-    inherited_last: bool = False,
+    prefix_paths: Sequence[str] = (), inherited: Optional[str] = None
 ) -> str:
     """PYTHONPATH for a `-S` child: explicit prefixes first (staged dirs,
-    repo roots), then any inherited/user PYTHONPATH, then this process's
-    full sys.path (site-packages included — the child skips `site`).
-
-    inherited_last=True puts the user's PYTHONPATH AFTER sys.path instead:
-    used where the cluster's own packages must win over user paths (job
-    drivers must never import a stale vendored ray_tpu over the cluster's).
-    """
+    the framework root), then any inherited/user PYTHONPATH (keeping its
+    normal precedence over site-packages), then this process's full
+    sys.path (site-packages included — the child skips `site`)."""
     parts = [p for p in prefix_paths if p]
-    if inherited and not inherited_last:
+    if inherited:
         parts.append(inherited)
     parts.extend(p for p in sys.path if p)
-    if inherited and inherited_last:
-        parts.append(inherited)
     return os.pathsep.join(parts)
+
+
+def framework_root() -> str:
+    """The directory containing the ray_tpu package — prefixed where the
+    cluster's OWN code must win over user paths (job drivers)."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))

@@ -19,6 +19,7 @@ import asyncio
 import contextvars
 import inspect
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable
 
@@ -121,23 +122,27 @@ def multiplexed(max_num_models_per_replica: int = 3):
         # (locks, loaded models) is built lazily PER PROCESS via cache_of,
         # so deployment classes carrying this method still cloudpickle
         wrapper._multiplex_max_models = max_num_models_per_replica
+        wrapper._multiplex_takes_self = takes_self
         return wrapper
 
     return decorate
 
 
-_caches: "weakref.WeakKeyDictionary" = None  # type: ignore[assignment]
+_caches = weakref.WeakKeyDictionary()
 _caches_lock = threading.Lock()
 
 
 def cache_of(wrapper) -> _ModelCache:
-    """The per-process model cache behind a @multiplexed wrapper."""
-    import weakref
-
-    global _caches
+    """The per-process model cache behind a FUNCTION-style @multiplexed
+    wrapper. Method-style wrappers keep per-INSTANCE caches (on the
+    instance itself) — inspect those via instance._ray_tpu_mux_caches."""
+    if getattr(wrapper, "_multiplex_takes_self", False):
+        raise TypeError(
+            "cache_of() works on function-style @multiplexed wrappers; "
+            "method-style caches are per instance "
+            "(instance._ray_tpu_mux_caches)"
+        )
     with _caches_lock:
-        if _caches is None:
-            _caches = weakref.WeakKeyDictionary()
         cache = _caches.get(wrapper)
         if cache is None:
             cache = _caches[wrapper] = _ModelCache(

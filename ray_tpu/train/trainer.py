@@ -13,10 +13,13 @@ Differences, by TPU design:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 import ray_tpu
 from ray_tpu.util import placement_group, PlacementGroupSchedulingStrategy
@@ -110,14 +113,69 @@ class JaxTrainer:
         self._resume_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
+        """Run the training gang; on worker failure, restart the WHOLE gang
+        from the last reported checkpoint up to
+        run_config.failure_config.max_failures times (SURVEY §7.2: pjit
+        programs are SPMD gangs — all-or-nothing restart from checkpoint is
+        the tractable elastic-training v1; reference analogue: Tune
+        restarting a trial from its checkpoint under FailureConfig)."""
+        fc = self.run_config.failure_config
+        resume = self._resume_checkpoint
+        history: List[Dict[str, Any]] = []
+        failures = 0
+        while True:
+            try:
+                result = self._fit_attempt(resume)
+            except Exception as e:  # setup-phase failure (spawn/pg/ready)
+                result = Result(error=e)
+            # keep the full metric history across restarts
+            history.extend(result.metrics_history)
+            result.metrics_history = list(history)
+            if result.error is None or failures >= fc.max_failures:
+                return result
+            failures += 1
+            resume = result.checkpoint if result.checkpoint is not None else resume
+            logger.warning(
+                "training gang failed (%r); restart %d/%d from %s",
+                result.error, failures, fc.max_failures,
+                "last checkpoint" if resume is not None else "scratch",
+            )
+
+    def _fit_attempt(self, resume_checkpoint) -> Result:
+        """One gang attempt. Setup failures raise (fit() settles them into
+        a Result); workers and the placement group are ALWAYS torn down —
+        a leaked half-built gang would starve the restart attempt."""
+        pg_box: List[Any] = []
+        workers: List[Any] = []
+        try:
+            return self._fit_attempt_inner(resume_checkpoint, pg_box.append, workers)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            if pg_box:
+                from ray_tpu.util import remove_placement_group
+
+                try:
+                    remove_placement_group(pg_box[0])
+                except Exception:
+                    pass
+
+    def _fit_attempt_inner(self, resume_checkpoint, set_pg, workers) -> Result:
         sc = self.scaling_config
         n = sc.num_workers
         res = sc.worker_resources()
-        pg = None
         strategy = None
         if n > 1:
             pg = placement_group([dict(res) for _ in range(n)], strategy=sc.placement_strategy)
-            pg.wait(120)
+            set_pg(pg)
+            if not pg.wait(120):
+                raise RuntimeError(
+                    f"placement group for {n} training workers not placeable "
+                    f"within 120s (bundles: {res})"
+                )
             strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
 
         coordinator = None
@@ -144,10 +202,12 @@ class JaxTrainer:
         if sc.env_vars:
             opts["runtime_env"] = {"env_vars": dict(sc.env_vars)}
 
-        workers = [
+        workers.extend(
             WorkerCls.options(**opts).remote(rank, n, coordinator) for rank in range(n)
-        ]
-        ray_tpu.get([w.ready.remote() for w in workers])
+        )
+        # timeout: unschedulable/crashing workers must raise into the
+        # restart loop, not block setup forever
+        ray_tpu.get([w.ready.remote() for w in workers], timeout=180)
 
         # shard datasets across workers (streaming split)
         def shard_for(rank):
@@ -160,44 +220,44 @@ class JaxTrainer:
             return out
 
         run_refs = [
-            w.run.remote(self._train_fn, self._config, shard_for(i), self._resume_checkpoint)
+            w.run.remote(self._train_fn, self._config, shard_for(i), resume_checkpoint)
             for i, w in enumerate(workers)
         ]
 
         result = Result()
         done = False
-        while not done:
-            reports, rank0_done = ray_tpu.get(workers[0].next_results.remote())
+        try:
+            while not done:
+                reports, rank0_done = ray_tpu.get(workers[0].next_results.remote())
+                for rep in reports:
+                    result.metrics_history.append(rep["metrics"])
+                    result.metrics = rep["metrics"]
+                    if rep.get("checkpoint") is not None:
+                        result.checkpoint = rep["checkpoint"]
+                if rank0_done:
+                    done = True
+                else:
+                    ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs), timeout=0.2)
+                    if len(ready) == len(run_refs):
+                        done = True
+        except Exception as e:  # a worker died mid-run: settle the error so
+            result.error = e  # fit()'s gang-restart loop can act on it
+        # surface worker errors (rank 0 first)
+        if result.error is None:
+            try:
+                ray_tpu.get(run_refs)
+            except Exception as e:  # noqa: BLE001
+                result.error = e
+        # final drain (best-effort: the pump actor may be gone)
+        try:
+            reports, _ = ray_tpu.get(workers[0].next_results.remote())
             for rep in reports:
                 result.metrics_history.append(rep["metrics"])
                 result.metrics = rep["metrics"]
                 if rep.get("checkpoint") is not None:
                     result.checkpoint = rep["checkpoint"]
-            if rank0_done:
-                done = True
-            else:
-                ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs), timeout=0.2)
-                if len(ready) == len(run_refs):
-                    done = True
-        # surface worker errors (rank 0 first)
-        try:
-            ray_tpu.get(run_refs)
-        except Exception as e:  # noqa: BLE001
-            result.error = e
-        # final drain
-        reports, _ = ray_tpu.get(workers[0].next_results.remote())
-        for rep in reports:
-            result.metrics_history.append(rep["metrics"])
-            result.metrics = rep["metrics"]
-            if rep.get("checkpoint") is not None:
-                result.checkpoint = rep["checkpoint"]
-        for w in workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
-        if pg is not None:
-            from ray_tpu.util import remove_placement_group
-
-            remove_placement_group(pg)
+        except Exception:
+            pass
+        # worker + placement-group teardown happens in _fit_attempt's
+        # finally (covers setup failures too)
         return result
