@@ -119,6 +119,51 @@ def merge_sorted(key: Key, descending: bool, *parts):
     return from_columns(_take(cols, order))
 
 
+def random_partition(block, k: int, seed):
+    """Map stage of the distributed random_shuffle: scatter this block's
+    rows into k partitions uniformly at random (one return per partition —
+    push-based shuffle shape, _internal/push_based_shuffle.py).
+
+    Row-list blocks (heterogeneous dicts, ragged values) scatter as LISTS —
+    forcing them through to_columns would crash or mangle them; columnar
+    blocks scatter as schema-preserving column dicts."""
+    rng = np.random.default_rng(seed)
+    if isinstance(block, (list, tuple)):
+        rows = list(block)
+        assignment = rng.integers(0, k, size=len(rows))
+        parts: list = [
+            [r for r, a in zip(rows, assignment) if a == i]
+            for i in builtins.range(k)
+        ]
+    else:
+        cols = to_columns(block)
+        n = len(next(iter(cols.values()))) if cols else 0
+        assignment = rng.integers(0, k, size=n)
+        parts = [_take(cols, assignment == i) for i in builtins.range(k)]
+    return parts if k > 1 else parts[0]
+
+
+def shuffle_merge(seed, *parts):
+    """Reduce stage: concat this partition's pieces from every map task and
+    permute locally — global uniformity comes from the random scatter.
+    Empty partitions keep their SCHEMA (zero-row columns) so downstream
+    block concat never sees a key-less block."""
+    rng = np.random.default_rng(seed)
+    if any(isinstance(p, list) for p in parts):
+        rows = [r for p in parts if isinstance(p, list) for r in p]
+        order = rng.permutation(len(rows))
+        return [rows[i] for i in order]
+    merged = _concat(list(parts))
+    if not merged:
+        for p in parts:  # schema-preserving empty block
+            if p:
+                return from_columns({key: v[:0] for key, v in p.items()})
+        return {}
+    n = len(next(iter(merged.values())))
+    order = rng.permutation(n)
+    return from_columns(_take(merged, order))
+
+
 def hash_partition(block, key: Key, k: int):
     cols = to_columns(block)
     vals = _key_values(cols, key)

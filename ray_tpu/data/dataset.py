@@ -289,26 +289,57 @@ class Dataset:
         return Dataset(slices)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle (materializes; push-based shuffle is the planned
-        scale path, reference _internal/push_based_shuffle.py). Preserves the
-        block format (dict-of-numpy stays dict-of-numpy)."""
-        blocks = self._compute_blocks()
+        """Global shuffle as a two-stage push-based exchange (reference:
+        _internal/push_based_shuffle.py): map tasks scatter each block's
+        rows into K random partitions (num_returns=K), one merge task per
+        partition concats + locally permutes — the driver only holds refs,
+        so shuffle scale is bounded by the cluster, not driver memory.
+        Preserves dict-of-numpy block format."""
+        from . import _exchange
+
+        import ray_tpu
+
+        blocks, remote = self._exchange_tasks()
         if not blocks:
             return Dataset([])
-        merged = _block_concat(blocks) if len(blocks) > 1 else blocks[0]
-        n = _block_num_rows(merged)
-        if n == 0:
-            return Dataset([lambda: merged])
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n)
-        shuffled = _block_take(merged, order)
-        k = max(1, self.num_blocks())
-        per = (n + k - 1) // k
-        slices = [
-            _block_slice(shuffled, s, min(s + per, n))
-            for s in builtins.range(0, n, per)
+        k = len(blocks)
+        base = np.random.default_rng(seed).integers(0, 2**31) if seed is not None else None
+
+        def map_seed(i):
+            return None if base is None else base + i
+
+        def merge_seed(i):
+            return None if base is None else base + k + i
+
+        if not remote:
+            # local fallback runs the SAME two-stage algorithm with the
+            # same derived seeds, so a fixed seed produces identical output
+            # whether or not a cluster is attached
+            part_lists = [
+                _exchange.random_partition(b, k, map_seed(i)) if k > 1
+                else [_exchange.random_partition(b, k, map_seed(i))]
+                for i, b in enumerate(blocks)
+            ]
+            merged = [
+                _exchange.shuffle_merge(merge_seed(i), *[pl[i] for pl in part_lists])
+                for i in builtins.range(k)
+            ]
+            return Dataset([lambda b=b: b for b in merged])
+        if k == 1:
+            blocks = ray_tpu.get(blocks)
+            part = _exchange.random_partition(blocks[0], 1, map_seed(0))
+            merged0 = _exchange.shuffle_merge(merge_seed(0), part)
+            return Dataset([lambda b=merged0: b])
+        part_t = ray_tpu.remote(_exchange.random_partition).options(num_returns=k)
+        merge_t = ray_tpu.remote(_exchange.shuffle_merge)
+        parts = [part_t.remote(b, k, map_seed(i)) for i, b in enumerate(blocks)]
+        outs = [
+            merge_t.remote(
+                merge_seed(i), *[parts[b][i] for b in builtins.range(len(parts))]
+            )
+            for i in builtins.range(k)
         ]
-        return Dataset([lambda b=b: b for b in slices])
+        return Dataset([lambda r=r: ray_tpu.get(r) for r in outs])
 
     # ---- exchanges: sort / groupby (two-stage shuffles) ----
 

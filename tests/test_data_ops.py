@@ -150,3 +150,77 @@ class TestWrites:
         batches = list(ds.iter_torch_batches(batch_size=4))
         assert [b["x"].shape[0] for b in batches] == [4, 4, 2]
         assert isinstance(batches[0]["x"], torch.Tensor)
+
+
+def test_random_shuffle_push_based(ray_start_regular):
+    """random_shuffle runs as a two-stage exchange over tasks: same
+    multiset of rows, different order, deterministic under a seed."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000, override_num_blocks=8)
+    out = ds.random_shuffle(seed=7)
+    assert out.num_blocks() == 8
+    rows = [r["id"] for r in out.take_all()]
+    assert sorted(rows) == list(range(1000))
+    assert rows != list(range(1000))  # actually shuffled
+    # deterministic under the same seed
+    rows2 = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+    assert rows == rows2
+    # different seed -> different order (overwhelmingly)
+    rows3 = [r["id"] for r in ds.random_shuffle(seed=8).take_all()]
+    assert rows != rows3
+
+
+def test_random_shuffle_scalar_rows(ray_start_regular):
+    import ray_tpu.data as rd
+
+    out = rd.from_items(list(range(100)), override_num_blocks=4).random_shuffle(seed=1)
+    assert sorted(out.take_all()) == list(range(100))
+
+
+def test_random_shuffle_edge_cases(ray_start_regular):
+    import ray_tpu.data as rd
+
+    # more blocks than rows: empty merge partitions keep their schema
+    out = rd.range(6, override_num_blocks=6).random_shuffle(seed=1)
+    assert sorted(r["id"] for r in out.take_all()) == list(range(6))
+    assert list(out.iter_batches(batch_size=4))  # downstream concat works
+    # heterogeneous / ragged row lists survive (no columnization)
+    rows = rd.from_items([{"a": 1}, {"b": 2}]).random_shuffle(seed=0).take_all()
+    assert sorted(rows, key=str) == [{"a": 1}, {"b": 2}]
+    ragged = rd.from_items([[1, 2], [3]]).random_shuffle(seed=0).take_all()
+    assert sorted(ragged, key=len) == [[3], [1, 2]]
+    # train_test_split downstream of shuffle
+    tr, te = rd.range(10, override_num_blocks=8).train_test_split(0.3, shuffle=True, seed=0)
+    assert tr.count() + te.count() == 10
+
+
+def test_random_shuffle_seed_stable_local_vs_cluster(tmp_path):
+    """A fixed seed must give identical output with and without a cluster."""
+    import subprocess
+    import sys
+
+    code = """
+import sys
+sys.path.insert(0, {repo!r})
+import ray_tpu
+import ray_tpu.data as rd
+if {use_cluster}:
+    ray_tpu.init(num_cpus=2)
+rows = [r["id"] for r in rd.range(200, override_num_blocks=4).random_shuffle(seed=11).take_all()]
+print(",".join(map(str, rows)))
+if {use_cluster}:
+    ray_tpu.shutdown()
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for use_cluster in (False, True):
+        p = subprocess.run(
+            [sys.executable, "-c", code.format(repo=repo, use_cluster=use_cluster)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert p.returncode == 0, p.stderr
+        outs.append(p.stdout.strip().splitlines()[-1])
+    assert outs[0] == outs[1]
