@@ -66,6 +66,18 @@ def _take(cols: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
     return {k: v[idx] for k, v in cols.items()}
 
 
+def cols_to_rows(cols: Dict[str, np.ndarray]) -> list:
+    """Column dict -> row list ('__value__' marker unwraps to raw values;
+    used when an exchange must fall back to row-list form)."""
+    if not cols:
+        return []
+    if set(cols) == {"__value__"}:
+        return list(cols["__value__"])
+    keys = list(cols)
+    n = len(cols[keys[0]])
+    return [{k: cols[k][i] for k in keys} for i in builtins.range(n)]
+
+
 def _concat(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     parts = [p for p in parts if p and len(next(iter(p.values())))]
     if not parts:
@@ -150,7 +162,15 @@ def shuffle_merge(seed, *parts):
     block concat never sees a key-less block."""
     rng = np.random.default_rng(seed)
     if any(isinstance(p, list) for p in parts):
-        rows = [r for p in parts if isinstance(p, list) for r in p]
+        # mixed-format partitions (e.g. a union of columnar and row-list
+        # datasets): fall back to row form — dropping the columnar parts
+        # would silently lose data
+        rows = []
+        for p in parts:
+            if isinstance(p, list):
+                rows.extend(p)
+            elif p:
+                rows.extend(cols_to_rows(p))
         order = rng.permutation(len(rows))
         return [rows[i] for i in order]
     merged = _concat(list(parts))
@@ -162,6 +182,62 @@ def shuffle_merge(seed, *parts):
     n = len(next(iter(merged.values())))
     order = rng.permutation(n)
     return from_columns(_take(merged, order))
+
+
+def block_rows(block) -> int:
+    """Row count (map stage of the exact repartition exchange)."""
+    if isinstance(block, (list, tuple)):
+        return len(block)
+    cols = to_columns(block)
+    return len(next(iter(cols.values()))) if cols else 0
+
+
+def slice_partition(block, start: int, boundaries):
+    """Map stage of repartition: this block covers global rows
+    [start, start+n); emit its intersection with each output range
+    [boundaries[j], boundaries[j+1]) — exact even splits without the
+    driver ever touching rows. Row-list blocks (heterogeneous/ragged
+    rows) slice as lists, like random_partition."""
+    if isinstance(block, (list, tuple)):
+        rows = list(block)
+        n = len(rows)
+        out: list = []
+        for j in builtins.range(len(boundaries) - 1):
+            lo = max(0, int(boundaries[j]) - start)
+            hi = min(n, int(boundaries[j + 1]) - start)
+            out.append(rows[lo:hi] if hi > lo else [])
+        return out if len(out) > 1 else out[0]
+    cols = to_columns(block)
+    n = len(next(iter(cols.values()))) if cols else 0
+    out = []
+    for j in builtins.range(len(boundaries) - 1):
+        lo = max(0, int(boundaries[j]) - start)
+        hi = min(n, int(boundaries[j + 1]) - start)
+        if hi <= lo:
+            out.append({k: v[:0] for k, v in cols.items()})
+        else:
+            out.append({k: v[lo:hi] for k, v in cols.items()})
+    return out if len(out) > 1 else out[0]
+
+
+def concat_parts(*parts):
+    """Reduce stage of repartition: order-preserving concat (row-list
+    parts — possibly mixed with columnar ones — merge in row form)."""
+    if any(isinstance(p, list) for p in parts):
+        rows: list = []
+        for p in parts:
+            if isinstance(p, list):
+                rows.extend(p)
+            elif p:
+                rows.extend(cols_to_rows(p))
+        return rows
+    merged = _concat(list(parts))
+    if not merged:
+        for p in parts:
+            if p:
+                return from_columns({k: v[:0] for k, v in p.items()})
+        return {}
+    return from_columns(merged)
 
 
 def hash_partition(block, key: Key, k: int):

@@ -273,20 +273,49 @@ class Dataset:
         return self._with_op(_Op("flat_map", fn))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        """Materializing repartition into equal-ish contiguous blocks."""
-        blocks = self._compute_blocks()
-        merged = _block_concat(blocks) if len(blocks) > 1 else blocks[0]
-        total = _block_num_rows(merged)
-        per = max(1, total // num_blocks)
-        slices = []
-        for i in builtins.range(num_blocks):
-            s = i * per
-            e = total if i == num_blocks - 1 else min((i + 1) * per, total)
-            if s >= total:
-                break
-            blk = _block_slice(merged, s, e)
-            slices.append(lambda b=blk: b)
-        return Dataset(slices)
+        """Exact even repartition as a two-stage exchange: count tasks
+        yield global offsets, map tasks emit each block's intersection
+        with every output range (num_returns=K), concat tasks assemble the
+        outputs — order preserved, driver holds only counts and refs
+        (reference: repartition over the exchange task scheduler)."""
+        from . import _exchange
+
+        import ray_tpu
+
+        blocks, remote = self._exchange_tasks()
+        if not blocks:
+            return Dataset([])
+        if not remote:
+            counts = [_exchange.block_rows(b) for b in blocks]
+        else:
+            rows_t = ray_tpu.remote(_exchange.block_rows)
+            counts = ray_tpu.get([rows_t.remote(b) for b in blocks])
+        total = sum(counts)
+        k = max(1, num_blocks)
+        boundaries = [round(j * total / k) for j in builtins.range(k + 1)]
+        starts = list(np.cumsum([0] + counts[:-1]))
+        if not remote:
+            part_lists = [
+                _exchange.slice_partition(b, int(s), boundaries) if k > 1
+                else [_exchange.slice_partition(b, int(s), boundaries)]
+                for b, s in zip(blocks, starts)
+            ]
+            merged = [
+                _exchange.concat_parts(*[pl[j] for pl in part_lists])
+                for j in builtins.range(k)
+            ]
+            return Dataset([lambda b=b: b for b in merged])
+        slice_t = ray_tpu.remote(_exchange.slice_partition).options(num_returns=k)
+        concat_t = ray_tpu.remote(_exchange.concat_parts)
+        parts = [slice_t.remote(b, int(s), boundaries) for b, s in zip(blocks, starts)]
+        if k == 1:
+            outs = [concat_t.remote(*parts)]
+        else:
+            outs = [
+                concat_t.remote(*[parts[b][j] for b in builtins.range(len(parts))])
+                for j in builtins.range(k)
+            ]
+        return Dataset([lambda r=r: ray_tpu.get(r) for r in outs])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Global shuffle as a two-stage push-based exchange (reference:
@@ -492,12 +521,25 @@ class Dataset:
         return Dataset([lambda b=b: b for b in taken])
 
     def union(self, *others: "Dataset") -> "Dataset":
+        """Lazy union: each input's op chain folds into its block fns, so
+        nothing materializes until the union is consumed."""
+        from ._plan import optimize
+
         datasets = [self, *others]
         block_fns = []
         for ds in datasets:
-            if ds._ops:
+            if any(op.compute == "actors" for op in ds._ops):
+                # actor-pool ops must run through the pool (callable-class
+                # state constructs once per worker) — folding them into
+                # plain block fns would rebuild the state per block
                 blocks = ds._compute_blocks()
-                block_fns.extend([lambda b=b: b for b in blocks])
+                block_fns.extend(lambda b=b: b for b in blocks)
+            elif ds._ops:
+                ops = optimize(ds._ops)
+                block_fns.extend(
+                    (lambda fn=fn, ops=ops: _apply_ops(fn(), list(ops)))
+                    for fn in ds._block_fns
+                )
             else:
                 block_fns.extend(ds._block_fns)
         return Dataset(block_fns)

@@ -224,3 +224,66 @@ if {use_cluster}:
         assert p.returncode == 0, p.stderr
         outs.append(p.stdout.strip().splitlines()[-1])
     assert outs[0] == outs[1]
+
+
+def test_repartition_exchange_exact(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000, override_num_blocks=7)
+    out = ds.repartition(4)
+    assert out.num_blocks() == 4
+    sizes = [len(list(b["id"])) for b in out._iter_computed_blocks()]
+    assert sum(sizes) == 1000
+    assert max(sizes) - min(sizes) <= 1  # exact even split
+    # order preserved
+    assert [r["id"] for r in out.take(5)] == [0, 1, 2, 3, 4]
+    # upward repartition too
+    up = ds.repartition(16)
+    assert up.num_blocks() == 16 and up.count() == 1000
+
+
+def test_union_is_lazy_and_correct(ray_start_regular):
+    import ray_tpu.data as rd
+
+    a = rd.range(10).map(lambda r: {"id": r["id"] * 2})
+    b = rd.from_items([{"id": 100 + i} for i in range(5)])
+    u = a.union(b)
+    assert u.num_blocks() == a.num_blocks() + b.num_blocks()
+    vals = sorted(r["id"] for r in u.take_all())
+    assert vals == sorted([i * 2 for i in range(10)] + [100 + i for i in range(5)])
+
+
+def test_mixed_format_shuffle_and_repartition(ray_start_regular):
+    """Unions of columnar and row-list datasets survive the exchanges."""
+    import ray_tpu.data as rd
+
+    mixed = rd.range(10, override_num_blocks=2).union(
+        rd.from_items([{"id": 100}, {"id": 101}], override_num_blocks=2)
+    )
+    rows = mixed.random_shuffle(seed=3).take_all()
+    assert sorted(int(r["id"]) for r in rows) == list(range(10)) + [100, 101]
+    rows = mixed.repartition(3).take_all()
+    assert sorted(int(r["id"]) for r in rows) == list(range(10)) + [100, 101]
+    # ragged / heterogeneous rows through repartition
+    ragged = rd.from_items([[1, 2], [3]], override_num_blocks=1).repartition(2)
+    assert sorted(ragged.take_all(), key=len) == [[3], [1, 2]]
+    het = rd.from_items([{"a": 1}, {"b": 2}], override_num_blocks=1).repartition(2)
+    assert sorted(het.take_all(), key=str) == [{"a": 1}, {"b": 2}]
+
+
+def test_union_preserves_actor_pool_contract(ray_start_regular):
+    """compute='actors' ops in a union still construct once per worker."""
+    import ray_tpu.data as rd
+
+    class Counter:
+        def __init__(self):
+            self.constructed = 1
+
+        def __call__(self, b):
+            return {"id": b["id"], "c": [self.constructed] * len(b["id"])}
+
+    ds = rd.range(40, override_num_blocks=4).map_batches(
+        Counter, compute="actors", num_actors=2
+    )
+    u = ds.union(rd.from_items([{"id": 999, "c": 0}]))
+    assert u.count() == 41
