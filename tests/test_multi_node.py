@@ -106,3 +106,18 @@ def test_remote_actor_restart_on_node_death(cluster):
             time.sleep(0.3)
     else:
         pytest.fail("actor did not restart on the surviving node")
+
+
+def test_cross_node_data_exchange(ray_start_cluster):
+    """Shuffle/repartition/sort run across REAL agent nodes: map and merge
+    tasks land on different hosts and dependencies pull cross-node through
+    the head (object_manager-style pull, collapsed)."""
+    import ray_tpu.data as rd
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ds = rd.range(100_000, override_num_blocks=6)
+    out = ds.random_shuffle(seed=5).repartition(4)
+    assert out.count() == 100_000
+    srt = ds.sort("id")
+    assert [r["id"] for r in srt.take(3)] == [0, 1, 2]
