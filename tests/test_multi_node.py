@@ -121,3 +121,56 @@ def test_cross_node_data_exchange(ray_start_cluster):
     assert out.count() == 100_000
     srt = ds.sort("id")
     assert [r["id"] for r in srt.take(3)] == [0, 1, 2]
+
+
+def test_remote_driver_attach_over_tcp(ray_start_cluster, tmp_path):
+    """Ray-Client parity: a SECOND driver in another process attaches to
+    the head over TCP (init(address="host:port")), runs tasks and actors,
+    and reads objects the first driver put."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    cluster = ray_start_cluster
+    addr = cluster.head_tcp_address
+    assert addr and ":" in addr
+
+    ref = ray_tpu.put({"from": "driver-1"})
+    global_worker.request(
+        {"t": "kv_put", "ns": "", "key": "shared_oid", "value": ref.id}
+    )
+
+    code = f"""
+import sys
+sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+from ray_tpu.object_ref import ObjectRef
+
+ray_tpu.init(address={addr!r})
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+assert ray_tpu.get(square.remote(7), timeout=60) == 49
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self): self.v = 0
+    def add(self, n): self.v += n; return self.v
+
+a = Acc.remote()
+assert ray_tpu.get(a.add.remote(5), timeout=60) == 5
+
+oid = global_worker.request({{"t": "kv_get", "ns": "", "key": "shared_oid"}})
+assert ray_tpu.get(ObjectRef(oid), timeout=60) == {{"from": "driver-1"}}
+print("REMOTE-DRIVER-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "REMOTE-DRIVER-OK" in proc.stdout
