@@ -184,10 +184,24 @@ def shuffle_merge(seed, *parts):
     return from_columns(_take(merged, order))
 
 
+def _as_arrow(block):
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            return block
+    except ImportError:
+        pass
+    return None
+
+
 def block_rows(block) -> int:
     """Row count (map stage of the exact repartition exchange)."""
     if isinstance(block, (list, tuple)):
         return len(block)
+    table = _as_arrow(block)
+    if table is not None:
+        return table.num_rows
     cols = to_columns(block)
     return len(next(iter(cols.values()))) if cols else 0
 
@@ -207,6 +221,17 @@ def slice_partition(block, start: int, boundaries):
             hi = min(n, int(boundaries[j + 1]) - start)
             out.append(rows[lo:hi] if hi > lo else [])
         return out if len(out) > 1 else out[0]
+    table = _as_arrow(block)
+    if table is not None:
+        # slice the Table zero-copy: normalizing through numpy would drop
+        # arrow types (nullable ints, timestamps) into object arrays
+        n = table.num_rows
+        out = []
+        for j in builtins.range(len(boundaries) - 1):
+            lo = max(0, int(boundaries[j]) - start)
+            hi = min(n, int(boundaries[j + 1]) - start)
+            out.append(table.slice(lo, max(0, hi - lo)))
+        return out if len(out) > 1 else out[0]
     cols = to_columns(block)
     n = len(next(iter(cols.values()))) if cols else 0
     out = []
@@ -222,7 +247,18 @@ def slice_partition(block, start: int, boundaries):
 
 def concat_parts(*parts):
     """Reduce stage of repartition: order-preserving concat (row-list
-    parts — possibly mixed with columnar ones — merge in row form)."""
+    parts — possibly mixed with columnar ones — merge in row form;
+    all-arrow parts stay arrow)."""
+    tables = [_as_arrow(p) for p in parts]
+    if parts and all(t is not None for t in tables):
+        import pyarrow as pa
+
+        return pa.concat_tables(tables)
+    if any(t is not None for t in tables):
+        # mixed arrow + other formats: normalize arrow down to columns
+        parts = tuple(
+            to_columns(p) if t is not None else p for p, t in zip(parts, tables)
+        )
     if any(isinstance(p, list) for p in parts):
         rows: list = []
         for p in parts:

@@ -272,27 +272,27 @@ class Dataset:
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
         return self._with_op(_Op("flat_map", fn))
 
-    def repartition(self, num_blocks: int) -> "Dataset":
-        """Exact even repartition as a two-stage exchange: count tasks
-        yield global offsets, map tasks emit each block's intersection
-        with every output range (num_returns=K), concat tasks assemble the
-        outputs — order preserved, driver holds only counts and refs
-        (reference: repartition over the exchange task scheduler)."""
+    def _slice_exchange(self, make_boundaries) -> List[Callable[[], Any]]:
+        """Shared scaffolding of the exact-slice exchanges (repartition,
+        train_test_split): count tasks yield global offsets,
+        `make_boundaries(total) -> [b0..bk]` picks the output ranges, map
+        tasks emit each block's intersection with every range
+        (num_returns=K), concat tasks assemble outputs — order preserved,
+        the driver holds only counts and refs. Returns K block thunks."""
         from . import _exchange
 
         import ray_tpu
 
         blocks, remote = self._exchange_tasks()
         if not blocks:
-            return Dataset([])
+            return []
         if not remote:
             counts = [_exchange.block_rows(b) for b in blocks]
         else:
             rows_t = ray_tpu.remote(_exchange.block_rows)
             counts = ray_tpu.get([rows_t.remote(b) for b in blocks])
-        total = sum(counts)
-        k = max(1, num_blocks)
-        boundaries = [round(j * total / k) for j in builtins.range(k + 1)]
+        boundaries = [int(b) for b in make_boundaries(sum(counts))]
+        k = len(boundaries) - 1
         starts = list(np.cumsum([0] + counts[:-1]))
         if not remote:
             part_lists = [
@@ -304,7 +304,7 @@ class Dataset:
                 _exchange.concat_parts(*[pl[j] for pl in part_lists])
                 for j in builtins.range(k)
             ]
-            return Dataset([lambda b=b: b for b in merged])
+            return [lambda b=b: b for b in merged]
         slice_t = ray_tpu.remote(_exchange.slice_partition).options(num_returns=k)
         concat_t = ray_tpu.remote(_exchange.concat_parts)
         parts = [slice_t.remote(b, int(s), boundaries) for b, s in zip(blocks, starts)]
@@ -315,7 +315,16 @@ class Dataset:
                 concat_t.remote(*[parts[b][j] for b in builtins.range(len(parts))])
                 for j in builtins.range(k)
             ]
-        return Dataset([lambda r=r: ray_tpu.get(r) for r in outs])
+        return [lambda r=r: ray_tpu.get(r) for r in outs]
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Exact even repartition as a two-stage exchange (reference:
+        repartition over the exchange task scheduler)."""
+        k = max(1, num_blocks)
+        fns = self._slice_exchange(
+            lambda total: [round(j * total / k) for j in builtins.range(k + 1)]
+        )
+        return Dataset(fns)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Global shuffle as a two-stage push-based exchange (reference:
@@ -563,16 +572,19 @@ class Dataset:
         return Dataset([lambda b=out: b])
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
-        """Returns (train, test) datasets (reference: dataset.py
-        train_test_split)."""
+        """Returns (train, test) datasets split at a global row boundary —
+        a two-output slice exchange over tasks, so nothing funnels through
+        the driver (reference: dataset.py train_test_split)."""
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        blocks = ds._compute_blocks()
-        merged = _block_concat(blocks) if len(blocks) > 1 else (blocks[0] if blocks else [])
-        n = _block_num_rows(merged)
-        cut = n - int(n * test_size) if isinstance(test_size, float) else n - test_size
-        train = _block_slice(merged, 0, cut)
-        test = _block_slice(merged, cut, n)
-        return Dataset([lambda b=train: b]), Dataset([lambda b=test: b])
+
+        def boundaries(n):
+            cut = n - int(n * test_size) if isinstance(test_size, float) else n - test_size
+            return [0, cut, n]
+
+        fns = ds._slice_exchange(boundaries)
+        if not fns:
+            return Dataset([]), Dataset([])
+        return Dataset([fns[0]]), Dataset([fns[1]])
 
     # ---- writes (reference: data/datasource do_write paths) ----
 

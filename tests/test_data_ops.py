@@ -287,3 +287,27 @@ def test_union_preserves_actor_pool_contract(ray_start_regular):
     )
     u = ds.union(rd.from_items([{"id": 999, "c": 0}]))
     assert u.count() == 41
+
+
+def test_split_preserves_arrow_tables(ray_start_regular, tmp_path):
+    """Arrow blocks survive repartition/train_test_split with their types
+    (nullable columns must not degrade to object-dtype numpy)."""
+    import pyarrow as pa
+
+    import ray_tpu.data as rd
+
+    tbl = pa.table({"x": pa.array([1, None, 3, 4, 5], type=pa.int64())})
+    path = str(tmp_path / "t.parquet")
+    import pyarrow.parquet as pq
+
+    pq.write_table(tbl, path)
+    ds = rd.read_parquet(path)
+    tr, te = ds.train_test_split(0.4)
+    blocks = list(tr._iter_computed_blocks())
+    assert isinstance(blocks[0], pa.Table)
+    assert blocks[0].column("x").type == pa.int64()
+    assert tr.count() == 3 and te.count() == 2
+    rp = ds.repartition(2)
+    rblocks = list(rp._iter_computed_blocks())
+    assert all(isinstance(b, pa.Table) for b in rblocks)
+    assert rp.count() == 5

@@ -123,7 +123,7 @@ def test_cross_node_data_exchange(ray_start_cluster):
     assert [r["id"] for r in srt.take(3)] == [0, 1, 2]
 
 
-def test_remote_driver_attach_over_tcp(ray_start_cluster, tmp_path):
+def test_remote_driver_attach_over_tcp(ray_start_cluster):
     """Ray-Client parity: a SECOND driver in another process attaches to
     the head over TCP (init(address="host:port")), runs tasks and actors,
     and reads objects the first driver put."""
