@@ -148,10 +148,21 @@ def random_partition(block, k: int, seed):
             for i in builtins.range(k)
         ]
     else:
-        cols = to_columns(block)
-        n = len(next(iter(cols.values()))) if cols else 0
-        assignment = rng.integers(0, k, size=n)
-        parts = [_take(cols, assignment == i) for i in builtins.range(k)]
+        table = _as_arrow(block)
+        if table is not None:
+            # filter() copies compactly AND keeps arrow types (nullable
+            # ints, timestamps) that a numpy round-trip would destroy
+            import pyarrow as pa
+
+            assignment = rng.integers(0, k, size=table.num_rows)
+            parts = [
+                table.filter(pa.array(assignment == i)) for i in builtins.range(k)
+            ]
+        else:
+            cols = to_columns(block)
+            n = len(next(iter(cols.values()))) if cols else 0
+            assignment = rng.integers(0, k, size=n)
+            parts = [_take(cols, assignment == i) for i in builtins.range(k)]
     return parts if k > 1 else parts[0]
 
 
@@ -161,6 +172,17 @@ def shuffle_merge(seed, *parts):
     Empty partitions keep their SCHEMA (zero-row columns) so downstream
     block concat never sees a key-less block."""
     rng = np.random.default_rng(seed)
+    tables = [_as_arrow(p) for p in parts]
+    if parts and all(t is not None for t in tables):
+        import pyarrow as pa
+
+        merged_t = pa.concat_tables(tables)
+        order = rng.permutation(merged_t.num_rows)
+        return merged_t.take(pa.array(order))
+    if any(t is not None for t in tables):
+        parts = tuple(
+            to_columns(p) if t is not None else p for p, t in zip(parts, tables)
+        )
     if any(isinstance(p, list) for p in parts):
         # mixed-format partitions (e.g. a union of columnar and row-list
         # datasets): fall back to row form — dropping the columnar parts
@@ -211,37 +233,33 @@ def slice_partition(block, start: int, boundaries):
     [start, start+n); emit its intersection with each output range
     [boundaries[j], boundaries[j+1]) — exact even splits without the
     driver ever touching rows. Row-list blocks (heterogeneous/ragged
-    rows) slice as lists, like random_partition."""
-    if isinstance(block, (list, tuple)):
-        rows = list(block)
-        n = len(rows)
-        out: list = []
-        for j in builtins.range(len(boundaries) - 1):
-            lo = max(0, int(boundaries[j]) - start)
-            hi = min(n, int(boundaries[j + 1]) - start)
-            out.append(rows[lo:hi] if hi > lo else [])
-        return out if len(out) > 1 else out[0]
-    table = _as_arrow(block)
-    if table is not None:
-        # slice the Table zero-copy: normalizing through numpy would drop
-        # arrow types (nullable ints, timestamps) into object arrays
+    rows) slice as lists; arrow Tables stay arrow (types preserved)."""
+    is_rows = isinstance(block, (list, tuple))
+    table = None if is_rows else _as_arrow(block)
+    if is_rows:
+        data: Any = list(block)
+        n = len(data)
+    elif table is not None:
         n = table.num_rows
-        out = []
-        for j in builtins.range(len(boundaries) - 1):
-            lo = max(0, int(boundaries[j]) - start)
-            hi = min(n, int(boundaries[j + 1]) - start)
-            out.append(table.slice(lo, max(0, hi - lo)))
-        return out if len(out) > 1 else out[0]
-    cols = to_columns(block)
-    n = len(next(iter(cols.values()))) if cols else 0
-    out = []
+    else:
+        data = to_columns(block)
+        n = len(next(iter(data.values()))) if data else 0
+    ranges = []
     for j in builtins.range(len(boundaries) - 1):
         lo = max(0, int(boundaries[j]) - start)
         hi = min(n, int(boundaries[j + 1]) - start)
-        if hi <= lo:
-            out.append({k: v[:0] for k, v in cols.items()})
-        else:
-            out.append({k: v[lo:hi] for k, v in cols.items()})
+        ranges.append((lo, max(lo, hi)))
+    if is_rows:
+        out: list = [data[lo:hi] for lo, hi in ranges]
+    elif table is not None:
+        # take() (not slice()): a zero-copy slice still PICKLES with the
+        # full parent buffers, so shipping it through the object store
+        # would copy the whole block per partition
+        import pyarrow as pa
+
+        out = [table.take(pa.array(np.arange(lo, hi))) for lo, hi in ranges]
+    else:
+        out = [{k: v[lo:hi] for k, v in data.items()} for lo, hi in ranges]
     return out if len(out) > 1 else out[0]
 
 

@@ -387,6 +387,13 @@ class Dataset:
         import ray_tpu
 
         use_tasks = ray_tpu.is_initialized()
+        if use_tasks and any(op.compute == "actors" for op in self._ops):
+            # actor-pool ops must run through their pool (callable-class
+            # state constructs once per worker, not once per block): compute
+            # via the pool, then re-publish the blocks as refs so the
+            # exchange itself still distributes
+            blocks = self._compute_blocks()
+            return [ray_tpu.put(b) for b in blocks], True
         if use_tasks:
             exec_task = ray_tpu.remote(_execute_block)
             refs = [exec_task.remote(fn, self._ops) for fn in self._block_fns]

@@ -92,6 +92,38 @@ def cmd_status(obs: _Observer, args) -> None:
         print(f"  {k}: {res['available'].get(k, 0.0):g}/{res['total'][k]:g} available")
     if states:
         print("tasks:", " ".join(f"{k}={v}" for k, v in sorted(states.items())))
+    # per-node load (agent reports; ray_syncer analogue)
+    loaded = [n for n in nodes if n.get("load_report")]
+    if loaded:
+        print("node load:")
+        for n in loaded:
+            r = n["load_report"]
+            frac = r["mem_used"] / max(1, r["mem_total"])
+            print(
+                f"  {n['node_id']}: load1m={r['load_1m']:.2f} "
+                f"mem={frac:.0%} workers={r['workers']}"
+            )
+
+
+def cmd_events(obs: _Observer, args) -> None:
+    """Per-handler control-plane latency (reference: event_stats.h dump)."""
+    stats = obs.request({"t": "event_stats"})
+    rows = [
+        {
+            "handler": name,
+            "count": st["count"],
+            "avg_ms": round(st["avg_ms"], 3),
+            "max_ms": round(st["max_ms"], 2),
+            "total_ms": round(st["total_ms"], 1),
+        }
+        for name, st in sorted(
+            stats.items(), key=lambda kv: -kv[1]["total_ms"]
+        )
+    ]
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(_fmt_table(rows, ["handler", "count", "avg_ms", "max_ms", "total_ms"]))
 
 
 _LIST_SPECS = {
@@ -195,6 +227,8 @@ def main(argv=None) -> None:
     p_tl = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     p_tl.add_argument("-o", "--output", default="timeline.json")
     sub.add_parser("metrics", help="dump metrics (prometheus-ish text)")
+    p_ev = sub.add_parser("events", help="head handler latency stats")
+    p_ev.add_argument("--json", action="store_true")
     sub.add_parser("dashboard", help="print (and open) the live dashboard URL")
     p_start = sub.add_parser("start", help="start a head or join as a node agent")
     p_start.add_argument("--head", action="store_true")
@@ -227,6 +261,7 @@ def main(argv=None) -> None:
     try:
         {
             "status": cmd_status,
+            "events": cmd_events,
             "list": cmd_list,
             "timeline": cmd_timeline,
             "metrics": cmd_metrics,

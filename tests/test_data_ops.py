@@ -311,3 +311,59 @@ def test_split_preserves_arrow_tables(ray_start_regular, tmp_path):
     rblocks = list(rp._iter_computed_blocks())
     assert all(isinstance(b, pa.Table) for b in rblocks)
     assert rp.count() == 5
+
+
+def test_shuffle_preserves_arrow(ray_start_regular, tmp_path):
+    """shuffle=True keeps arrow types too (filter/take path)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import ray_tpu.data as rd
+
+    tbl = pa.table({"x": pa.array([1, None, 3, 4, 5, 6], type=pa.int64())})
+    path = str(tmp_path / "s.parquet")
+    pq.write_table(tbl, path)
+    out = rd.read_parquet(path).random_shuffle(seed=2)
+    blocks = [b for b in out._iter_computed_blocks() if getattr(b, "num_rows", 0)]
+    assert blocks and all(isinstance(b, pa.Table) for b in blocks)
+    assert blocks[0].column("x").type == pa.int64()
+    tr, te = rd.read_parquet(path).train_test_split(0.5, shuffle=True, seed=2)
+    assert tr.count() + te.count() == 6
+
+
+def test_exchange_honors_actor_pool(ray_start_regular):
+    """sort/shuffle over a compute='actors' chain constructs the callable
+    class once per pool worker, not once per block."""
+    import ray_tpu
+    import ray_tpu.data as rd
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def get(self):
+            return self.n
+
+    tally = Counter.remote()
+
+    class Stamper:
+        def __init__(self):
+            import ray_tpu
+
+            ray_tpu.get(tally.incr.remote())
+
+        def __call__(self, b):
+            return b
+
+    ds = rd.range(80, override_num_blocks=8).map_batches(
+        Stamper, compute="actors", num_actors=2
+    )
+    out = ds.random_shuffle(seed=0)
+    assert out.count() == 80
+    constructions = ray_tpu.get(tally.get.remote())
+    assert constructions <= 2, constructions  # once per pool worker

@@ -2,6 +2,7 @@
 (reference: _private/log_monitor.py, common/event_stats.h,
 src/ray/protobuf versioning, util/tracing/tracing_helper.py)."""
 
+import os
 import threading
 import time
 
@@ -89,3 +90,31 @@ def test_tracing_execution_span_with_fake_context():
             assert span is not None
     finally:
         tracing._enabled = False
+
+
+def test_cli_status_and_events(ray_start_regular):
+    """CLI surfaces cluster status and handler latency stats."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+    sd = global_worker.session_dir
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "--session-dir", sd, "status"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert out.returncode == 0 and "resources:" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "--session-dir", sd, "events"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert out.returncode == 0 and "submit_task" in out.stdout
