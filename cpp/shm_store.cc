@@ -298,10 +298,14 @@ void* shm_store_connect(const char* session, int64_t capacity_bytes) {
 }
 
 int64_t shm_store_capacity(void* handle) {
+  if (handle == nullptr) return 0;  // defense: a caller raced disconnect
+
   return static_cast<StoreHandle*>(handle)->ctrl->capacity.load();
 }
 
 int64_t shm_store_used(void* handle) {
+  if (handle == nullptr) return 0;  // defense: a caller raced disconnect
+
   return static_cast<StoreHandle*>(handle)->ctrl->used.load();
 }
 
@@ -309,6 +313,8 @@ int64_t shm_store_used(void* handle) {
 // Returns nullptr if capacity would be exceeded (caller may evict+retry).
 void* shm_store_create(void* handle, const char* object_name, int64_t size,
                        int32_t pin) {
+  if (handle == nullptr) return nullptr;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
@@ -339,6 +345,8 @@ void* shm_store_create(void* handle, const char* object_name, int64_t size,
 }
 
 int shm_store_seal(void* handle, const char* object_name) {
+  if (handle == nullptr) return -1;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ObjectEntry* e = find_entry(h->ctrl, object_name, false);
   if (e == nullptr) return -1;
@@ -348,6 +356,8 @@ int shm_store_seal(void* handle, const char* object_name) {
 
 // Maps a sealed object read-only; returns pointer, sets *size_out.
 void* shm_store_get(void* handle, const char* object_name, int64_t* size_out) {
+  if (handle == nullptr) return nullptr;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/false));
   if (base == nullptr) return nullptr;
@@ -395,6 +405,8 @@ constexpr int32_t kPendingDelete = 2;  // sealed-state: delete when refs hit 0
 // persists; nothing to unmap per object. Completes a deferred delete when
 // the last pin goes away.
 int shm_store_release(void* handle, const char* object_name, void* mem) {
+  if (handle == nullptr) return -1;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   (void)mem;
@@ -416,6 +428,8 @@ int shm_store_release(void* handle, const char* object_name, void* mem) {
 // unlike the per-segment design, a freed slab range can be reused by a new
 // object, so handing it out under a live reader would corrupt data.
 int shm_store_delete(void* handle, const char* object_name) {
+  if (handle == nullptr) return -1;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   lock_cb(cb);
@@ -437,6 +451,8 @@ int shm_store_delete(void* handle, const char* object_name) {
 // Returns bytes evicted. The caller (head) must treat evicted ids as lost
 // and trigger lineage reconstruction — same contract as plasma eviction.
 int64_t shm_store_evict(void* handle, int64_t want_bytes) {
+  if (handle == nullptr) return 0;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   int64_t freed = 0;
@@ -477,6 +493,8 @@ int64_t shm_store_evict(void* handle, int64_t want_bytes) {
 // (serialization.materialize). Returns bytes reclaimed.
 int64_t shm_store_spill_pinned(void* handle, int64_t want_bytes,
                                const char* spill_dir) {
+  if (handle == nullptr) return 0;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
@@ -551,6 +569,8 @@ int64_t shm_store_spill_pinned(void* handle, int64_t want_bytes,
 // run at memcpy speed instead of paying first-touch zero-fill (plasma
 // pre-touches its dlmalloc arena the same way). Returns bytes touched.
 int64_t shm_store_pretouch(void* handle, int64_t max_bytes) {
+  if (handle == nullptr) return 0;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   ControlBlock* cb = h->ctrl;
   char* base = static_cast<char*>(ensure_data_map(h, /*writable=*/true));
@@ -591,6 +611,8 @@ int64_t shm_store_pretouch(void* handle, int64_t max_bytes) {
 }
 
 void shm_store_disconnect(void* handle) {
+  if (handle == nullptr) return;  // defense: a caller raced disconnect
+
   auto* h = static_cast<StoreHandle*>(handle);
   if (h->data_rw) munmap(h->data_rw, h->data_len);
   if (h->data_ro) munmap(h->data_ro, h->data_len);

@@ -231,6 +231,8 @@ class ShmClient:
         evicted ids are reconstructible from lineage (head.py), which is
         what makes producer-side eviction safe; `pin=True` marks data with
         NO lineage (ray.put) as never-evictable."""
+        if self.handle is None:
+            return None  # disconnected (shutdown): treat as store-full
         data = memoryview(data)
         size = data.nbytes
         ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
@@ -267,6 +269,8 @@ class ShmClient:
         """Map a sealed object read-only, zero-copy. The mapping is unmapped
         and its pin dropped automatically when the last view dies (weakref
         finalizer on the backing ctypes buffer)."""
+        if self.handle is None:
+            return None  # disconnected (shutdown)
         import weakref
 
         size_out = ctypes.c_int64(0)
@@ -283,6 +287,8 @@ class ShmClient:
         return memoryview(buf).toreadonly()
 
     def delete(self, name: str):
+        if self.handle is None:
+            return  # disconnected (shutdown): late frees are no-ops
         self.lib.shm_store_delete(self.handle, name.encode())
         try:
             os.unlink(self._spill_file(name))
@@ -290,12 +296,18 @@ class ShmClient:
             pass
 
     def used(self) -> int:
+        if self.handle is None:
+            return 0
         return self.lib.shm_store_used(self.handle)
 
     def capacity(self) -> int:
+        if self.handle is None:
+            return 0
         return self.lib.shm_store_capacity(self.handle)
 
     def evict(self, nbytes: int) -> int:
+        if self.handle is None:
+            return 0
         return self.lib.shm_store_evict(self.handle, nbytes)
 
     def pretouch_async(self):

@@ -332,7 +332,8 @@ class Dataset:
         rows into K random partitions (num_returns=K), one merge task per
         partition concats + locally permutes — the driver only holds refs,
         so shuffle scale is bounded by the cluster, not driver memory.
-        Preserves dict-of-numpy block format."""
+        Block formats survive: arrow Tables stay arrow (types preserved),
+        dict-of-numpy stays columnar, row lists stay rows."""
         from . import _exchange
 
         import ray_tpu
@@ -390,10 +391,14 @@ class Dataset:
         if use_tasks and any(op.compute == "actors" for op in self._ops):
             # actor-pool ops must run through their pool (callable-class
             # state constructs once per worker, not once per block): compute
-            # via the pool, then re-publish the blocks as refs so the
-            # exchange itself still distributes
-            blocks = self._compute_blocks()
-            return [ray_tpu.put(b) for b in blocks], True
+            # via the pool and re-publish blocks as refs so the exchange
+            # still distributes. STREAMING put: holding the whole dataset
+            # in a driver-side list would defeat the windowed backpressure
+            refs = []
+            for b in self._iter_computed_blocks():
+                refs.append(ray_tpu.put(b))
+                del b
+            return refs, True
         if use_tasks:
             exec_task = ray_tpu.remote(_execute_block)
             refs = [exec_task.remote(fn, self._ops) for fn in self._block_fns]
