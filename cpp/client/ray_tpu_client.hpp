@@ -483,7 +483,8 @@ class Client {
   // ---- low-level request/response ----
 
   Json Request(Json msg) {
-    msg.obj["rid"] = Json::of(++rid_);
+    const int64_t rid = ++rid_;
+    msg.obj["rid"] = Json::of(rid);
     std::string body;
     msg.dump(body);
     SendFrame(body);
@@ -491,6 +492,10 @@ class Client {
       Json reply = JsonParser(RecvFrame()).parse();
       const Json *t = reply.get("t");
       if (!t || t->as_str() != "reply") continue;  // ignore pushes
+      // a stray late reply (e.g. after a future timeout-and-retry) must not
+      // pair with the wrong request
+      const Json *r = reply.get("rid");
+      if (!r || r->as_int() != rid) continue;
       const Json *ok = reply.get("ok");
       if (!ok || !ok->as_bool()) {
         const Json *err = reply.get("error");

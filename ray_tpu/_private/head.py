@@ -314,6 +314,7 @@ class Head:
             collections.defaultdict(set)
         )
         self._channel_events: Dict[str, asyncio.Event] = {}
+        self._channel_waiters: Dict[str, int] = {}
         self._push_tasks: Set[asyncio.Task] = set()
         # handler name -> {count, total_ms, max_ms} (event_stats.h analogue)
         self.event_stats: Dict[str, dict] = {}
@@ -704,6 +705,12 @@ class Head:
                 "node %s under memory pressure (%.0f%%) but no killable task "
                 "worker found", node_id, 100.0 * used / max(total, 1),
             )
+            # shorter cooldown than the kill path: rate-limits the warning
+            # under sustained pressure with only unkillable work (actors),
+            # while re-checking soon in case a killable task starts
+            self._oom_cooldowns[node_id] = now + max(
+                1.0, cfg.memory_monitor_refresh_ms / 1000.0
+            )
             return
         w = self.workers[victim.worker_id]
         w.kill_reason = (
@@ -829,7 +836,11 @@ class Head:
         for proc in getattr(conn, "_metric_procs", ()):
             self.metrics_store.pop(proc, None)
         for ch in getattr(conn, "_subscribed_channels", ()):
-            self.channel_subscribers[ch].discard(conn)
+            subs = self.channel_subscribers.get(ch)
+            if subs is not None:
+                subs.discard(conn)
+                if not subs:
+                    del self.channel_subscribers[ch]
         for n in list(self.nodes.values()):
             if n.conn is conn and n.alive:
                 await self._on_node_death(n, reason="agent connection closed")
@@ -1690,7 +1701,11 @@ class Head:
 
     async def _h_unsubscribe(self, conn, msg):
         ch = msg["channel"]
-        self.channel_subscribers[ch].discard(conn)
+        subs = self.channel_subscribers.get(ch)
+        if subs is not None:
+            subs.discard(conn)
+            if not subs:
+                del self.channel_subscribers[ch]
         if hasattr(conn, "_subscribed_channels"):
             conn._subscribed_channels.discard(ch)
         return True
@@ -1710,12 +1725,23 @@ class Head:
             if remaining <= 0:
                 return {"seq": last, "timeout": True}
             ev = self._channel_events.setdefault(ch, asyncio.Event())
+            self._channel_waiters[ch] = self._channel_waiters.get(ch, 0) + 1
             try:
                 # no shield: cancelling Event.wait() is side-effect free, and
                 # shielding would leak one pending waiter per poll timeout
                 await asyncio.wait_for(ev.wait(), remaining)
             except asyncio.TimeoutError:
                 return {"seq": last, "timeout": True}
+            finally:
+                # last waiter out drops the Event — churning channel names
+                # that time out without a publish must not grow head memory
+                n = self._channel_waiters.get(ch, 1) - 1
+                if n <= 0:
+                    self._channel_waiters.pop(ch, None)
+                    if self._channel_events.get(ch) is ev and not ev.is_set():
+                        self._channel_events.pop(ch, None)
+                else:
+                    self._channel_waiters[ch] = n
 
     # ------------------------------------------------------------------
     # state API + observability (reference: dashboard/state_aggregator.py,

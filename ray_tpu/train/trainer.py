@@ -39,10 +39,9 @@ class Result:
 class TrainWorker:
     """Actor hosting one training process (one host's SPMD shard)."""
 
-    def __init__(self, rank: int, world_size: int, coordinator: Optional[str] = None):
+    def __init__(self, rank: int, world_size: int):
         self.rank = rank
         self.world_size = world_size
-        self.coordinator = coordinator
         self.ctx: Optional[TrainContext] = None
         self._done = threading.Event()
         self._ret = None
@@ -51,15 +50,42 @@ class TrainWorker:
     def ready(self):
         return True
 
-    def run(self, train_fn: Callable, config: Dict[str, Any], datasets=None, checkpoint=None):
-        if self.world_size > 1 and self.coordinator:
+    def get_coordinator_address(self) -> str:
+        """Rank-0 upcall: a `host:port` the REST of the gang can dial for
+        jax.distributed rendezvous. Resolved AFTER placement, on the worker
+        itself — the reference does exactly this for the torch rendezvous
+        (train/torch/config.py:113-170 master addr/port queried from worker
+        0; backend_executor.py:342) — a driver-picked loopback address
+        cannot form a mesh across hosts."""
+        import socket
+
+        from ray_tpu._private.head import _advertise_host
+
+        host = _advertise_host("0.0.0.0")  # this node's outbound/routable IP
+        s = socket.socket()
+        s.bind(("0.0.0.0", 0))
+        port = s.getsockname()[1]
+        s.close()  # jax.distributed binds it next; standard rendezvous race
+        return f"{host}:{port}"
+
+    def run(
+        self,
+        train_fn: Callable,
+        config: Dict[str, Any],
+        datasets=None,
+        checkpoint=None,
+        coordinator: Optional[str] = None,
+    ):
+        dist_inited = False
+        if self.world_size > 1 and coordinator:
             import jax
 
             jax.distributed.initialize(
-                coordinator_address=self.coordinator,
+                coordinator_address=coordinator,
                 num_processes=self.world_size,
                 process_id=self.rank,
             )
+            dist_inited = True
         self.ctx = TrainContext(
             world_rank=self.rank,
             world_size=self.world_size,
@@ -80,6 +106,13 @@ class TrainWorker:
             raise
         finally:
             self.ctx.done.set()
+            if dist_inited:
+                import jax
+
+                try:  # leave the process reusable for a gang-restart attempt
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
 
     def next_results(self, max_items: int = 100):
         """Drain queued session.report() payloads (non-blocking)."""
@@ -178,15 +211,6 @@ class JaxTrainer:
                 )
             strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
 
-        coordinator = None
-        if n > 1:
-            import socket
-
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
-            s.close()
-
         WorkerCls = ray_tpu.remote(TrainWorker)
         opts: Dict[str, Any] = {
             "num_cpus": res.get("CPU", 1),
@@ -203,11 +227,19 @@ class JaxTrainer:
             opts["runtime_env"] = {"env_vars": dict(sc.env_vars)}
 
         workers.extend(
-            WorkerCls.options(**opts).remote(rank, n, coordinator) for rank in range(n)
+            WorkerCls.options(**opts).remote(rank, n) for rank in range(n)
         )
         # timeout: unschedulable/crashing workers must raise into the
         # restart loop, not block setup forever
         ray_tpu.get([w.ready.remote() for w in workers], timeout=180)
+
+        # rendezvous: rank-0 worker (placed!) picks the coordinator address
+        # on ITS node and the driver broadcasts it to the gang
+        coordinator = None
+        if n > 1:
+            coordinator = ray_tpu.get(
+                workers[0].get_coordinator_address.remote(), timeout=60
+            )
 
         # shard datasets across workers (streaming split)
         def shard_for(rank):
@@ -220,7 +252,10 @@ class JaxTrainer:
             return out
 
         run_refs = [
-            w.run.remote(self._train_fn, self._config, shard_for(i), resume_checkpoint)
+            w.run.remote(
+                self._train_fn, self._config, shard_for(i), resume_checkpoint,
+                coordinator,
+            )
             for i, w in enumerate(workers)
         ]
 

@@ -7,6 +7,8 @@ schedulers (tune/schedulers/), experiment resume (Tuner.restore).
 
 from __future__ import annotations
 
+import logging
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -38,13 +40,15 @@ from .tpe import TPESearcher  # noqa: F401
 from .trainable import Trainable, report  # noqa: F401
 from .trial import Trial  # noqa: F401
 
+logger = logging.getLogger(__name__)
+
 
 @dataclass
 class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
-    # None = default: 1 for the built-in variant generator, UNCAPPED for a
-    # user-supplied search_alg (which then runs to its own exhaustion)
+    # None = default 1 (reference parity: tune/tune.py num_samples=1);
+    # -1 = run a user-supplied search_alg to its own exhaustion
     num_samples: Optional[int] = None
     max_concurrent_trials: int = 0
     search_alg: Optional[Searcher] = None
@@ -111,19 +115,33 @@ class Tuner:
         metric = tc.metric or "_metric"
         if tc.search_alg is not None:
             searcher = tc.search_alg
-            if tc.num_samples is not None:
+            # num_samples caps ANY searcher — suggestion-based ones (TPE
+            # etc.) never self-exhaust, and uncapped they would run forever
+            # (reference: tune/tune.py defaults num_samples=1 for every
+            # searcher). Unset defaults to 1, matching the reference;
+            # num_samples=-1 is the explicit "run to searcher exhaustion"
+            # sentinel (reference: tune/tune.py num_samples=-1 = infinite).
+            if tc.num_samples != -1:
                 from .search import SampleLimiter
 
-                # an explicit num_samples caps ANY searcher — suggestion-
-                # based ones (TPE etc.) never self-exhaust, and without a
-                # cap the experiment would run forever (reference:
-                # tune/tune.py applies num_samples to search algorithms);
-                # self-exhausting searchers keep their own limit when
-                # num_samples is left unset
-                searcher = SampleLimiter(searcher, tc.num_samples)
+                if tc.num_samples is None:
+                    logger.warning(
+                        "TuneConfig.num_samples not set with a custom "
+                        "search_alg: defaulting to 1 (use num_samples=-1 "
+                        "to run until the searcher exhausts itself)"
+                    )
+                searcher = SampleLimiter(
+                    searcher,
+                    tc.num_samples if tc.num_samples is not None else 1,
+                )
         else:
             searcher = BasicVariantGenerator(
-                self._space, num_samples=tc.num_samples or 1, seed=tc.seed
+                self._space,
+                # -1 (searcher-exhaustion sentinel) is meaningless for the
+                # finite variant generator: one pass over the grid. 0 stays
+                # 0 (zero trials), only None/-1 default to 1.
+                num_samples=1 if tc.num_samples in (None, -1) else tc.num_samples,
+                seed=tc.seed,
             )
         controller = TuneController(
             self._trainable,
@@ -163,7 +181,10 @@ class Tuner:
             tune_config=kwargs.pop(
                 "tune_config",
                 TuneConfig(
-                    metric=state["metric"], mode=state["mode"], search_alg=_Exhausted()
+                    # -1: the internal already-exhausted searcher must not
+                    # trip the num_samples-unset warning or a 1-trial cap
+                    metric=state["metric"], mode=state["mode"],
+                    search_alg=_Exhausted(), num_samples=-1,
                 ),
             ),
             run_config=run_config,

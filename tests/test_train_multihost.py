@@ -1,0 +1,119 @@
+"""Multi-host JaxTrainer: a 2-worker gang across TWO real agent-node
+processes forms an actual jax.distributed mesh (reference parity: the torch
+rendezvous seam train/torch/config.py:113-170 — master address resolved from
+the rank-0 WORKER, not the driver — plus backend_executor.py:342)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, session
+from ray_tpu.train.config import FailureConfig
+
+
+@pytest.fixture
+def two_node_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    # head owns no CPUs: both train workers MUST land on the agent nodes
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    yield c
+    c.shutdown()
+
+
+def _dist_train_loop(config):
+    """Runs in each gang worker: jax.distributed is already initialized by
+    the TrainWorker harness (coordinator from the rank-0 worker). Builds a
+    GLOBAL 2-device mesh (1 CPU device per process) and runs a cross-process
+    collective + a data-parallel gradient."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rank = session.get_world_rank()
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+    assert jax.local_device_count() == 1
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    # global [2] array, one element per process
+    local = jnp.asarray([float(rank + 1)])
+    garr = jax.make_array_from_single_device_arrays(
+        (2,), NamedSharding(mesh, P("dp")),
+        [jax.device_put(local, jax.local_devices()[0])],
+    )
+
+    # 1. cross-process all-reduce: sum of [1, 2] == 3 everywhere
+    total = float(jax.jit(lambda a: a.sum())(garr))
+
+    # 2. data-parallel gradient: loss = sum((w*x)^2) with x sharded over dp
+    #    and w replicated -> dL/dw = sum(2*w*x^2) needs a psum across
+    #    processes, inserted by GSPMD
+    w = jnp.float32(3.0)
+
+    def loss(w, x):
+        return ((w * x) ** 2).sum()
+
+    g = float(jax.jit(jax.grad(loss))(w, garr))
+    # single-process oracle: x = [1, 2] -> grad = 2*w*(1 + 4) = 10*w
+    session.report({"total": total, "grad": g, "rank": rank,
+                    "procs": jax.process_count()})
+    return "ok"
+
+
+def test_jax_trainer_two_nodes(two_node_cluster):
+    trainer = JaxTrainer(
+        _dist_train_loop,
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            placement_strategy="STRICT_SPREAD",
+            # one CPU device per process: the 2-process mesh has exactly one
+            # device per host, like one chip per host
+            env_vars={"XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                      "JAX_PLATFORMS": "cpu"},
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["procs"] == 2
+    assert result.metrics["total"] == pytest.approx(3.0)
+    assert result.metrics["grad"] == pytest.approx(30.0)  # 10 * w, w=3
+
+
+def test_jax_trainer_gang_restart_across_node_kill(two_node_cluster):
+    """Kill a gang worker's node mid-train: the WHOLE gang restarts and the
+    rerun converges to the same result (all-or-nothing SPMD restart)."""
+    cluster = two_node_cluster
+
+    def loop(config):
+        import os
+
+        rank = session.get_world_rank()
+        if rank == 1:
+            # first attempt only (marker file): rank 1's process dies hard
+            marker = os.path.join(config["tmp"], "died")
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)
+        session.report({"rank": rank, "ok": 1})
+        return "ok"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer = JaxTrainer(
+            loop,
+            train_loop_config={"tmp": tmp},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                placement_strategy="SPREAD",
+                env_vars={"JAX_PLATFORMS": "cpu"},
+            ),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+        )
+        result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["ok"] == 1

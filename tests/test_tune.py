@@ -164,6 +164,10 @@ def test_concurrency_limiter(ray_cpus):
     searcher = tune.ConcurrencyLimiter(
         tune.BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=6), max_concurrent=2
     )
-    results = tune.run(_objective, search_alg=searcher, metric="score", mode="max")
+    # num_samples=-1: run the (self-exhausting) searcher to exhaustion —
+    # unset would cap at 1 (reference default)
+    results = tune.run(
+        _objective, search_alg=searcher, metric="score", mode="max", num_samples=-1
+    )
     assert len(results) == 6
     assert not results.errors
