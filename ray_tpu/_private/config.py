@@ -86,6 +86,9 @@ D("worker_register_timeout_s", float, 30.0, "max wait for a spawned worker to re
 D("task_retry_delay_ms", int, 100, "delay before retrying a failed task")
 D("max_pending_lease_requests", int, 1024)
 D("object_inline_limit_bytes", int, 128 * 1024, "objects <= this ride the control socket; larger go to shm")
+D("fetch_chunk_bytes", int, 16 * 1024 * 1024,
+  "chunk size for node-to-node buffer pulls (object_manager.h chunked "
+  "transfer analogue); bounds per-message memory on the bulk plane")
 D("shm_store_bytes", int, 2 * 1024**3, "capacity of the C++ shared-memory object store")
 D("shm_store_enabled", bool, True)
 D("get_poll_timeout_s", float, 0.2)
@@ -94,6 +97,14 @@ D("worker_pool_prestart", int, 0, "workers to prestart per node at init")
 D("direct_actor_calls", bool, True,
   "push actor calls straight to the actor's worker (head only resolves the "
   "route); falls back to head-mediated dispatch per actor on failure")
+D("direct_task_calls", bool, True,
+  "push normal tasks straight to head-granted leased workers with lease "
+  "reuse (direct_task_transport.cc:588,:191); head path for placement "
+  "strategies / runtime envs / TPU tasks and as fallback")
+D("direct_task_max_leases", int, 8,
+  "max concurrently held worker leases per (caller, resource shape)")
+D("task_lease_idle_ms", int, 200,
+  "idle time before a held task lease is released back to the cluster")
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")
@@ -105,6 +116,9 @@ D("head_snapshot_path", str, "",
 D("head_restore_path", str, "",
   "restore head state from this snapshot at startup (reference: GCS "
   "restart reload, gcs_init_data.h)")
+D("head_reconnect_timeout_s", float, 60.0,
+  "how long agents/workers/drivers keep retrying the head address after "
+  "their connection drops (head crash + restart-from-snapshot window)")
 D("head_tcp_host", str, "127.0.0.1",
   "bind host for the multi-host TCP control plane; the wire protocol is "
   "unauthenticated pickle, so bind non-loopback (0.0.0.0) only on trusted "

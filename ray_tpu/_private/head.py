@@ -62,6 +62,9 @@ class NodeRecord:
     probing: bool = False
     # last load report from the node's agent (ray_syncer analogue)
     load_report: Optional[Dict[str, Any]] = None
+    # the node's peer-facing bulk plane listener (object_manager.h:117);
+    # consumers dial it directly — the head only serves this location
+    buffer_addr: Optional[str] = None
 
     def __post_init__(self):
         if not self.available:
@@ -255,7 +258,15 @@ class ObjectDirectory:
                 # the debt so the late put reconciles to zero and frees
                 self.task_pins.pop(oid, None)
                 return
-            self.events.pop(oid, None)
+            # NEVER drop an event someone is parked on: a later put would
+            # mint a NEW event and set that one, stranding the old waiters
+            # forever (the direct-path free/put interleave hits this —
+            # get_objects parks, a transient count reaches 0, the producer's
+            # put lands after). Keeping the same object means the late put
+            # wakes them.
+            ev = self.events.get(oid)
+            if ev is not None and not ev._waiters:
+                self.events.pop(oid, None)
             self.refcounts.pop(oid, None)
             self.task_pins.pop(oid, None)
             if env is not None and self.on_free is not None:
@@ -318,6 +329,17 @@ class Head:
         self._push_tasks: Set[asyncio.Task] = set()
         # handler name -> {count, total_ms, max_ms} (event_stats.h analogue)
         self.event_stats: Dict[str, dict] = {}
+        # object bytes relayed through the head (fetch_buffers fallback
+        # path only — the direct node-to-node plane keeps this ~0)
+        self.relay_bytes: int = 0
+        # direct task leases: worker_id -> {conn, node_id, resources}
+        # (direct_task_transport.cc:191 lease bookkeeping)
+        self._task_leases: Dict[str, dict] = {}
+        # dashboard observability: per-worker log rings + per-node load
+        # history (reference: dashboard/modules/{log,reporter})
+        self.log_ring: Dict[str, "collections.deque"] = {}
+        self.node_history: Dict[str, "collections.deque"] = {}
+        self._log_interest_until = 0.0
         # submitted jobs: submission_id -> record (entrypoint subprocess)
         self.jobs: Dict[str, dict] = {}
         self._prestart_tasks: List[asyncio.Task] = []
@@ -368,10 +390,23 @@ class Head:
                 for n in names:
                     shm.delete(n)
 
+    async def _h_buffer_addrs(self, conn, msg):
+        """Owner-directed location lookup (pull_manager.h:52): where is each
+        node's bulk-plane listener? Consumers dial it directly and cache the
+        answer; the head never sees the object bytes."""
+        out = {}
+        for nid in msg["nodes"]:
+            node = self.nodes.get(nid)
+            out[nid] = (
+                node.buffer_addr if node is not None and node.alive else None
+            )
+        return out
+
     async def _h_fetch_buffers(self, conn, msg):
-        """Pull shm buffers that live on `node` for a consumer elsewhere —
-        the collapsed analogue of the reference's chunked object pull
-        (pull_manager.h:52 / object_manager.h:117)."""
+        """RELAY FALLBACK for cross-node pulls (consumers first try the
+        owner's bulk plane via buffer_addrs; reference analogue:
+        object_manager.h:117). Relayed bytes are counted — tests and the
+        dashboard assert the bulk plane stays off the head."""
         node_id = msg.get("node") or self._head_node_id
         names: List[str] = msg["names"]
         node = self.nodes.get(node_id)
@@ -379,11 +414,13 @@ class Head:
             if not node.alive or node.conn.closed:
                 return {name: None for name in names}
             try:
-                return await node.conn.request(
+                got = await node.conn.request(
                     {"t": "read_buffers", "names": names}, timeout=60
                 )
             except Exception:
                 return {name: None for name in names}
+            self.relay_bytes += sum(len(v) for v in got.values() if v)
+            return got
         # head node and logical nodes share the head machine's shm plane
         shm = self._shm_client()
         out = {}
@@ -396,6 +433,12 @@ class Head:
         """Listen on the session unix socket AND on TCP (the multi-host
         plane; reference: grpc_server.h:73). The bound host:port is written
         to <session_dir>/head_addr for discovery by `init(address=...)`."""
+        # a stale socket file survives a crashed head whose session this
+        # start is restoring; binding over it needs the unlink
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
         self.server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
         self._shm_client()  # connect early: kicks off the slab pretouch
         if cfg.head_restore_path:
@@ -469,6 +512,7 @@ class Head:
         state = {
             "version": 1,
             "time": time.time(),
+            "session_id": os.path.basename(self.session_dir),
             "kv": {ns: dict(table) for ns, table in self.kv.items()},
             "named_actors": dict(self.named_actors),
             "actors": {
@@ -643,6 +687,13 @@ class Head:
     # ------------------------------------------------------------------
 
     async def _publish_logs(self, worker_id: str, data: str):
+        # bounded per-worker ring for the dashboard's log viewer
+        # (reference: dashboard/modules/log — file tail over HTTP)
+        ring = self.log_ring.get(worker_id)
+        if ring is None:
+            ring = self.log_ring[worker_id] = collections.deque(maxlen=400)
+        for line in data.splitlines():
+            ring.append(line)
         await self._h_publish(
             None, {"channel": "__logs__",
                    "data": {"worker_id": worker_id, "data": data}}
@@ -661,7 +712,7 @@ class Head:
         loop = asyncio.get_running_loop()
         while not self._shutdown:
             await asyncio.sleep(0.3)
-            if not self.channel_subscribers.get("__logs__"):
+            if not self._logs_wanted():
                 # nobody listening: don't read content, but keep offsets at
                 # the file ends — a later subscriber gets LIVE output, not
                 # the accumulated backlog of the unsubscribed gap
@@ -672,10 +723,31 @@ class Head:
             ):
                 await self._publish_logs(worker_id, data)
 
+    def _logs_wanted(self) -> bool:
+        """True when a driver subscribed to __logs__ OR the dashboard's log
+        viewer asked recently (interest expires so idle dashboards don't
+        keep cross-host log traffic flowing forever)."""
+        return bool(self.channel_subscribers.get("__logs__")) or (
+            time.monotonic() < self._log_interest_until
+        )
+
     async def _h_logs_wanted(self, conn, msg):
         """Agents poll this to gate their log forwarding (no subscribers ->
         no cross-host log traffic)."""
-        return bool(self.channel_subscribers.get("__logs__"))
+        return self._logs_wanted()
+
+    async def _h_tail_logs(self, conn, msg):
+        """Dashboard log viewer: last N buffered lines for one worker (and
+        the list of workers with any buffered output). Requesting marks log
+        interest for 30s so agents start forwarding."""
+        self._log_interest_until = time.monotonic() + 30.0
+        worker_id = msg.get("worker_id")
+        out = {"workers": sorted(self.log_ring.keys())}
+        if worker_id:
+            ring = self.log_ring.get(worker_id)
+            limit = int(msg.get("limit", 200))
+            out["lines"] = list(ring)[-limit:] if ring else []
+        return out
 
     async def _oom_kill(self, node_id: str, used: int, total: int):
         # per-node cooldown: the previous victim's memory takes time to
@@ -841,6 +913,12 @@ class Head:
                 subs.discard(conn)
                 if not subs:
                     del self.channel_subscribers[ch]
+        # caller died holding direct task leases: reclaim the workers
+        for wid in list(getattr(conn, "_task_leases", ())):
+            self._drop_task_lease(wid)
+            w = self.workers.get(wid)
+            if w is not None and w.state != "dead":
+                await self._return_leased_worker(w)
         for n in list(self.nodes.values()):
             if n.conn is conn and n.alive:
                 await self._on_node_death(n, reason="agent connection closed")
@@ -899,6 +977,22 @@ class Head:
             for t, st in self.event_stats.items()
         }
 
+    async def _h_object_stats(self, conn, msg):
+        """Bulk-plane accounting: relayed bytes must stay ~0 when the
+        direct node-to-node plane is healthy."""
+        return {"relay_bytes": self.relay_bytes}
+
+    async def _h_debug_object(self, conn, msg):
+        """Per-object directory introspection (ops/debugging)."""
+        oid = msg["oid"]
+        return {
+            "present": self.objects.contains(oid),
+            "refcount": self.objects.refcounts.get(oid, 0),
+            "pins": self.objects.task_pins.get(oid, 0),
+            "has_event": oid in self.objects.events,
+            "lineage_task": self.object_lineage.get(oid),
+        }
+
     # --- registration ---
 
     async def _h_register_driver(self, conn, msg):
@@ -908,17 +1002,30 @@ class Head:
 
     async def _h_register_node(self, conn, msg):
         """A per-host agent joined over TCP (reference: raylet registration
-        with GcsNodeManager)."""
+        with GcsNodeManager). An agent whose previous connection is gone may
+        RE-register under the same node id — the reconnect path after a head
+        restart or a network blip (reference: raylet re-register against a
+        restarted GCS, gcs_server.cc:130-178 init-from-stored-state)."""
         protocol.check_protocol_version(msg, f"node agent {msg.get('node_id')}")
         node_id = msg["node_id"]
-        if node_id in self.nodes and self.nodes[node_id].alive:
+        prev = self.nodes.get(node_id)
+        if prev is not None and prev.alive and prev.conn is not None and not prev.conn.closed:
             raise ValueError(f"node id {node_id!r} already registered")
         self.nodes[node_id] = NodeRecord(
-            node_id, dict(msg["resources"]), labels=msg.get("labels", {}), conn=conn
+            node_id, dict(msg["resources"]), labels=msg.get("labels", {}), conn=conn,
+            buffer_addr=msg.get("buffer_addr"),
         )
+        # reconnect ordering is arbitrary: actors adopted BEFORE their node
+        # re-registered must be charged against the fresh availability
+        for rec in self.actors.values():
+            if rec.state == "alive" and not rec.node_acquired:
+                w = self.workers.get(rec.worker_id or "")
+                if w is not None and w.node_id == node_id and w.state != "dead":
+                    self._adopt_actor_resources(rec, node_id)
         self._prestart_workers(node_id)
         self._pump()
-        return {"session": os.path.basename(self.session_dir)}
+        return {"session": os.path.basename(self.session_dir),
+                "session_dir": self.session_dir}
 
     def _prestart_workers(self, node_id: str):
         """Pre-warm the node's idle pool so first tasks skip the process
@@ -953,11 +1060,38 @@ class Head:
         protocol.check_protocol_version(msg, f"worker {msg.get('worker_id')}")
         w = self.workers.get(msg["worker_id"])
         if w is None:
-            raise ValueError(f"unknown worker {msg['worker_id']}")
+            if not msg.get("adopt"):
+                raise ValueError(f"unknown worker {msg['worker_id']}")
+            # a SURVIVING worker re-registering after a head restart: the
+            # process (and any actor state in it) is intact — re-adopt it
+            # instead of forcing a cold respawn (reference: workers
+            # re-register with a restarted GCS via the raylet)
+            w = WorkerRecord(
+                worker_id=msg["worker_id"],
+                node_id=msg.get("node_id") or "",
+                state="starting",
+            )
+            self.workers[w.worker_id] = w
         w.conn = conn
         w.direct_address = msg.get("direct_address")
+        aid = msg.get("actor_id")
+        if aid:
+            w.state = "actor"
+            w.actor_id = aid
+            rec = self.actors.get(aid)
+            if rec is not None and rec.state != "alive":
+                # snapshot restore marked it dead; the live process proves
+                # otherwise — revive the record so routes resolve again
+                rec.state = "alive"
+                rec.worker_id = w.worker_id
+                rec.death_reason = None
+                # a revived actor still OCCUPIES its node: without the
+                # deduction the scheduler double-books the host
+                self._adopt_actor_resources(rec, w.node_id)
         if w.state == "starting":
             w.state = "idle"
+            if msg.get("adopt"):
+                self.idle_workers[w.node_id].append(w.worker_id)
         if w.registered is not None and not w.registered.done():
             w.registered.set_result(None)
         self._pump()
@@ -1012,6 +1146,100 @@ class Head:
         # direct-transport results carry the caller's +1 here; if the caller
         # already dropped its ref (counter went negative), reconcile now
         self.objects._maybe_free(oid)
+
+    async def _h_put_objects(self, conn, msg):
+        """Batched put_object: direct-transport callers coalesce result
+        forwards so the head pays one message per batch, not per call
+        (reference: the task-event/object-report batching in
+        core_worker/task_event_buffer.h)."""
+        for oid, env in msg["objects"]:
+            self.objects.put(oid, env)
+            self.objects.add_ref(oid, 1)
+            self.objects._maybe_free(oid)
+
+    # ------------------------------------------------------------------
+    # direct task transport: leases + post-hoc records
+    # (reference: direct_task_transport.cc:588 lease-worker push, :191
+    # lease reuse — the head grants a leased worker; the caller pushes
+    # task specs straight to it and reuses the lease across tasks)
+    # ------------------------------------------------------------------
+
+    async def _h_request_task_lease(self, conn, msg):
+        res = dict(msg.get("resources") or {"CPU": 1.0})
+        nid = self._select_node(res, None)
+        if nid is None:
+            return None  # no capacity: caller queues via submit_task
+        w = await self._lease_worker(
+            nid, needs_tpu=res.get("TPU", 0) > 0,
+            runtime_env=msg.get("runtime_env"),
+        )
+        if w is None or not w.direct_address:
+            self._release_node(nid, res, None)
+            if w is not None:  # un-dialable worker: back to the pool
+                await self._return_leased_worker(w)
+            return None
+        self._task_leases[w.worker_id] = {
+            "conn": conn, "node_id": nid, "resources": res,
+        }
+        if not hasattr(conn, "_task_leases"):
+            conn._task_leases = set()
+        conn._task_leases.add(w.worker_id)
+        return {
+            "worker_id": w.worker_id, "address": w.direct_address,
+            "node_id": w.node_id,
+        }
+
+    def _drop_task_lease(self, worker_id: str) -> None:
+        """Release the lease's node resources + caller bookkeeping (the
+        worker itself is settled separately — it may be dead)."""
+        lease = self._task_leases.pop(worker_id, None)
+        if lease is None:
+            return
+        s = getattr(lease["conn"], "_task_leases", None)
+        if s is not None:
+            s.discard(worker_id)
+        self._release_node(lease["node_id"], lease["resources"], None)
+
+    async def _return_leased_worker(self, w: WorkerRecord) -> None:
+        if w.state != "busy":
+            return
+        if w.pooled:
+            w.state = "idle"
+            self.idle_workers[w.node_id].append(w.worker_id)
+        else:
+            await self._kill_worker(w, reason="direct lease done")
+        self._pump()
+
+    async def _h_release_task_lease(self, conn, msg):
+        wid = msg["worker_id"]
+        self._drop_task_lease(wid)
+        w = self.workers.get(wid)
+        if w is not None:
+            await self._return_leased_worker(w)
+        return True
+
+    async def _h_record_tasks(self, conn, msg):
+        """Post-hoc records for direct-pushed tasks: lineage (so evicted
+        results reconstruct through the normal scheduler) + observability
+        (state API / timeline). Best-effort and batched, like the
+        reference's task event buffer (task_event_buffer.h ->
+        gcs_task_manager.h:61)."""
+        for r in msg["records"]:
+            spec = r["spec"]
+            rec = self.tasks.get(spec["task_id"])
+            if rec is None:
+                rec = TaskRecord(
+                    spec=spec,
+                    resources=spec.get("resources") or {"CPU": 1.0},
+                )
+                self.tasks[spec["task_id"]] = rec
+            rec.node_id = r.get("node_id")
+            rec.worker_id = r.get("worker_id")
+            rec.retries_left = spec.get("max_retries", 0)
+            rec.mark(r["state"])
+            for oid in spec["return_ids"]:
+                self.object_lineage[oid] = spec["task_id"]
+        return True
 
     async def _h_get_objects(self, conn, msg):
         ids: List[str] = msg["object_ids"]
@@ -1448,6 +1676,15 @@ class Head:
         await self._fail_backlog(rec)
         return True
 
+    def _adopt_actor_resources(self, rec: ActorRecord, node_id: str) -> None:
+        """Charge a re-adopted (head-restart survivor) actor against its
+        node's availability — the inverse of _release_actor_node."""
+        node = self.nodes.get(node_id)
+        if node is None or rec.node_acquired:
+            return
+        _acquire(node.available, dict(rec.spec.get("resources") or {}))
+        rec.node_acquired = True
+
     def _release_actor_node(self, rec: ActorRecord, w: Optional[WorkerRecord]):
         """Idempotently return an actor's acquired node resources
         (node_acquired guards double release across the kill and
@@ -1623,6 +1860,49 @@ class Head:
         node = self.nodes.get(msg["node_id"])
         if node is not None:
             node.load_report = msg["report"]
+            self._record_node_history(msg["node_id"], msg["report"])
+
+    def _record_node_history(self, node_id: str, report: dict) -> None:
+        """Bounded per-node time series feeding the dashboard's resource
+        sparklines (reference: dashboard/modules/reporter metrics)."""
+        hist = self.node_history.get(node_id)
+        if hist is None:
+            hist = self.node_history[node_id] = collections.deque(maxlen=150)
+        hist.append(
+            {
+                "ts": report.get("ts", time.time()),
+                "load_1m": report.get("load_1m"),
+                "mem_frac": (
+                    report.get("mem_used", 0) / report["mem_total"]
+                    if report.get("mem_total")
+                    else None
+                ),
+                "workers": report.get("workers"),
+            }
+        )
+
+    async def _h_node_history(self, conn, msg):
+        # the head node has no agent reporting for it: sample locally on
+        # each poll (dashboard ticks ~2s — plenty for a sparkline)
+        try:
+            from .memory_monitor import MemoryMonitor
+
+            used, total = MemoryMonitor().sample()
+            self._record_node_history(
+                self._head_node_id,
+                {
+                    "ts": time.time(),
+                    "load_1m": os.getloadavg()[0],
+                    "mem_used": used,
+                    "mem_total": total,
+                    "workers": sum(
+                        1 for w in self.workers.values() if w.state != "dead"
+                    ),
+                },
+            )
+        except Exception:
+            pass
+        return {nid: list(h) for nid, h in self.node_history.items()}
 
     async def _h_nodes(self, conn, msg):
         return [
@@ -2366,6 +2646,7 @@ class Head:
             return
         was_actor = w.actor_id
         w.state = "dead"
+        self._drop_task_lease(w.worker_id)  # frees the lease's node share
         if w.worker_id in self.idle_workers[w.node_id]:
             self.idle_workers[w.node_id].remove(w.worker_id)
         # actor restart path

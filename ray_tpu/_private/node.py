@@ -46,9 +46,28 @@ def default_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str,
     return out
 
 
+def _snapshot_session_id(path: str):
+    """The session id recorded in a head snapshot (None if unreadable)."""
+    import pickle
+
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f).get("session_id")
+    except Exception:
+        return None
+
+
 class Node:
     def __init__(self, resources: Dict[str, float]):
         self.session_id = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+        if cfg.head_restore_path:
+            # restoring = resuming the SAME logical cluster: adopt the
+            # snapshot's session id so surviving agents/workers (whose shm
+            # planes, scratch dirs and sockets are keyed by session)
+            # re-register instead of being orphaned
+            sid = _snapshot_session_id(cfg.head_restore_path)
+            if sid:
+                self.session_id = sid
         self.session_dir = os.path.join(cfg.session_dir_root, self.session_id)
         os.makedirs(self.session_dir, exist_ok=True)
         self.socket_path = os.path.join(self.session_dir, "head.sock")

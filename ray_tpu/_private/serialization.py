@@ -169,9 +169,17 @@ def materialize(env: SerializedObject, shm_client) -> SerializedObject:
         for buf in missing:
             by_node.setdefault(buf.node or "", []).append(buf.name)
         for node, names in by_node.items():
-            got = global_worker.request(
-                {"t": "fetch_buffers", "names": names, "node": node}
-            )
+            # bulk plane first: chunked pull straight from the owning
+            # node's agent (object_manager.h:117); the head relay is the
+            # fallback (and the only path for head-owned buffers, where
+            # the head IS the owner)
+            got = None
+            if node and node != my_node:
+                got = global_worker.fetch_buffers_direct(node, names)
+            if got is None:
+                got = global_worker.request(
+                    {"t": "fetch_buffers", "names": names, "node": node}
+                )
             for name, data in got.items():
                 if data is None:
                     raise ObjectLostError(name)

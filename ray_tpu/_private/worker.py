@@ -68,6 +68,32 @@ class _ArgRef:
     object_id: str
 
 
+def _bulk_read(sock, name: str):
+    """One buffer off the bulk plane: op READ(2) + name -> <q size> + raw
+    bytes, received straight into a preallocated buffer (recv_into — no
+    framing, no pickle; see Agent._start_buffer_server for the wire)."""
+    import struct
+
+    nb = name.encode()
+    sock.sendall(struct.pack("<BQ", 2, len(nb)) + nb)
+    hdr = _recv_exact_into(sock, bytearray(8))
+    (size,) = struct.unpack("<q", hdr)
+    if size < 0:
+        return None
+    return _recv_exact_into(sock, bytearray(size))
+
+
+def _recv_exact_into(sock, buf: bytearray) -> bytearray:
+    view = memoryview(buf)
+    got = 0
+    while got < len(buf):
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("bulk-plane peer closed mid-buffer")
+        got += n
+    return buf
+
+
 async def _swallow_conn_errors(coro):
     """Fire-and-forget sends: a connection torn down mid-send (shutdown,
     worker death) must not leave an unretrieved-exception future."""
@@ -103,10 +129,40 @@ class _ActorChannel:
     def __init__(self, worker: "Worker", actor_id: str):
         self.worker = worker
         self.actor_id = actor_id
-        self.queue: asyncio.Queue = asyncio.Queue()
+        # thread-safe FIFO: callers append directly (visible immediately —
+        # closes the submit/stash ordering race a call_soon-deferred
+        # asyncio.Queue.put would open) and wake the consumer via the loop
+        self.deque: "collections.deque" = collections.deque()
+        self._more = asyncio.Event()
         self.conn: Optional[protocol.Connection] = None
+        self.direct_addr: Optional[str] = None  # for the sync bypass socket
         self.head_routed = False  # permanent fallback: order must not mix
+        self.inflight = 0  # direct calls sent, reply not yet settled
+        # sync-bypass stash: at most ONE deferred call (see Worker.get's
+        # bypass path); guarded by worker._stash_lock
+        self.stashed: Optional[dict] = None
         self.task = asyncio.get_running_loop().create_task(self._consume())
+
+    def wake(self):
+        self._more.set()
+
+    def claim_stash(self, spec: Optional[dict] = None) -> Optional[dict]:
+        """Atomically take the stashed call (or `spec` specifically).
+        Returns it, or None if absent/already claimed."""
+        with self.worker._stash_lock:
+            s = self.stashed
+            if s is None or (spec is not None and s is not spec):
+                return None
+            self.stashed = None
+            for oid in s["return_ids"]:
+                self.worker._stash_by_oid.pop(oid, None)
+            return s
+
+    def busy(self) -> bool:
+        """True when ANY call is queued, stashed, or in flight — the sync
+        bypass may only run when the channel is completely quiet (worker-
+        side execution order must match submission order)."""
+        return bool(self.deque) or self.inflight > 0 or self.stashed is not None
 
     async def _resolve(self) -> Optional[str]:
         """Poll the head until the actor is alive (with an address) or dead.
@@ -160,27 +216,18 @@ class _ActorChannel:
 
         self.conn = protocol.Connection(reader, writer, handler)
         self.conn.start()
+        self.direct_addr = addr  # the sync bypass dials the same endpoint
         return True
 
     async def _resolve_deps(self, spec: dict) -> dict:
-        resolved = {}
-        missing = []
-        for oid in spec.get("deps", []):
-            env = self.worker._local_objects.get(oid)
-            if env is not None:
-                resolved[oid] = env
-            else:
-                missing.append(oid)
-        if missing:
-            envs = await self.worker.conn.request(
-                {"t": "get_objects", "object_ids": missing}
-            )
-            resolved.update(dict(zip(missing, envs)))
-        return resolved
+        return await _resolve_spec_deps(self.worker, spec)
 
     async def _consume(self):
         while True:
-            spec = await self.queue.get()
+            while not self.deque:
+                self._more.clear()
+                await self._more.wait()
+            spec = self.deque.popleft()
             if spec is None:
                 return
             try:
@@ -197,7 +244,12 @@ class _ActorChannel:
             self.head_routed = True
             self._to_head(spec)
             return
-        resolved = await self._resolve_deps(spec)
+        self.inflight += 1
+        try:
+            resolved = await self._resolve_deps(spec)
+        except BaseException:
+            self.inflight -= 1
+            raise
         msg = {
             "t": "run_task",
             "task_id": spec["task_id"],
@@ -214,7 +266,18 @@ class _ActorChannel:
     async def _finish(self, spec: dict, msg: dict, fut):
         """Collect the reply and settle the return objects. MUST terminate
         every return id one way or another — a get() may be blocked on the
-        local pending event with no timeout."""
+        local pending event with no timeout.
+
+        inflight is decremented BEFORE the result is cached: caching wakes
+        the caller, and the caller's next submit must see a quiet channel
+        (inflight==0) or the sync bypass never engages."""
+        settled = [False]
+
+        def settle():
+            if not settled[0]:
+                settled[0] = True
+                self.inflight -= 1
+
         try:
             try:
                 reply = await fut
@@ -226,6 +289,7 @@ class _ActorChannel:
                 # on death; only max_task_retries opts into replays). Later
                 # calls reconnect to the restarted actor via a fresh route.
                 self.conn = None
+                settle()
                 await self._fail_returns(spec, f"worker died mid-call: {e!r}")
                 return
             for _ in range(3):
@@ -239,29 +303,34 @@ class _ActorChannel:
                     {"t": "reconstruct_objects", "object_ids": lost}
                 )
                 if not all(ok.get(oid) for oid in lost):
+                    settle()
                     await self._fail_returns(spec, f"lost deps {lost} unrecoverable")
                     return
+                # stale local envelopes point at the EVICTED buffers; the
+                # head holds the reconstructed ones
+                self.worker._invalidate_local(lost)
                 msg["args"] = {
                     "env": spec["args"],
                     "resolved": await self._resolve_deps(spec),
                 }
                 reply = await self.conn.request(msg)
             if "results" not in reply:
+                settle()
                 await self._fail_returns(spec, f"bad reply {list(reply)}")
                 return
             envs = reply["results"]
+            settle()  # BEFORE caching: caching wakes the caller (see above)
             for oid, env in zip(spec["return_ids"], envs):
                 self.worker._cache_local_object(oid, env)
-                await self.worker.conn.send(
-                    {"t": "put_object", "object_id": oid, "envelope": env,
-                     "initial_refs": 1}
-                )
+                self.worker._enqueue_put(oid, env)
         except Exception as e:  # never leave pending events unsettled
+            settle()
             try:
                 await self._fail_returns(spec, f"direct call failed: {e!r}")
             except Exception:
                 self.worker._release_pending(spec["return_ids"])
         finally:
+            settle()
             # deps stay pinned until the actor has consumed (or we failed)
             await self._release_deps(spec)
 
@@ -272,10 +341,7 @@ class _ActorChannel:
         err.is_error = True
         for oid in spec["return_ids"]:
             self.worker._cache_local_object(oid, err)
-            await self.worker.conn.send(
-                {"t": "put_object", "object_id": oid, "envelope": err,
-                 "initial_refs": 1}
-            )
+            self.worker._enqueue_put(oid, err)
 
     def _to_head(self, spec: dict):
         # release get() waiters: the result will come via the head, not the
@@ -285,9 +351,9 @@ class _ActorChannel:
             loop = asyncio.get_running_loop()
             # the head takes the caller's +1 at submit (the direct path
             # skipped it; head-path results don't carry it in put_object)
-            loop.create_task(
+            loop.create_task(_swallow_conn_errors(
                 self.worker.conn.send({"t": "submit_actor_task", "spec": spec})
-            )
+            ))
             # release the direct-path dep pins AFTER the submit lands (the
             # handler pins deps synchronously on arrival)
             loop.create_task(self._release_deps(spec))
@@ -297,16 +363,382 @@ class _ActorChannel:
     async def _release_deps(self, spec: dict):
         """Idempotent release of the dep refs taken at direct submit (both
         the direct send and the head fallback funnel through here)."""
-        if spec.get("deps") and not spec.get("_deps_released"):
-            spec["_deps_released"] = True
-            await self.worker.conn.send(
-                {"t": "remove_refs", "counts": {d: 1 for d in spec["deps"]}}
-            )
+        await _release_spec_deps(self.worker, spec)
 
     async def close(self):
         self.task.cancel()
+        # un-stash so a flush timer firing later finds nothing
+        self.claim_stash()
         if self.conn is not None:
             await self.conn.close()
+
+    def flush_stale_stash(self, now: float) -> bool:
+        """(io loop, via the sweeper) flush an unclaimed stash to the
+        ordered queue — `remote()` without a matching get must still
+        execute (side effects)."""
+        s = self.stashed
+        if s is None or now - s.get("_stash_t", now) < 0.008:
+            return False
+        s = self.claim_stash(s)
+        if s is None:
+            return False
+        self.deque.append(s)
+        self.wake()
+        return True
+
+
+class _TaskLease:
+    """One granted worker lease (direct_task_transport.cc:191): a direct
+    connection to a leased worker, reused across tasks until idle."""
+
+    __slots__ = ("worker_id", "node_id", "conn", "inflight", "last_used")
+
+    def __init__(self, worker_id: str, node_id: str, conn):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn = conn
+        self.inflight = 0
+        self.last_used = 0.0
+
+
+class _TaskChannel:
+    """Per-resource-shape direct transport for NORMAL tasks. Reference
+    parity: CoreWorkerDirectTaskSubmitter (direct_task_transport.cc:588) —
+    the caller asks the head for a worker LEASE, then pushes task specs
+    straight to that worker and reuses the lease across tasks (:191). The
+    head stays out of the per-task path entirely: results ride back inline,
+    are forwarded in BATCHES to the head's object directory, and post-hoc
+    task records (batched) keep lineage + observability intact.
+
+    Leases grow up to cfg.direct_task_max_leases while every held lease is
+    busy (parallelism parity with head dispatch); idle leases are released
+    after cfg.task_lease_idle_ms so capacity returns to the cluster."""
+
+    def __init__(self, worker: "Worker", resources: Dict[str, float]):
+        self.worker = worker
+        self.resources = resources
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.leases: List[_TaskLease] = []
+        self._acquiring = 0  # in-flight lease requests
+        self._no_lease_until = 0.0
+        self.max_leases = max(1, cfg.direct_task_max_leases)
+        self._wake = asyncio.Event()  # set on task finish / lease grant
+        loop = asyncio.get_running_loop()
+        self.task = loop.create_task(self._consume())
+        self._reaper = loop.create_task(self._idle_reaper())
+
+    async def _consume(self):
+        while True:
+            spec = await self.queue.get()
+            if spec is None:
+                return
+            try:
+                await self._dispatch(spec)
+            except Exception:
+                logger.exception("direct task dispatch failed; routing via head")
+                self._to_head(spec)
+
+    async def _resolve_then_requeue(self, spec: dict):
+        """Dependency wait OFF the dispatch path and WITHOUT holding a
+        lease (reference: direct_task_transport resolves dependencies
+        BEFORE requesting a worker lease). Parking with a lease held
+        deadlocks: N dep-blocked tasks can pin every lease — and the
+        cluster capacity behind them — while their producer tasks wait for
+        that same capacity."""
+        try:
+            spec["_resolved"] = await _resolve_spec_deps(self.worker, spec)
+        except Exception:
+            logger.exception("dep resolution failed; routing via head")
+            self._to_head(spec)
+            return
+        self.queue.put_nowait(spec)
+
+    async def _dispatch(self, spec: dict):
+        """One task per lease at a time (reference: a granted lease runs a
+        single task; parallelism comes from MULTIPLE leases). Growth is
+        launched in parallel for the visible backlog; when every lease is
+        busy and growth is exhausted, wait for a completion — and after
+        sustained saturation hand the spec to the head, which owns queuing."""
+        if spec.get("deps") and "_resolved" not in spec:
+            # park dep waits concurrently; ready specs re-enter the queue
+            asyncio.get_running_loop().create_task(
+                self._resolve_then_requeue(spec)
+            )
+            return
+        loop = asyncio.get_running_loop()
+        saturated_since = None
+        while True:
+            # head connection down (crash + restart window): hold the spec —
+            # a _to_head fallback would silently drop it on the dead conn.
+            # The caller's next sync request() performs the reconnect.
+            while self.worker.conn is None or self.worker.conn.closed:
+                if not self.worker.connected:
+                    return  # disconnected for real; get() waiters released
+                if not await self.worker._reconnect_async():
+                    await asyncio.sleep(0.3)
+            lease = self._pick_lease()
+            if lease is not None and lease.inflight == 0:
+                await self._submit_one(lease, spec)
+                return
+            room = self.max_leases - len(self.leases) - self._acquiring
+            if room > 0 and loop.time() >= self._no_lease_until:
+                want = min(self.queue.qsize() + 1, room)
+                for _ in range(want):
+                    self._acquiring += 1
+                    loop.create_task(self._acquire())
+            if lease is None and self._acquiring == 0:
+                self._to_head(spec)  # no lease obtainable: head queues it
+                return
+            if saturated_since is None:
+                saturated_since = loop.time()
+            elif loop.time() - saturated_since > 1.0:
+                # long-running tasks hold every lease; the head may have
+                # capacity beyond our lease cap — let it schedule/queue
+                self._to_head(spec)
+                return
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), 0.1)
+            except asyncio.TimeoutError:
+                pass
+
+    def _pick_lease(self) -> Optional[_TaskLease]:
+        live = [l for l in self.leases if l.conn is not None and not l.conn.closed]
+        self.leases = live
+        return min(live, key=lambda l: l.inflight, default=None)
+
+    async def _acquire(self):
+        grant = None
+        try:
+            grant = await self.worker.conn.request(
+                {"t": "request_task_lease", "resources": self.resources}
+            )
+            loop = asyncio.get_running_loop()
+            if not grant:
+                self._no_lease_until = loop.time() + 0.2
+                return
+            addr = grant["address"]
+            if not protocol.is_tcp_address(addr) and (
+                grant["node_id"] != self.worker.node_id
+            ):
+                # unix socket on another machine: un-dialable from here
+                await self._give_back(grant)
+                grant = None
+                self._no_lease_until = loop.time() + 5.0
+                return
+
+            async def handler(msg):
+                raise ValueError("unexpected push on task lease connection")
+
+            reader, writer = await protocol.open_stream(addr)
+            conn = protocol.Connection(reader, writer, handler)
+            conn.start()
+            lease = _TaskLease(grant["worker_id"], grant["node_id"], conn)
+            lease.last_used = loop.time()
+            self.leases.append(lease)
+            grant = None  # owned by the lease now
+        except Exception:
+            # a granted-but-undialable lease MUST go back: leaking it leaves
+            # the head holding the worker busy + its node share allocated
+            if grant is not None:
+                await self._give_back(grant)
+            self._no_lease_until = asyncio.get_running_loop().time() + 0.2
+        finally:
+            self._acquiring -= 1
+            self._wake.set()
+
+    async def _give_back(self, grant: dict):
+        try:
+            await self.worker.conn.send(
+                {"t": "release_task_lease", "worker_id": grant["worker_id"]}
+            )
+        except Exception:
+            pass  # conn died; the head reclaims leases on conn close
+
+    async def _submit_one(self, lease: _TaskLease, spec: dict):
+        loop = asyncio.get_running_loop()
+        # claim the lease synchronously (no await before the send): the
+        # idle reaper must never see inflight==0 between pick and send —
+        # it would close the conn under this task
+        lease.inflight += 1
+        lease.last_used = loop.time()
+        resolved = spec.pop("_resolved", None) or {}
+        msg = {
+            "t": "run_task",
+            "task_id": spec["task_id"],
+            "fn_key": spec["fn_key"],
+            "args": {"env": spec["args"], "resolved": resolved},
+            "return_ids": spec["return_ids"],
+            "trace_ctx": spec.get("trace_ctx"),
+        }
+        # record RUNNING at dispatch (batched): the head's observability —
+        # and its OOM killing policy, which picks victims among running
+        # tasks — must see direct-pushed tasks while they execute
+        self.worker._enqueue_task_record(
+            spec, "running", lease.worker_id, lease.node_id
+        )
+        fut = loop.create_task(lease.conn.request(msg))
+        loop.create_task(self._finish(lease, spec, msg, fut))
+
+    async def _finish(self, lease: _TaskLease, spec: dict, msg: dict, fut):
+        """Settle every return id exactly once (a get() may be parked on
+        the local pending event)."""
+        try:
+            try:
+                reply = await fut
+            except Exception:
+                # Lease broke mid-task (worker death): the task MAY have
+                # executed. Reference semantics: rerun only when the user
+                # opted into retries (max_retries), else WorkerCrashedError.
+                lease.conn = None
+                used = spec.get("_retries_used", 0)
+                if used < spec.get("max_retries", 0):
+                    spec["_retries_used"] = used + 1
+                    spec.pop("_resolved", None)  # deps re-resolve fresh
+                    # requeue on OUR channel (with retry accounting), NOT
+                    # _to_head: worker deaths cluster with head outages,
+                    # and a send on a dead head conn drops the spec; the
+                    # dispatch loop holds specs through reconnection
+                    self.queue.put_nowait(spec)
+                else:
+                    await self._fail_returns(spec, "worker died mid-task")
+                return
+            for _ in range(3):
+                lost = reply.get("lost_deps")
+                if not lost:
+                    break
+                # dep buffers evicted before execution: user code never ran,
+                # resend (same lease) is side-effect free
+                ok = await self.worker.conn.request(
+                    {"t": "reconstruct_objects", "object_ids": lost}
+                )
+                if not all(ok.get(oid) for oid in lost):
+                    await self._fail_returns(spec, f"lost deps {lost} unrecoverable")
+                    return
+                # stale local envelopes point at the EVICTED buffers; the
+                # head holds the reconstructed ones
+                self.worker._invalidate_local(lost)
+                msg["args"] = {
+                    "env": spec["args"],
+                    "resolved": await _resolve_spec_deps(self.worker, spec),
+                }
+                reply = await lease.conn.request(msg)
+            if "results" not in reply:
+                await self._fail_returns(spec, f"bad reply {list(reply)}")
+                return
+            for oid, env in zip(spec["return_ids"], reply["results"]):
+                self.worker._cache_local_object(oid, env)
+                self.worker._enqueue_put(oid, env)
+            self.worker._enqueue_task_record(
+                spec, "done", lease.worker_id, lease.node_id
+            )
+        except Exception as e:
+            try:
+                await self._fail_returns(spec, f"direct task failed: {e!r}")
+            except Exception:
+                self.worker._release_pending(spec["return_ids"])
+        finally:
+            lease.inflight -= 1
+            lease.last_used = asyncio.get_running_loop().time()
+            self._wake.set()  # the dispatcher may be waiting for a free lease
+            await _release_spec_deps(self.worker, spec)
+
+    async def _fail_returns(self, spec: dict, reason: str):
+        from ..exceptions import WorkerCrashedError
+
+        err = serialization.serialize(
+            WorkerCrashedError(f"task {spec['task_id']}: {reason}")
+        )
+        err.is_error = True
+        for oid in spec["return_ids"]:
+            self.worker._cache_local_object(oid, err)
+            self.worker._enqueue_put(oid, err)
+        self.worker._enqueue_task_record(spec, "failed", None, None)
+
+    def _to_head(self, spec: dict):
+        # the head resolves deps itself: shipping pre-resolved envelopes
+        # would bloat the socket + the head's stored TaskRecord
+        spec.pop("_resolved", None)
+        # the head takes the caller's +1 at submit; release local waiters so
+        # get() routes through the head
+        self.worker._release_pending(spec["return_ids"])
+        try:
+            loop = asyncio.get_running_loop()
+            loop.create_task(_swallow_conn_errors(
+                self.worker.conn.send({"t": "submit_task", "spec": spec})
+            ))
+            loop.create_task(_release_spec_deps(self.worker, spec))
+        except Exception:
+            pass
+
+    async def _idle_reaper(self):
+        idle_s = cfg.task_lease_idle_ms / 1000.0
+        while True:
+            await asyncio.sleep(max(idle_s / 2, 0.05))
+            now = asyncio.get_running_loop().time()
+            # retire WITHOUT awaiting between the idle check and removal
+            # from self.leases: an await there would let the dispatcher
+            # submit onto a lease this loop is about to close
+            retiring: List[_TaskLease] = []
+            keep: List[_TaskLease] = []
+            for lease in self.leases:
+                if lease.conn is None or lease.conn.closed:
+                    continue
+                if lease.inflight == 0 and now - lease.last_used > idle_s:
+                    retiring.append(lease)
+                else:
+                    keep.append(lease)
+            self.leases = keep
+            for lease in retiring:
+                try:
+                    await self.worker.conn.send(
+                        {"t": "release_task_lease", "worker_id": lease.worker_id}
+                    )
+                except Exception:
+                    pass
+                await lease.conn.close()
+
+    async def close(self):
+        self.task.cancel()
+        self._reaper.cancel()
+        for lease in self.leases:
+            if lease.conn is not None:
+                try:
+                    await lease.conn.close()
+                except Exception:
+                    pass
+        self.leases = []
+
+
+async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
+    """Resolve dep envelopes for a direct push (local cache first, head
+    for the rest) — shared by the actor and task direct channels."""
+    resolved = {}
+    missing = []
+    for oid in spec.get("deps", []):
+        env = worker._local_objects.get(oid)
+        if env is not None:
+            resolved[oid] = env
+        else:
+            missing.append(oid)
+    if missing:
+        envs = await worker.conn.request(
+            {"t": "get_objects", "object_ids": missing}
+        )
+        resolved.update(dict(zip(missing, envs)))
+    return resolved
+
+
+async def _release_spec_deps(worker: "Worker", spec: dict):
+    """Idempotent release of the dep refs taken at direct submit."""
+    if spec.get("deps") and not spec.get("_deps_released"):
+        spec["_deps_released"] = True
+        try:
+            await worker.conn.send(
+                {"t": "remove_refs", "counts": {d: 1 for d in spec["deps"]}}
+            )
+        except Exception:
+            pass  # conn died (shutdown/head restart); refs reconcile later
 
 
 class Worker:
@@ -339,6 +771,30 @@ class Worker:
         # envelopes (bounded; the head's ObjectDirectory stays the source of
         # truth for every other process)
         self._actor_channels: Dict[str, _ActorChannel] = {}
+        # bulk plane: per-node blocking sockets to peer agents' buffer
+        # servers (object_manager.h:117 — object bytes move node-to-node,
+        # the head only resolves locations)
+        self._peer_conns: Dict[str, Any] = {}
+        self._peer_sock_locks: Dict[str, threading.Lock] = {}
+        self._peer_lock = threading.Lock()
+        # direct normal-task channels keyed by resource shape
+        # (direct_task_transport.cc:588) + batched head forwards (io-loop
+        # state only)
+        self._task_channels: Dict[Any, _TaskChannel] = {}
+        self._put_batch: Dict[str, Any] = {}  # oid -> envelope (un-flushed)
+        self._record_batch: List[dict] = []
+        self._flush_handle = None
+        # sync-bypass state: stashed (deferred) actor calls by return id +
+        # per-thread blocking sockets to actor workers
+        self._stash_lock = threading.Lock()
+        self._stash_by_oid: Dict[str, Tuple[Any, dict]] = {}
+        self._bypass_local = threading.local()
+        self._batch_lock = threading.Lock()  # _put/_record/_ref batches
+        self._ref_batch: Dict[str, int] = {}
+        self._sweeper_on = False
+        self._sweeper_loop = None
+        self._sweep_task = None
+        self._reconnecting = False
         self._local_objects: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
         # in-flight direct calls: return id -> Event set when the reply
         # lands locally (get() waits here instead of round-tripping the head)
@@ -359,6 +815,14 @@ class Worker:
             ev = self._local_pending.pop(oid, None)
         if ev is not None:
             ev.set()
+
+    def _invalidate_local(self, oids) -> None:
+        """Drop stale locally-cached envelopes (e.g. after their shm
+        buffers were evicted + reconstructed: the head now holds fresh
+        envelopes; the local copies point at dead buffers)."""
+        with self._local_lock:
+            for oid in oids:
+                self._local_objects.pop(oid, None)
 
     def _release_pending(self, oids) -> None:
         with self._local_lock:
@@ -432,6 +896,7 @@ class Worker:
             None if protocol.is_tcp_address(socket_path) else os.path.dirname(socket_path)
         )
         self.namespace = namespace
+        self._remote_address = socket_path  # reconnect target (head restart)
         self.conn = self.io.run(self._open_conn(socket_path))
         info = self.request(
             {"t": "register_driver", "proto": protocol.PROTOCOL_VERSION}
@@ -546,8 +1011,243 @@ class Worker:
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
         if not self.conn or self.conn.closed:
-            raise exceptions.RayTpuError("ray_tpu is not connected (call ray_tpu.init())")
+            # a remote driver whose head connection dropped (head crash +
+            # restart-from-snapshot) re-registers at the same address
+            # (reference: GCS reconnect, gcs_server.cc:130-178)
+            if not self._try_reconnect():
+                raise exceptions.RayTpuError(
+                    "ray_tpu is not connected (call ray_tpu.init())"
+                )
         return self.io.run(self.conn.request(msg, timeout))
+
+    def _try_reconnect(self) -> bool:
+        if self.io is None:
+            return False
+        try:
+            return self.io.run(self._reconnect_async())
+        except Exception:
+            return False
+
+    async def _reconnect_async(self) -> bool:
+        """(io loop) redial + re-register against the head address. Used by
+        the sync request() path AND proactively by channel consumers — the
+        sync bypass can keep actor calls flowing with the head DOWN, so a
+        user-thread request is not guaranteed to ever trigger reconnect."""
+        if self._reconnecting:
+            while self._reconnecting:  # single dialer; others wait on it
+                await asyncio.sleep(0.2)
+            return self.conn is not None and not self.conn.closed
+        addr = getattr(self, "_remote_address", None)
+        if not (self.connected and addr):
+            return False
+        self._reconnecting = True
+        try:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + cfg.head_reconnect_timeout_s
+            while loop.time() < deadline and self.connected:
+                try:
+                    conn = await self._open_conn(addr)
+                    info = await conn.request(
+                        {"t": "register_driver",
+                         "proto": protocol.PROTOCOL_VERSION},
+                        10,
+                    )
+                except Exception:
+                    await asyncio.sleep(0.5)
+                    continue
+                self.conn = conn
+                self.node_id = info["node_id"]
+                # a restarted head restores fn/cls exports from its
+                # snapshot; clearing the memo keeps us correct even when
+                # it could not
+                self._fn_exported.clear()
+                logger.warning("reconnected to head at %s", addr)
+                return True
+            return False
+        finally:
+            self._reconnecting = False
+
+    # ------------------------------------------------------------------
+    # batched head forwards (io-loop only; reference: task_event_buffer.h
+    # batching — one head message per flush window, not per call)
+    # ------------------------------------------------------------------
+
+    def _enqueue_put(self, oid: str, env) -> None:
+        """Thread-safe: io-loop producers (channel _finish) AND caller
+        threads (sync bypass) append; the io loop flushes."""
+        with self._batch_lock:
+            self._put_batch[oid] = env
+            n = len(self._put_batch) + len(self._record_batch)
+        if threading.current_thread() is self.io.thread:
+            self._schedule_flush(n)
+        else:
+            self._ensure_sweeper()
+
+    def _enqueue_task_record(self, spec: dict, state: str, worker_id, node_id) -> None:
+        with self._batch_lock:
+            self._record_batch.append(
+                {"spec": spec, "state": state, "worker_id": worker_id,
+                 "node_id": node_id}
+            )
+            n = len(self._put_batch) + len(self._record_batch)
+        if threading.current_thread() is self.io.thread:
+            self._schedule_flush(n)
+        else:
+            self._ensure_sweeper()
+
+    def _schedule_flush(self, n: int) -> None:
+        if n >= 128:
+            if self._flush_handle is not None:
+                self._flush_handle.cancel()
+                self._flush_handle = None
+            asyncio.ensure_future(self._flush_batches())
+            return
+        if self._flush_handle is None:
+            loop = asyncio.get_running_loop()
+            self._flush_handle = loop.call_later(
+                0.002, lambda: asyncio.ensure_future(self._flush_batches())
+            )
+
+    def _ensure_sweeper(self) -> None:
+        """(any thread) make sure the io-loop sweeper is ticking. The
+        sweeper amortizes caller-thread -> io-loop wakeups: the sync bypass
+        produces a stash + a result forward PER CALL, and a call_soon wake
+        for each would cost more than the bypass saves. One flag check per
+        call, one loop wake per sweeper lifetime."""
+        # the flag is only trustworthy for the CURRENT io loop: a previous
+        # session's loop may have died before the sweeper's finally ran,
+        # leaving the flag stuck True forever (symptom: stashes/batches
+        # never flush after re-init)
+        if self._sweeper_on and self._sweeper_loop is self.io.loop:
+            return
+        self._sweeper_on = True
+        self._sweeper_loop = self.io.loop
+
+        def _start():
+            self._sweep_task = asyncio.ensure_future(self._sweep())
+
+        try:
+            self.io.loop.call_soon_threadsafe(_start)
+        except RuntimeError:  # loop shut down
+            self._sweeper_on = False
+
+    async def _sweep(self):
+        try:
+            idle_ticks = 0
+            while idle_ticks < 12:  # ~100ms of quiet, then stand down
+                await asyncio.sleep(0.008)
+                did = False
+                now = time.monotonic()
+                for ch in list(self._actor_channels.values()):
+                    if ch.flush_stale_stash(now):
+                        did = True
+                with self._batch_lock:
+                    pending = bool(
+                        self._put_batch or self._record_batch or self._ref_batch
+                    )
+                if pending:
+                    await self._flush_batches()
+                    did = True
+                idle_ticks = 0 if did else idle_ticks + 1
+        finally:
+            self._sweeper_on = False
+            # close the stand-down race: a producer that enqueued between
+            # this sweep's last check and the flag reset saw _sweeper_on
+            # True and did not wake the loop — re-arm if anything is pending
+            if self.connected:
+                with self._batch_lock:
+                    pending = bool(
+                        self._put_batch or self._record_batch or self._ref_batch
+                    )
+                if pending or any(
+                    ch.stashed is not None
+                    for ch in self._actor_channels.values()
+                ):
+                    self._ensure_sweeper()
+
+    async def _flush_batches(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        with self._batch_lock:
+            puts, self._put_batch = list(self._put_batch.items()), {}
+            recs, self._record_batch = self._record_batch, []
+            refs, self._ref_batch = self._ref_batch, {}
+        if self.conn is None or self.conn.closed:
+            return
+        try:
+            # puts BEFORE records/refs: lineage entries must never point at
+            # task records whose results the head hasn't seen, and a remove
+            # must not precede the put carrying the caller's +1
+            if puts:
+                await self.conn.send({"t": "put_objects", "objects": puts})
+            if recs:
+                await self.conn.send({"t": "record_tasks", "records": recs})
+            if refs:
+                await self.conn.send({"t": "remove_refs", "counts": refs})
+        except Exception:
+            pass  # conn died; disconnect() settles local waiters
+
+    # ------------------------------------------------------------------
+    # bulk plane: direct node-to-node buffer pulls
+    # ------------------------------------------------------------------
+
+    def fetch_buffers_direct(self, node: str, names) -> Optional[dict]:
+        """Pull shm buffers STRAIGHT from the owning node's agent over a
+        raw blocking socket (streamed; reference: object_manager.h:117 /
+        pull_manager.h:52 — the head only resolves the location). Returns
+        None when no direct path exists or the pull fails midway (caller
+        falls back to the head relay)."""
+        try:
+            sock = self._peer_socket(node)
+            if sock is None:
+                return None
+            with self._peer_sock_locks[node]:
+                return {name: _bulk_read(sock, name) for name in names}
+        except Exception:
+            self._drop_peer_socket(node)
+            return None
+
+    def _peer_socket(self, node: str):
+        """Cached blocking socket to `node`'s bulk-plane listener; the
+        address is re-resolved on every (re)connect — a restarted agent
+        binds a new port."""
+        import socket as _socket
+
+        with self._peer_lock:
+            sock = self._peer_conns.get(node)
+            if sock is not None:
+                return sock
+            lock = self._peer_sock_locks.setdefault(node, threading.Lock())
+        addrs = self.request({"t": "buffer_addrs", "nodes": [node]}, timeout=30)
+        addr = addrs.get(node)
+        if not addr:
+            return None
+        host, _, port = addr.rpartition(":")
+        sock = _socket.socket()
+        try:
+            # deep receive buffer (set BEFORE connect so the window scales):
+            # amortizes sender/receiver scheduling ping-pong on busy hosts
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 8 * 1024 * 1024)
+        except OSError:
+            pass
+        sock.settimeout(120)
+        sock.connect((host, int(port)))
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        with self._peer_lock:
+            cur = self._peer_conns.setdefault(node, sock)
+        if cur is not sock:  # lost a connect race; keep the winner
+            sock.close()
+        return cur
+
+    def _drop_peer_socket(self, node: str):
+        with self._peer_lock:
+            sock = self._peer_conns.pop(node, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except Exception:
+                pass
 
     def send(self, msg: dict):
         if self.conn is None or self.conn.closed or self.io is None:
@@ -570,8 +1270,43 @@ class Worker:
         self.connected = False
         self.mode = None
         channels, self._actor_channels = dict(self._actor_channels), {}
+        tchannels, self._task_channels = dict(self._task_channels), {}
         if self.io is not None:
-            for ch in channels.values():
+            try:  # push batched result forwards out BEFORE resetting them
+                self.io.run(self._flush_batches(), timeout=2)
+            except Exception:
+                pass
+        # reset every cross-session transport bit: a stale flag/batch from
+        # this session must not leak into the next init. (Stashed calls that
+        # were never claimed are dropped here — shutdown beats fire-and-
+        # forget calls still inside the stash window, same as the reference
+        # dropping in-flight work at ray.shutdown.)
+        self._sweeper_on = False
+        self._sweeper_loop = None
+        self._reconnecting = False
+        self._remote_address = None
+        with self._stash_lock:
+            self._stash_by_oid.clear()
+        with self._batch_lock:
+            self._put_batch = {}
+            self._record_batch = []
+            self._ref_batch = {}
+        self._flush_handle = None
+        sweep_task, self._sweep_task = self._sweep_task, None
+        if sweep_task is not None and self.io is not None:
+            try:
+                self.io.loop.call_soon_threadsafe(sweep_task.cancel)
+            except RuntimeError:
+                pass
+        with self._peer_lock:
+            peers, self._peer_conns = dict(self._peer_conns), {}
+        for sock in peers.values():
+            try:
+                sock.close()
+            except Exception:
+                pass
+        if self.io is not None:
+            for ch in list(channels.values()) + list(tchannels.values()):
                 try:
                     self.io.run(ch.close(), timeout=2)
                 except Exception:
@@ -617,11 +1352,26 @@ class Worker:
         if self.connected:
             self.send({"t": "add_refs", "counts": {object_id: 1}})
 
-    def remove_object_ref(self, object_id: str):
+    def remove_object_ref(self, object_id: str, escaped: bool = True):
         with self._local_lock:
             self._local_objects.pop(object_id, None)
         if self.connected:
-            self.send({"t": "remove_refs", "counts": {object_id: 1}})
+            # batched: ObjectRef.__del__ fires once per call in steady
+            # state, and a per-del io-loop wake costs more than the call
+            with self._batch_lock:
+                if not escaped and object_id in self._put_batch:
+                    # the ref died before its result forward flushed AND was
+                    # never pickled: no other process can know the id. The
+                    # put (+1) and this remove (-1) cancel — drop BOTH and
+                    # the head never hears about the object at all.
+                    del self._put_batch[object_id]
+                    return
+                self._ref_batch[object_id] = self._ref_batch.get(object_id, 0) + 1
+                n = len(self._ref_batch)
+            if self.io is not None and threading.current_thread() is self.io.thread:
+                self._schedule_flush(n)
+            else:
+                self._ensure_sweeper()
 
     # ------------------------------------------------------------------
     # objects
@@ -648,6 +1398,96 @@ class Worker:
         )
         return ObjectRef(oid, skip_adding_local_ref=True)
 
+    def _bypass_sock(self, ch):
+        """Per-(thread, actor) blocking socket to the actor worker's direct
+        endpoint (the same one the async channel dials)."""
+        import socket as _socket
+
+        d = getattr(self._bypass_local, "socks", None)
+        if d is None:
+            d = self._bypass_local.socks = {}
+        sock = d.get(ch.actor_id)
+        if sock is None:
+            addr = ch.direct_addr
+            if protocol.is_tcp_address(addr):
+                host, _, port = addr.rpartition(":")
+                sock = _socket.create_connection((host, int(port)), timeout=60)
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            else:
+                sock = _socket.socket(_socket.AF_UNIX)
+                sock.settimeout(60)  # bounds CONNECT only (reset below)
+                sock.connect(addr)
+            # no recv deadline: like the async channel's conn.request, the
+            # reply arrives when the method finishes — a >60s method is
+            # healthy, not dead (worker death still surfaces as EOF)
+            sock.settimeout(None)
+            d[ch.actor_id] = sock
+        return sock
+
+    def _drop_bypass_sock(self, ch):
+        d = getattr(self._bypass_local, "socks", None)
+        sock = d.pop(ch.actor_id, None) if d else None
+        if sock is not None:
+            try:
+                sock.close()
+            except Exception:
+                pass
+
+    def _bypass_call(self, ch, spec: dict) -> None:
+        """Execute a claimed stashed call ON THE CALLER THREAD over a
+        blocking socket: no io-thread ping-pong, which on busy hosts costs
+        more than the wire (the sync half of VERDICT's actor-call target).
+        Settles every return id exactly once."""
+        import pickle as _pickle
+        import struct as _struct
+
+        msg = {
+            "t": "run_task",
+            "task_id": spec["task_id"],
+            "actor_id": ch.actor_id,
+            "method": spec["method"],
+            "args": {"env": spec["args"], "resolved": {}},
+            "return_ids": spec["return_ids"],
+            "trace_ctx": spec.get("trace_ctx"),
+            "rid": -1,
+        }
+        sent = False
+        try:
+            sock = self._bypass_sock(ch)
+            body = _pickle.dumps(msg, protocol=5)
+            sock.sendall(_struct.pack("<Q", len(body)) + body)
+            sent = True
+            hdr = _recv_exact_into(sock, bytearray(8))
+            (n,) = _struct.unpack("<Q", hdr)
+            reply = _pickle.loads(_recv_exact_into(sock, bytearray(n)))
+        except Exception:
+            self._drop_bypass_sock(ch)
+            if not sent:
+                # never reached the worker: the ordered channel can run it
+                # (it re-resolves the route, e.g. across an actor restart)
+                ch.deque.append(spec)
+                self.io.loop.call_soon_threadsafe(ch.wake)
+                return
+            self._bypass_fail(ch, spec, "worker died mid-call")
+            return
+        value = reply.get("value") if reply.get("ok") else None
+        if value is None or "results" not in value or value.get("lost_deps"):
+            err = reply.get("error")
+            self._bypass_fail(ch, spec, f"direct call failed: {err!r}")
+            return
+        for oid, env in zip(spec["return_ids"], value["results"]):
+            self._cache_local_object(oid, env)
+            self._enqueue_put(oid, env)  # thread-safe; sweeper flushes
+
+    def _bypass_fail(self, ch, spec: dict, reason: str):
+        from ..exceptions import ActorDiedError
+
+        err = serialization.serialize(ActorDiedError(ch.actor_id, reason))
+        err.is_error = True
+        for oid in spec["return_ids"]:
+            self._cache_local_object(oid, err)
+            self._enqueue_put(oid, err)
+
     def get(self, refs, timeout: Optional[float] = None):
         from ..object_ref import ObjectRef
 
@@ -656,6 +1496,29 @@ class Worker:
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        # sync bypass: stashed-at-submit calls run HERE on the caller thread
+        if self._stash_by_oid:
+            claimed = []
+            for r in ref_list:
+                with self._stash_lock:
+                    entry = self._stash_by_oid.get(r.id)
+                if entry is not None:
+                    s = entry[0].claim_stash(entry[1])
+                    if s is not None:
+                        claimed.append((entry[0], s))
+            if len(claimed) == 1 and timeout is None:
+                self._bypass_call(*claimed[0])
+            else:
+                # a bounded get() must honor `timeout`: the blocking bypass
+                # can't be interrupted, so route through the channel whose
+                # event-wait can.
+                # 2+ claims must PIPELINE: executing them serially here
+                # deadlocks when one call's completion depends on another
+                # (e.g. ranks of one collective) — hand them back to their
+                # ordered channels instead
+                for ch, s in claimed:
+                    ch.deque.append(s)
+                    self.io.loop.call_soon_threadsafe(ch.wake)
         # fast path: results of direct actor calls are cached locally (or in
         # flight — then wait on the local event) — no head round-trip for
         # the produce-then-get pattern
@@ -785,6 +1648,11 @@ class Worker:
         def conv(a):
             if isinstance(a, ObjectRef):
                 deps.append(a.id)
+                # the id escapes into a task spec WITHOUT the ref being
+                # pickled (no __reduce__): mark it escaped by hand, or its
+                # death could cancel the un-flushed result forward a
+                # dependent task is about to resolve against the head
+                a._escaped = True
                 return _ArgRef(a.id)
             return a
 
@@ -835,11 +1703,37 @@ class Worker:
             "scheduling_strategy": scheduling_strategy,
             "runtime_env": self.merged_runtime_env(runtime_env),
         }
-        # fire-and-forget (FIFO per connection): submission is
-        # serialization-bound, not RTT-bound; the head takes the caller's
-        # +1 on each return id when it processes the submit
-        self.send_ordered({"t": "submit_task", "spec": spec})
+        # Direct path (direct_task_transport.cc:588): push to a leased
+        # worker, head out of the per-task loop. Head path for anything the
+        # pooled-lease model can't serve: placement strategies, runtime
+        # envs, TPU workers (non-pooled).
+        if (
+            cfg.direct_task_calls
+            and scheduling_strategy is None
+            and not spec["runtime_env"]
+            and not (resources or {}).get("TPU")
+        ):
+            if deps:
+                self.send_ordered({"t": "add_refs", "counts": {d: 1 for d in deps}})
+            key = tuple(sorted((resources or {"CPU": 1.0}).items()))
+            with self._lock:
+                ch = self._task_channels.get(key)
+                if ch is None:
+                    ch = self.io.run(self._make_task_channel(resources or {"CPU": 1.0}))
+                    self._task_channels[key] = ch
+            with self._local_lock:
+                for oid in return_ids:
+                    self._local_pending[oid] = threading.Event()
+            self.io.loop.call_soon_threadsafe(ch.queue.put_nowait, spec)
+        else:
+            # fire-and-forget (FIFO per connection): submission is
+            # serialization-bound, not RTT-bound; the head takes the
+            # caller's +1 on each return id when it processes the submit
+            self.send_ordered({"t": "submit_task", "spec": spec})
         return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]
+
+    async def _make_task_channel(self, resources: Dict[str, float]) -> "_TaskChannel":
+        return _TaskChannel(self, dict(resources))
 
     # ------------------------------------------------------------------
     # actors
@@ -926,7 +1820,34 @@ class Worker:
             with self._local_lock:
                 for oid in return_ids:
                     self._local_pending[oid] = threading.Event()
-            self.io.loop.call_soon_threadsafe(ch.queue.put_nowait, spec)
+            # Sync bypass: on a completely quiet channel, DEFER the send —
+            # an immediately-following get() (the sync call pattern) runs
+            # the call on the CALLER thread over a blocking socket, skipping
+            # two io-thread handoffs per call. A timer flushes unclaimed
+            # stashes to the ordered queue so fire-and-forget still runs.
+            if (
+                not deps
+                and not ch.head_routed
+                and ch.direct_addr is not None
+                and not ch.busy()
+            ):
+                spec["_stash_t"] = time.monotonic()
+                with self._stash_lock:
+                    if ch.stashed is None and not ch.busy():
+                        ch.stashed = spec
+                        for oid in return_ids:
+                            self._stash_by_oid[oid] = (ch, spec)
+                        self._ensure_sweeper()  # bounds an unclaimed stash
+                        return [
+                            ObjectRef(oid, skip_adding_local_ref=True)
+                            for oid in return_ids
+                        ]
+            # ordered path: an unclaimed stash must flush FIRST (order)
+            flush = ch.claim_stash()
+            if flush is not None:
+                ch.deque.append(flush)
+            ch.deque.append(spec)
+            self.io.loop.call_soon_threadsafe(ch.wake)
         else:
             self.send_ordered({"t": "submit_actor_task", "spec": spec})
         return [ObjectRef(oid, skip_adding_local_ref=True) for oid in return_ids]

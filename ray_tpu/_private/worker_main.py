@@ -53,11 +53,16 @@ class WorkerServer:
             conn.start()
 
         if protocol.is_tcp_address(self.socket_path):
-            server = await asyncio.start_server(on_peer, host="0.0.0.0", port=0)
-            port = server.sockets[0].getsockname()[1]
+            from .config import GLOBAL_CONFIG as cfg
             from .head import _advertise_host
 
-            return f"{_advertise_host('0.0.0.0')}:{port}"
+            # same bind policy as the control plane (see config.py
+            # head_tcp_host): loopback-configured clusters must not expose
+            # the unauthenticated task-push endpoint on all interfaces
+            bind = cfg.head_tcp_host or "0.0.0.0"
+            server = await asyncio.start_server(on_peer, host=bind, port=0)
+            port = server.sockets[0].getsockname()[1]
+            return f"{_advertise_host(bind)}:{port}"
         base = os.path.dirname(self.socket_path)
         sock_dir = os.path.join(base, "workers")
         os.makedirs(sock_dir, exist_ok=True)
@@ -85,6 +90,7 @@ class WorkerServer:
             direct_address = await self._start_direct_server()
         except Exception:
             direct_address = None
+        self._direct_address = direct_address
         await self.conn.request(
             {
                 "t": "register_worker",
@@ -95,9 +101,46 @@ class WorkerServer:
                 "direct_address": direct_address,
             }
         )
-        # serve until the connection dies
-        while not self.conn.closed:
-            await asyncio.sleep(0.2)
+        # serve until the connection dies; on head death try to RECONNECT —
+        # this process (and any actor state in it) survives a head restart
+        # (reference: workers re-register via the raylet against a
+        # restarted GCS, gcs_server.cc:130-178)
+        while True:
+            while not self.conn.closed:
+                await asyncio.sleep(0.2)
+            if not await self._reconnect():
+                return
+
+    async def _reconnect(self) -> bool:
+        from .config import GLOBAL_CONFIG as cfg
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + cfg.head_reconnect_timeout_s
+        while loop.time() < deadline:
+            await asyncio.sleep(0.5)
+            try:
+                reader, writer = await protocol.open_stream(self.socket_path)
+                conn = protocol.Connection(reader, writer, self.handle)
+                conn.start()
+                await conn.request(
+                    {
+                        "t": "register_worker",
+                        "proto": protocol.PROTOCOL_VERSION,
+                        "worker_id": self.worker_id,
+                        "pid": os.getpid(),
+                        "node_id": self.node_id,
+                        "direct_address": self._direct_address,
+                        "actor_id": self.actor_id,
+                        "adopt": True,
+                    },
+                    timeout=10,
+                )
+            except Exception:
+                continue
+            self.conn = conn
+            global_worker.conn = conn
+            return True
+        return False
 
     async def handle(self, msg):
         t = msg["t"]

@@ -26,21 +26,27 @@ th{background:#f0f0f0} .dead{color:#b00} .alive{color:#080}
 <h1>ray_tpu dashboard</h1>
 <div id="res"></div>
 <h2>Nodes</h2><table id="nodes"></table>
+<div id="spark"></div>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Workers</h2><table id="workers"></table>
 <h2>Jobs</h2><table id="jobs"></table>
-<h2>Tasks (last 50)</h2><table id="tasks"></table>
+<h2>Tasks (last 50 — click a row for its event timeline)</h2>
+<pre id="taskdetail" style="display:none;background:#fff;border:1px solid #ddd;padding:.5rem"></pre>
+<table id="tasks"></table>
+<h2>Worker logs</h2>
+<select id="logsel"><option value="">(choose a worker)</option></select>
+<pre id="logview" style="background:#111;color:#ddd;padding:.5rem;min-height:3rem;max-height:20rem;overflow:auto"></pre>
 <script>
 function esc(s){
   return String(s).replace(/[&<>"']/g,
     c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 }
-function fill(id, rows, cols){
+function fill(id, rows, cols, onclick){
   const t = document.getElementById(id);
   if(!rows.length){t.innerHTML = "<tr><td>(empty)</td></tr>"; return;}
   let h = "<tr>" + cols.map(c=>`<th>${esc(c)}</th>`).join("") + "</tr>";
   for(const r of rows){
-    h += "<tr>" + cols.map(c=>{
+    h += `<tr${onclick?` style="cursor:pointer" data-id="${esc(r[cols[0]])}"`:""}>` + cols.map(c=>{
       let v = r[c]; if(typeof v === "object" && v !== null) v = JSON.stringify(v);
       let cls = (c=="state"||c=="alive"||c=="status") ?
         ((v=="dead"||v==false||v=="FAILED")?"dead":"alive") : "";
@@ -48,23 +54,67 @@ function fill(id, rows, cols){
     }).join("") + "</tr>";
   }
   t.innerHTML = h;
+  if(onclick) for(const tr of t.querySelectorAll("tr[data-id]"))
+    tr.onclick = ()=>onclick(tr.dataset.id);
+}
+function sparkline(pts, color){
+  if(!pts.length) return "";
+  const w=160,h=28,max=Math.max(...pts,1e-9);
+  const path=pts.map((v,i)=>`${i?"L":"M"}${(i/(pts.length-1||1)*w).toFixed(1)},${(h-2-(v/max)*(h-4)).toFixed(1)}`).join(" ");
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle"><path d="${path}" fill="none" stroke="${color}" stroke-width="1.5"/></svg>`;
+}
+async function showTask(tid){
+  const d=document.getElementById("taskdetail");
+  try{
+    const all=await fetch("/api/tasks").then(r=>r.json());
+    const t=all.find(x=>x.task_id===tid);
+    d.textContent = t ? JSON.stringify(t, null, 2) : "task gone";
+  }catch(e){ d.textContent=""+e; }
+  d.style.display="block";
+}
+let taskRows=[];
+async function tickLogs(){
+  const sel=document.getElementById("logsel"), view=document.getElementById("logview");
+  try{
+    const q = sel.value ? ("?worker_id="+encodeURIComponent(sel.value)) : "";
+    const data = await fetch("/api/logs"+q).then(r=>r.json());
+    const cur = new Set([...sel.options].map(o=>o.value));
+    for(const w of data.workers) if(!cur.has(w)){
+      const o=document.createElement("option"); o.value=o.textContent=w; sel.appendChild(o);
+    }
+    if(sel.value && data.lines){
+      const atEnd = view.scrollTop+view.clientHeight >= view.scrollHeight-8;
+      view.textContent = data.lines.join("\\n");
+      if(atEnd) view.scrollTop = view.scrollHeight;
+    }
+  }catch(e){}
 }
 async function tick(){
   try{
-    const [res, nodes, actors, workers, jobs, tasks] = await Promise.all(
-      ["cluster","nodes","actors","workers","jobs","tasks"].map(
+    const [res, nodes, actors, workers, jobs, tasks, hist] = await Promise.all(
+      ["cluster","nodes","actors","workers","jobs","tasks","node_history"].map(
         p=>fetch("/api/"+p).then(r=>r.json())));
     document.getElementById("res").textContent =
       Object.entries(res.total).map(([k,v])=>
         `${k}: ${Math.round((res.available[k]??0)*100)/100}/${Math.round(v*100)/100}`).join("   ");
     fill("nodes", nodes, ["node_id","alive","resources","available"]);
+    let sh = "";
+    for(const [nid, pts] of Object.entries(hist)){
+      sh += `<div><code>${esc(nid)}</code> load ` +
+        sparkline(pts.map(p=>p.load_1m??0), "#07c") + " mem " +
+        sparkline(pts.map(p=>p.mem_frac??0), "#c70") +
+        ` ${Math.round((pts.at(-1)?.mem_frac??0)*100)}%</div>`;
+    }
+    document.getElementById("spark").innerHTML = sh;
     fill("actors", actors, ["actor_id","class_name","name","state","worker_id"]);
     fill("workers", workers, ["worker_id","node_id","state","actor_id","pid"]);
     fill("jobs", jobs, ["submission_id","status","entrypoint","log_path"]);
-    fill("tasks", tasks.slice(-50).reverse(), ["task_id","name","state","node_id","worker_id"]);
+    taskRows = tasks;
+    fill("tasks", tasks.slice(-50).reverse(),
+         ["task_id","name","state","node_id","worker_id"], showTask);
   }catch(e){ document.getElementById("res").textContent = "head unreachable: "+e; }
 }
-tick(); setInterval(tick, 2000);
+tick(); setInterval(tick, 2000); tickLogs(); setInterval(tickLogs, 1500);
 </script></body></html>"""
 
 
@@ -122,7 +172,16 @@ class Dashboard:
             return "200 OK", "text/html; charset=utf-8", _PAGE.encode()
         if not path.startswith("/api/"):
             return "404 Not Found", "text/plain", b"not found"
-        kind = path[len("/api/"):].split("?")[0]
+        kind, _, query = path[len("/api/"):].partition("?")
+        if kind == "logs":
+            from urllib.parse import parse_qs, unquote
+
+            q = parse_qs(query)
+            msg = {"t": "tail_logs"}
+            if q.get("worker_id"):
+                msg["worker_id"] = unquote(q["worker_id"][0])
+            data = await self.head.handle(None, msg)
+            return "200 OK", "application/json", json.dumps(data).encode()
         handlers = {
             "nodes": {"t": "nodes"},
             "actors": {"t": "list_actors"},
@@ -135,6 +194,8 @@ class Dashboard:
             "metrics": {"t": "get_metrics"},
             "event_stats": {"t": "event_stats"},
             "pgs": {"t": "pg_table"},
+            "node_history": {"t": "node_history"},
+            "object_stats": {"t": "object_stats"},
         }
         msg = handlers.get(kind)
         if msg is None:

@@ -15,13 +15,22 @@ from ._private import serialization
 
 
 class ObjectRef:
-    __slots__ = ("id", "_registered", "__weakref__")
+    __slots__ = ("id", "_registered", "_escaped", "_owner", "__weakref__")
 
     def __init__(self, id_hex: str, skip_adding_local_ref: bool = False):
         from ._private.worker import global_worker
 
         self.id = id_hex
         self._registered = False
+        # True once this ref has been pickled (task arg, put, actor call):
+        # another process may now hold the id, so its envelope MUST be
+        # forwarded to the head even if this local ref dies first
+        self._escaped = False
+        # owner handle = the ref minted at submit/put whose +1 rides the
+        # result forward; only ITS death may cancel that forward. Duplicate
+        # handles (unpickled copies) registered their own +1 and must
+        # always decrement instead.
+        self._owner = skip_adding_local_ref
         if not skip_adding_local_ref and global_worker.connected:
             global_worker.add_object_ref(id_hex)
             self._registered = True
@@ -48,6 +57,7 @@ class ObjectRef:
 
     def __reduce__(self):
         serialization.record_contained_ref(self)
+        self._escaped = True
         return (ObjectRef, (self.id,))
 
     def __del__(self):
@@ -55,7 +65,9 @@ class ObjectRef:
             if self._registered:
                 from ._private.worker import global_worker
 
-                global_worker.remove_object_ref(self.id)
+                global_worker.remove_object_ref(
+                    self.id, escaped=self._escaped or not self._owner
+                )
         except Exception:
             pass
 

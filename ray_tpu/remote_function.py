@@ -48,7 +48,10 @@ class RemoteFunction:
             name=opts.get("name") or self._function.__name__,
             num_returns=num_returns,
             resources=resolve_task_resources(opts, is_actor=False),
-            max_retries=opts.get("max_retries", 0),
+            # reference default: tasks retry 3x on SYSTEM failures (worker
+            # crash, lease failure) — ray_config_def.h task_max_retries;
+            # application exceptions never retry
+            max_retries=opts.get("max_retries", 3),
             scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
             runtime_env=_validated_runtime_env(opts.get("runtime_env")),
         )

@@ -16,13 +16,28 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
 class DeploymentResponse:
-    def __init__(self, ref):
+    def __init__(self, ref, handle=None, call=None):
         self._ref = ref
+        self._handle = handle
+        self._call = call  # (args, kwargs) for replica-death retry
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
 
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except (ActorDiedError, WorkerCrashedError):
+            # the chosen replica died mid-call (e.g. torn down by a
+            # redeploy that raced this request): re-route once against a
+            # refreshed replica set (reference: the router retries system
+            # failures transparently, serve/_private/router.py)
+            if self._handle is None or self._call is None:
+                raise
+            self._handle._refresh(force=True)
+            args, kwargs = self._call
+            retry = self._handle.remote(*args, **kwargs)
+            return ray_tpu.get(retry.ref, timeout=timeout_s)
 
     @property
     def ref(self):
@@ -168,4 +183,4 @@ class DeploymentHandle:
             )
         self._counts[idx] = self._counts.get(idx, 0) + 1
         self._inflight.append((idx, ref))
-        return DeploymentResponse(ref)
+        return DeploymentResponse(ref, handle=self, call=(args, kwargs))

@@ -43,8 +43,8 @@ class TuneController:
         self.mode = mode
         self.scheduler.set_properties(metric, mode)
         self.searcher.set_search_properties(metric, mode, None)
-        self.max_concurrent = max_concurrent_trials or 8
         self.resources = resources_per_trial or {"CPU": 1}
+        self.max_concurrent = max_concurrent_trials or self._capacity_cap()
         self.max_failures = max_failures
         self.trials: List[Trial] = []
         self.storage_path = storage_path
@@ -70,6 +70,7 @@ class TuneController:
         trial.status = RUNNING
 
     def _teardown(self, trial: Trial):
+        trial._pump_ref = None
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
@@ -104,12 +105,38 @@ class TuneController:
             self._launch(trial)
             running += 1
 
-    def _process_results(self, trial: Trial):
+    def _capacity_cap(self) -> int:
+        """Default trial concurrency = what the cluster can actually place
+        (reference: Tune admits trials as resources allow). An unbounded
+        default overcommits: launched-but-unplaceable trial actors make the
+        pump park on a STARTING actor while placed trials — whose completion
+        would free the capacity — wait their turn behind it."""
         try:
-            reports, _done = ray_tpu.get(trial.actor.next_results.remote())
+            total = ray_tpu.cluster_resources()
+            per = max(self.resources.get("CPU", 1), 1e-9)
+            return max(1, int(total.get("CPU", 1) / per))
+        except Exception:
+            return 8
+
+    def _process_results(self, trial: Trial, timeout: float = 1.0):
+        # bounded pump: a trial whose actor is still scheduling must not
+        # block the controller loop (completing OTHER trials is what frees
+        # its capacity). The drain is DESTRUCTIVE on the actor, so a
+        # timed-out pump keeps ITS ref and retries the SAME one next round
+        # — issuing a fresh next_results would orphan the drained reports.
+        ref = getattr(trial, "_pump_ref", None)
+        if ref is None:
+            ref = trial.actor.next_results.remote()
+            trial._pump_ref = ref
+        try:
+            reports, _done = ray_tpu.get(ref, timeout=timeout)
+        except ray_tpu.exceptions.GetTimeoutError:
+            return  # _pump_ref retained; retried next round / final drain
         except Exception as e:  # actor died (worker crash/OOM) — retry path
+            trial._pump_ref = None
             self._fail_or_retry(trial, e)
             return
+        trial._pump_ref = None
         for rep in reports:
             metrics = rep["metrics"]
             metrics.setdefault(
@@ -149,8 +176,9 @@ class TuneController:
         ready, _ = ray_tpu.wait([trial.run_ref], timeout=0)
         if not ready:
             return
-        # drain any final reports before closing out
-        self._process_results(trial)
+        # drain any final reports before closing out (reliably: the actor
+        # is alive and next_results returns immediately)
+        self._process_results(trial, timeout=30.0)
         if trial.status != RUNNING:
             return
         try:

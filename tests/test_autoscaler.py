@@ -70,7 +70,11 @@ def test_scale_up_unblocks_tasks(ray_start_regular):
 
     # two 4-CPU tasks can't run together on a 4-CPU head
     refs = [big.remote(i) for i in range(3)]
-    time.sleep(0.3)  # let them queue
+    # direct-path submitters hold the backlog caller-side for up to ~1s
+    # (lease saturation) before spilling to the head's pending queue —
+    # autoscaler demand becomes visible within ~1.2s, well inside any real
+    # autoscale period
+    time.sleep(1.6)  # let them spill + queue
     result = scaler.update()
     assert result["launched"] >= 1
     assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 2, 4]

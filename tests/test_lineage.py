@@ -83,3 +83,30 @@ def test_put_objects_are_not_evicted(small_store):
         ray_tpu.get(make.remote(i))
     arr = ray_tpu.get(pinned)
     assert arr[0] == 7.0
+
+
+def test_dep_wait_survives_transient_zero_refcount(ray_start_regular):
+    """Regression: a consumer parked on get_objects for a dep whose head
+    refcount transiently hit 0 (caller dropped its handles before the
+    producer's batched result-forward landed) must still wake when the put
+    arrives — the availability event must not be dropped under waiters."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.data import _exchange
+
+    slice_t = ray_tpu.remote(_exchange.slice_partition).options(num_returns=2)
+    concat_t = ray_tpu.remote(_exchange.concat_parts)
+    for _ in range(25):
+        blocks = [{"x": np.arange(25) + 25 * i} for i in range(4)]
+        parts = [
+            slice_t.remote(b, s, [0, 75, 100])
+            for b, s in zip(blocks, [0, 25, 50, 75])
+        ]
+        outs = [
+            concat_t.remote(*[parts[b][j] for b in range(4)]) for j in range(2)
+        ]
+        del parts, blocks  # handles die before the slice tasks complete
+        got = ray_tpu.get(outs, timeout=30)
+        assert _exchange.block_rows(got[0]) == 75
+        assert _exchange.block_rows(got[1]) == 25

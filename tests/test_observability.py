@@ -26,9 +26,17 @@ def test_list_tasks_and_objects(ray_start_regular):
 
     refs = [work.remote(5) for _ in range(4)]
     ray_tpu.get(refs)
-    tasks = list_tasks()
+    # task records for direct-pushed tasks are forwarded in batches
+    # (task_event_buffer.h semantics): poll briefly for the last flush
+    deadline = time.time() + 5
+    done = []
+    while time.time() < deadline:
+        tasks = list_tasks()
+        done = [t for t in tasks if t["state"] == "done"]
+        if len(done) >= 4:
+            break
+        time.sleep(0.1)
     assert len(tasks) >= 4
-    done = [t for t in tasks if t["state"] == "done"]
     assert len(done) >= 4
     assert all(t["worker_id"] for t in done)
     # events carry monotonic-ordered transitions ending in done

@@ -93,3 +93,61 @@ def test_streaming_response(serve_cluster):
     serve.run(stream.bind(), name="stream", route_prefix="/gen")
     with _get("/gen") as r:
         assert r.read().decode() == "tok0 tok1 tok2 tok3 tok4 "
+
+
+def test_100_parallel_streaming_requests(serve_cluster):
+    """100 concurrent chunked-streaming requests complete on the asyncio
+    ingress: streaming holds a coroutine, not a thread (the old
+    thread-per-request server needed 100 live threads for this; the
+    replica-call pool is only 16 deep). Also checks HTTP/1.1 keep-alive."""
+    import socket
+    import threading
+
+    from ray_tpu.serve.http_proxy import StreamingResponse
+
+    @serve.deployment
+    def streamer(x=None):
+        return StreamingResponse(f"chunk-{i}|" for i in range(5))
+
+    serve.run(streamer.bind(), name="s", route_prefix="/stream")
+
+    host, _, port = _addr().rpartition(":")
+    results = []
+    lock = threading.Lock()
+
+    def one():
+        try:
+            with socket.create_connection((host, int(port)), timeout=60) as s:
+                s.sendall(b"GET /stream HTTP/1.1\r\nHost: x\r\n\r\n")
+                buf = b""
+                while b"0\r\n\r\n" not in buf:
+                    b = s.recv(4096)
+                    if not b:
+                        break
+                    buf += b
+            ok = b"chunk-4|" in buf and b"Transfer-Encoding: chunked" in buf
+            with lock:
+                results.append(ok)
+        except Exception:
+            with lock:
+                results.append(False)
+
+    threads = [threading.Thread(target=one) for _ in range(100)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 100 and all(results), (
+        f"{sum(results)}/100 streams completed"
+    )
+
+    # keep-alive: two sequential requests on ONE connection
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        for _ in range(2):
+            s.sendall(b"GET /stream HTTP/1.1\r\nHost: x\r\n\r\n")
+            buf = b""
+            while b"0\r\n\r\n" not in buf:
+                b = s.recv(4096)
+                assert b, "connection closed between keep-alive requests"
+                buf += b
+            assert b"chunk-0|" in buf

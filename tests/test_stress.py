@@ -1,0 +1,98 @@
+"""Head scale/backpressure: deep task queues + actor backlogs through ONE
+head with bounded control-loop latency (reference: release/benchmarks
+many_tasks/many_actors envelope — scaled to a CI host; microbench.py runs
+the full 100k variant)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+
+@pytest.fixture
+def small_head():
+    # direct_task_calls off: this test measures the HEAD's queue, so every
+    # submit must land in it (the direct path would hold the backlog
+    # caller-side behind leases)
+    ray_tpu.init(
+        num_cpus=2,
+        ignore_reinit_error=True,
+        _system_config={"direct_task_calls": False},
+    )
+    yield
+    ray_tpu.shutdown()
+
+
+def _ping_ms(n: int = 20) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        global_worker.request({"t": "ping"})
+    return (time.perf_counter() - t0) / n * 1000
+
+
+def test_20k_queued_tasks_head_stays_responsive(small_head):
+    @ray_tpu.remote(resources={"never": 1.0})
+    def blocked():
+        return 1
+
+    @ray_tpu.remote
+    def runnable(i):
+        return i
+
+    baseline_ms = _ping_ms()
+
+    t0 = time.perf_counter()
+    refs = [blocked.remote() for _ in range(20_000)]
+    submit_s = time.perf_counter() - t0
+    assert submit_s < 30, f"20k submits took {submit_s:.1f}s"
+
+    # let the head ingest the backlog, then measure loop latency UNDER it
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(global_worker.request({"t": "list_tasks", "limit": 0})) >= 20_000:
+            break
+        time.sleep(0.5)
+    under_ms = _ping_ms()
+    assert under_ms < max(50.0, 40 * baseline_ms), (
+        f"head loop latency exploded under 20k queued tasks: "
+        f"{under_ms:.1f}ms (baseline {baseline_ms:.1f}ms)"
+    )
+
+    # normal work still completes under the backlog
+    t0 = time.perf_counter()
+    out = ray_tpu.get([runnable.remote(i) for i in range(200)], timeout=120)
+    assert out == list(range(200))
+    drain_s = time.perf_counter() - t0
+    assert drain_s < 60, f"200 runnable tasks took {drain_s:.1f}s under backlog"
+
+    # event stats stay bounded (no handler ran away)
+    stats = global_worker.request({"t": "event_stats"})
+    submit_avg = stats.get("submit_task", {}).get("avg_ms", 0.0)
+    assert submit_avg < 50, f"submit_task avg {submit_avg:.2f}ms"
+    del refs
+
+
+def test_1k_actor_backlog_and_teardown(small_head):
+    @ray_tpu.remote(resources={"never": 1.0})
+    class Blocked:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [Blocked.remote() for _ in range(1000)]
+    create_s = time.perf_counter() - t0
+    assert create_s < 30, f"1k actor creations took {create_s:.1f}s"
+
+    listed = global_worker.request({"t": "list_actors"})
+    assert len(listed) >= 1000
+    under_ms = _ping_ms()
+    assert under_ms < 100, f"head latency {under_ms:.1f}ms under 1k pending actors"
+
+    # mass teardown drains cleanly
+    t0 = time.perf_counter()
+    for a in actors:
+        ray_tpu.kill(a)
+    kill_s = time.perf_counter() - t0
+    assert kill_s < 60, f"1k kills took {kill_s:.1f}s"

@@ -2,6 +2,7 @@
 test_trial_scheduler.py, test_tuner_restore.py)."""
 
 import os
+import time
 
 import pytest
 
@@ -53,6 +54,10 @@ def test_random_search_num_samples(ray_cpus):
 def test_asha_stops_bad_trials(ray_cpus):
     def slow_objective(config):
         for i in range(20):
+            # actually stream (ASHA is an *asynchronous* streaming
+            # scheduler): an instant burst would land one trial's whole
+            # history before peers record, and rung cutoffs need peers
+            time.sleep(0.05)
             tune.report({"score": config["x"] * (i + 1), "training_iteration": i + 1})
 
     results = tune.run(
