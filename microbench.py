@@ -239,10 +239,11 @@ def bench_head_stress(n_tasks: int = 100_000, n_actors: int = 1_000) -> dict:
             "stress_submit_per_s": round(n_tasks / submit_s, 1),
             "stress_ingest_per_s": round(n_tasks / ingest_s, 1),
             "stress_ping_ms_baseline": round(base_ms, 2),
-            "stress_ping_ms_under_load": round(ping_ms(), 2),
+            "stress_ping_ms_under_load": round(under_ms, 2),
+            "stress_ping_ms_under_load_and_actors": round(ping_ms(), 2),
             "stress_actor_creates_per_s": round(n_actors / actors_s, 1),
         }
-        del refs, actors, under_ms
+        del refs, actors
         return out
     finally:
         ray_tpu.shutdown()

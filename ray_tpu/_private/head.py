@@ -971,6 +971,14 @@ class Head:
             if dt > st["max_ms"]:
                 st["max_ms"] = dt
 
+    async def _h_worker_kill_reason(self, conn, msg):
+        """Why the head killed a worker (OOM policy), if it did. Direct-path
+        callers consult this when a lease breaks mid-task so an OOM kill
+        surfaces as OutOfMemoryError, not a generic crash (reference:
+        worker_killing_policy.h + task failure cause plumbing)."""
+        w = self.workers.get(msg["worker_id"])
+        return w.kill_reason if w is not None else None
+
     async def _h_event_stats(self, conn, msg):
         return {
             t: dict(st, avg_ms=st["total_ms"] / max(1, st["count"]))

@@ -583,6 +583,7 @@ class _TaskChannel:
     async def _finish(self, lease: _TaskLease, spec: dict, msg: dict, fut):
         """Settle every return id exactly once (a get() may be parked on
         the local pending event)."""
+        requeued = False
         try:
             try:
                 reply = await fut
@@ -599,9 +600,27 @@ class _TaskChannel:
                     # _to_head: worker deaths cluster with head outages,
                     # and a send on a dead head conn drops the spec; the
                     # dispatch loop holds specs through reconnection
+                    requeued = True  # the retry still needs its dep pins
                     self.queue.put_nowait(spec)
                 else:
-                    await self._fail_returns(spec, "worker died mid-task")
+                    # an OOM kill by the head must surface as
+                    # OutOfMemoryError, matching the head-routed path
+                    kill_reason = None
+                    try:
+                        kill_reason = await self.worker.conn.request(
+                            {"t": "worker_kill_reason",
+                             "worker_id": lease.worker_id}
+                        )
+                    except Exception:
+                        pass
+                    if kill_reason:
+                        from ..exceptions import OutOfMemoryError
+
+                        await self._fail_returns(
+                            spec, kill_reason, error_cls=OutOfMemoryError
+                        )
+                    else:
+                        await self._fail_returns(spec, "worker died mid-task")
                 return
             for _ in range(3):
                 lost = reply.get("lost_deps")
@@ -641,13 +660,16 @@ class _TaskChannel:
             lease.inflight -= 1
             lease.last_used = asyncio.get_running_loop().time()
             self._wake.set()  # the dispatcher may be waiting for a free lease
-            await _release_spec_deps(self.worker, spec)
+            if not requeued:
+                await _release_spec_deps(self.worker, spec)
 
-    async def _fail_returns(self, spec: dict, reason: str):
+    async def _fail_returns(self, spec: dict, reason: str, error_cls=None):
         from ..exceptions import WorkerCrashedError
 
+        if error_cls is None:
+            error_cls = WorkerCrashedError
         err = serialization.serialize(
-            WorkerCrashedError(f"task {spec['task_id']}: {reason}")
+            error_cls(f"task {spec['task_id']}: {reason}")
         )
         err.is_error = True
         for oid in spec["return_ids"]:
@@ -1587,6 +1609,8 @@ class Worker:
                     )[0]
             value = serialization.deserialize(env)
             if getattr(env, "is_error", False):
+                if isinstance(value, exceptions.TaskError):
+                    raise value.as_instanceof_cause()
                 raise value
             values.append(value)
         return values[0] if is_single else values

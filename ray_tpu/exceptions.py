@@ -20,7 +20,52 @@ class TaskError(RayTpuError):
         super().__init__(f"Task '{function_name}' failed:\n{traceback_str}")
 
     def __reduce__(self):
-        return (type(self), (self.function_name, self.traceback_str, self.cause))
+        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+
+    def as_instanceof_cause(self) -> "TaskError":
+        """A TaskError that ALSO subclasses the cause's type, so user code
+        can `except ValueError` around a `get` (reference:
+        python/ray/exceptions.py RayTaskError.as_instanceof_cause /
+        make_dual_exception_type)."""
+        cause = self.cause
+        if cause is None:
+            return self
+        cause_cls = type(cause)
+        if isinstance(self, cause_cls) or issubclass(TaskError, cause_cls):
+            return self
+        try:
+            dual = _dual_exception_type(cause_cls)
+            return dual(self.function_name, self.traceback_str, cause)
+        except Exception:
+            return self
+
+
+_DUAL_TYPES: dict = {}
+
+
+def _reconstruct_dual(function_name, traceback_str, cause):
+    return TaskError(function_name, traceback_str, cause).as_instanceof_cause()
+
+
+def _dual_exception_type(cause_cls: type) -> type:
+    """TaskError subclass that is also a `cause_cls` (cached per type).
+    Dynamic classes don't pickle by reference, so __reduce__ rebuilds the
+    dual from its TaskError fields on the other side."""
+    dual = _DUAL_TYPES.get(cause_cls)
+    if dual is None:
+        dual = type(
+            f"TaskError({cause_cls.__name__})",
+            (TaskError, cause_cls),
+            {
+                "__init__": TaskError.__init__,
+                "__reduce__": lambda self: (
+                    _reconstruct_dual,
+                    (self.function_name, self.traceback_str, self.cause),
+                ),
+            },
+        )
+        _DUAL_TYPES[cause_cls] = dual
+    return dual
 
 
 class ActorError(RayTpuError):

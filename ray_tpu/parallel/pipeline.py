@@ -29,10 +29,20 @@ def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatc
     stage = lax.axis_index(axis_name)
     n_mb = n_microbatches
     total_ticks = n_mb + pp - 1
-    # strip the local stage dim: leaves are [1, ...] here
-    local_params = jax.tree.map(lambda p: p[0], stage_params)
     mb_shape = x_mb.shape[1:]
-    fwd = jax.checkpoint(lambda x: stage_fn(local_params, x))
+
+    # each device holds pp_stages/pp consecutive stages (leading local dim);
+    # apply them in order — with pp=1 this degenerates to the sequential
+    # stack with identical microbatch windows, so a single-device run is a
+    # bit-for-bit oracle for the sharded pipeline
+    def _fwd(x):
+        def body(xc, p_one):
+            return stage_fn(p_one, xc), None
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    fwd = jax.checkpoint(_fwd)
 
     send_perm = [(i, i + 1) for i in range(pp - 1)]
 

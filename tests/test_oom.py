@@ -98,3 +98,39 @@ def test_oom_surfaces_out_of_memory_error(oom_cluster):
     with pytest.raises(ray_tpu.exceptions.OutOfMemoryError, match="OOM-killed"):
         ray_tpu.get(ref, timeout=30)
     sample.write_text("5 100")
+
+
+def test_retry_after_worker_death_keeps_put_deps(oom_cluster):
+    """A direct-path task retried after its worker is killed must still see
+    its put() dependencies: the retry re-resolves them, so their ref pins
+    must survive the first (failed) dispatch (regression: the dep pins were
+    released in the dispatch-finish path even when the spec was requeued,
+    freeing lineage-less put() objects before the retry ran)."""
+    import numpy as np
+
+    import ray_tpu
+
+    sample = oom_cluster
+    marker = str(sample) + ".ran3"
+
+    big = ray_tpu.put(np.arange(300_000))  # externalized to shm, no lineage
+
+    @ray_tpu.remote(max_retries=2)
+    def use(arr, path):
+        with open(path, "a") as f:
+            f.write("x")
+        if len(open(path).read()) == 1:
+            time.sleep(30)  # first attempt: hold to be OOM-killed
+        return int(arr.sum())
+
+    ref = use.remote(big, marker)
+    del big  # the task's pin is now the only thing keeping the object alive
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.05)
+    assert os.path.exists(marker)
+    sample.write_text("99 100")
+    time.sleep(0.5)
+    sample.write_text("5 100")
+    assert ray_tpu.get(ref, timeout=60) == int(np.arange(300_000).sum())
+    assert len(open(marker).read()) >= 2
