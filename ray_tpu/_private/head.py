@@ -1940,6 +1940,30 @@ class Head:
     async def _h_ping(self, conn, msg):
         return "pong"
 
+    async def _h_profile_worker(self, conn, msg):
+        """On-demand profiling of a live worker (reference:
+        dashboard/modules/reporter/profile_manager.py). Forwards the request
+        to the worker's own sampler (worker_main._profile) and relays the
+        collapsed-stack / allocation report back to the caller."""
+        wid = msg.get("worker_id")
+        w = self.workers.get(wid or "")
+        if w is None or w.conn is None or w.conn.closed or w.state == "dead":
+            raise ValueError(f"no live worker {wid!r}")
+        duration = min(60.0, float(msg.get("duration_s", 2.0)))
+        return await asyncio.wait_for(
+            w.conn.request(
+                {
+                    "t": "profile",
+                    "kind": msg.get("kind", "cpu"),
+                    "duration_s": duration,
+                    # floor keeps the sampler from busy-spinning the GIL
+                    # inside the very worker it's observing
+                    "interval_s": max(0.001, float(msg.get("interval_s", 0.01))),
+                }
+            ),
+            timeout=duration + 30.0,
+        )
+
     # ------------------------------------------------------------------
     # pubsub (reference: src/ray/pubsub — long-poll publisher/subscriber
     # for object-location/actor/node/log channels; serve's config push,

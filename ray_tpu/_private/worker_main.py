@@ -153,10 +153,33 @@ class WorkerServer:
             return None
         if t == "ping":
             return "pong"
+        if t == "profile":
+            return await self._profile(msg)
         if t == "shutdown":
             self._loop.call_soon(sys.exit, 0)
             return True
         raise ValueError(f"worker got unknown message {t!r}")
+
+    async def _profile(self, msg):
+        """Self-profile on demand (reference:
+        dashboard/modules/reporter/profile_manager.py — py-spy/memray
+        against a pid; here the worker samples itself, see
+        util/profiling.py). Sampling runs on a FRESH thread so both the
+        protocol loop and the task executor stay observable."""
+        from ..util import profiling
+
+        kind = msg.get("kind", "cpu")
+        duration = float(msg.get("duration_s", 2.0))
+        if kind == "dump":
+            return profiling.stack_dump()
+        if kind == "mem":
+            return await asyncio.get_running_loop().run_in_executor(
+                None, profiling.memory_profile, duration
+            )
+        interval = float(msg.get("interval_s", 0.01))
+        return await asyncio.get_running_loop().run_in_executor(
+            None, profiling.cpu_profile, duration, interval
+        )
 
     async def _fetch_blob(self, ns: str, key: str, cache: dict):
         if key in cache:

@@ -173,6 +173,30 @@ class Dashboard:
         if not path.startswith("/api/"):
             return "404 Not Found", "text/plain", b"not found"
         kind, _, query = path[len("/api/"):].partition("?")
+        if kind == "profile":
+            # /api/profile?worker_id=..&kind=cpu|mem|dump&duration=2
+            # (reference: the dashboard's py-spy/memray profiling endpoints,
+            # dashboard/modules/reporter/profile_manager.py)
+            from urllib.parse import parse_qs, unquote
+
+            q = parse_qs(query)
+            if not q.get("worker_id"):
+                return "400 Bad Request", "text/plain", b"worker_id required"
+            try:
+                duration = float(q.get("duration", ["2.0"])[0])
+            except ValueError:
+                return "400 Bad Request", "text/plain", b"bad duration"
+            msg = {
+                "t": "profile_worker",
+                "worker_id": unquote(q["worker_id"][0]),
+                "kind": q.get("kind", ["cpu"])[0],
+                "duration_s": duration,
+            }
+            try:
+                data = await self.head.handle(None, msg)
+            except Exception as e:
+                return "404 Not Found", "text/plain", str(e).encode()
+            return "200 OK", "application/json", json.dumps(data).encode()
         if kind == "logs":
             from urllib.parse import parse_qs, unquote
 

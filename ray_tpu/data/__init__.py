@@ -25,6 +25,7 @@ from .datasource import (  # noqa: F401
     read_tfrecords,
     read_webdataset,
 )
+from .expressions import Expr, col  # noqa: F401
 from .grouped_data import GroupedData  # noqa: F401
 
 range = range_  # noqa: A001 — mirror ray.data.range

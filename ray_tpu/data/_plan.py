@@ -126,6 +126,52 @@ def push_limit(ops: List["_Op"], n: int) -> List["_Op"]:
     return ops[:i] + [cap] + ops[i:]
 
 
+def pushdown_reads(read_meta, block_fns, ops: List["_Op"]):
+    """Fold leading structured ops into the datasource scan.
+
+    Scans the op-chain prefix for planner-markered ops (op.meta): every
+    leading `filter(Expr)` pushes its predicate, and a `select_columns`
+    pushes its projection (and ends the scan — later ops see the projected
+    schema). Pushed ops are dropped; the reads are rebuilt with
+    columns=/filters= so pruning happens inside the parquet reader
+    (reference: the logical planner's read-op pushdown rules +
+    datasource-level `columns`/`filter` args).
+    """
+    if not read_meta or read_meta.get("kind") != "parquet":
+        return block_fns, ops
+    exprs = []
+    cols = None
+    n_pushed = 0
+    for op in ops:
+        tag = getattr(op, "meta", None)
+        if not tag:
+            break
+        if tag[0] == "filter_expr":
+            exprs.append(tag[1])
+            n_pushed += 1
+            continue
+        if tag[0] == "select":
+            cols = list(tag[1])
+            n_pushed += 1
+        break
+    if n_pushed == 0:
+        return block_fns, ops
+    import functools
+
+    from .dataset import _read_parquet_one
+
+    expr = read_meta.get("filter")
+    for e in exprs:
+        expr = e if expr is None else (expr & e)
+    if cols is None:
+        cols = read_meta.get("columns")
+    fns = [
+        functools.partial(_read_parquet_one, p, cols, expr)
+        for p in read_meta["paths"]
+    ]
+    return fns, ops[n_pushed:]
+
+
 def optimize(ops: List["_Op"]) -> List["_Op"]:
     """The rule pipeline applied before execution."""
     return fuse_map_batches(fuse_row_ops(ops))

@@ -124,6 +124,9 @@ class _Op:
     compute: str = "tasks"
     num_actors: int = 2
     fn_constructor_args: tuple = ()
+    # planner marker: ("select", cols) | ("filter_expr", Expr) — structured
+    # ops the pushdown rule may fold into the datasource read (_plan.py)
+    meta: Any = None
 
 
 def _op_callable(op: _Op, cache: Optional[Dict[int, Callable]]) -> Callable:
@@ -161,6 +164,12 @@ def _apply_ops(block, ops: List[_Op], cache: Optional[Dict[int, Callable]] = Non
             block = [op.fn(row) for row in _block_to_rows(block)]
         elif op.kind == "filter":
             block = [row for row in _block_to_rows(block) if op.fn(row)]
+        elif op.kind == "filter_batch":
+            # vectorized expression filter (expressions.Expr.mask)
+            from . import _exchange
+
+            mask = np.asarray(op.fn.mask(_exchange.to_columns(block)), bool)
+            block = _block_take(block, np.nonzero(mask)[0])
         elif op.kind == "flat_map":
             out: List[Any] = []
             for row in _block_to_rows(block):
@@ -220,10 +229,19 @@ def _block_size_bytes(block) -> int:
 
 
 class Dataset:
-    def __init__(self, block_fns: List[Callable[[], Any]], ops: Optional[List[_Op]] = None):
+    def __init__(
+        self,
+        block_fns: List[Callable[[], Any]],
+        ops: Optional[List[_Op]] = None,
+        read_meta: Optional[Dict[str, Any]] = None,
+    ):
         # block_fns: zero-arg callables producing the source blocks (lazy read)
         self._block_fns = block_fns
         self._ops = ops or []
+        # pushdown-capable source descriptor ({"kind", "paths", ...});
+        # set by read_parquet so _plan.pushdown_reads can rebuild reads
+        # with columns=/filters= (reference: logical-plan read pushdown)
+        self._read_meta = read_meta
 
     # ---- metadata ----
 
@@ -236,7 +254,7 @@ class Dataset:
     # ---- transforms (lazy) ----
 
     def _with_op(self, op: _Op) -> "Dataset":
-        return Dataset(self._block_fns, self._ops + [op])
+        return Dataset(self._block_fns, self._ops + [op], read_meta=self._read_meta)
 
     def map_batches(
         self,
@@ -266,7 +284,19 @@ class Dataset:
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return self._with_op(_Op("map", fn))
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+    def filter(self, fn) -> "Dataset":
+        """Row predicate (opaque callable) or column Expression.
+
+        Expressions (`from ray_tpu.data import col; ds.filter(col("x") > 5)`)
+        evaluate vectorized in column space AND are visible to the planner:
+        over a parquet read they push down into the scan itself
+        (_plan.pushdown_reads), so pruned row groups never leave disk."""
+        from .expressions import Expr
+
+        if isinstance(fn, Expr):
+            return self._with_op(
+                _Op("filter_batch", fn, meta=("filter_expr", fn))
+            )
         return self._with_op(_Op("filter", fn))
 
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
@@ -503,7 +533,9 @@ class Dataset:
             c = _exchange.to_columns(batch)
             return {k: c[k] for k in keep}
 
-        return self.map_batches(do)
+        # markered so the planner can fold the projection into a parquet
+        # read (pq.read_table(columns=...)) — _plan.pushdown_reads
+        return self._with_op(_Op("map_batches", do, meta=("select", keep)))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         def do(batch):
@@ -713,14 +745,15 @@ class Dataset:
         a pool of stateful _MapWorker actors (round-robin, same windowing)."""
         import ray_tpu
 
-        from ._plan import optimize
+        from ._plan import optimize, pushdown_reads
 
-        ops = optimize(self._ops)
-        use_cluster = parallel and ray_tpu.is_initialized() and len(self._block_fns) > 1
+        block_fns, ops = pushdown_reads(self._read_meta, self._block_fns, self._ops)
+        ops = optimize(ops)
+        use_cluster = parallel and ray_tpu.is_initialized() and len(block_fns) > 1
 
         if not use_cluster:
             cache: Dict[int, Callable] = {}
-            for fn in self._block_fns:
+            for fn in block_fns:
                 yield _apply_ops(fn(), ops, cache)
             return
 
@@ -730,7 +763,7 @@ class Dataset:
             # the chain shares one pool: honor the LARGEST request among its
             # actor ops (silently using op[0]'s size would shrink a user's
             # explicit pool for the expensive op)
-            n = max(1, min(max(op.num_actors for op in actor_ops), len(self._block_fns)))
+            n = max(1, min(max(op.num_actors for op in actor_ops), len(block_fns)))
             worker_cls = ray_tpu.remote(_MapWorker)
             actors = [worker_cls.remote(ops) for _ in builtins.range(n)]
             rr = itertools.cycle(actors)
@@ -757,7 +790,7 @@ class Dataset:
 
         try:
             pending: List[Any] = []
-            fn_iter = iter(self._block_fns)
+            fn_iter = iter(block_fns)
             for fn in itertools.islice(fn_iter, effective_window()):
                 pending.append(submit(fn))
             while pending:
@@ -952,7 +985,7 @@ def from_pandas(df) -> Dataset:
     return Dataset([lambda: {c: df[c].to_numpy() for c in df.columns}])
 
 
-def _file_blocks(paths, read_one: Callable[[str], Any]) -> Dataset:
+def _expand_paths(paths) -> List[str]:
     import glob as globmod
     import os
 
@@ -966,13 +999,42 @@ def _file_blocks(paths, read_one: Callable[[str], Any]) -> Dataset:
             expanded.append(p)
     if not expanded:
         raise FileNotFoundError(f"no files matched {paths!r}")
-    return Dataset([lambda p=p: read_one(p) for p in expanded])
+    return expanded
 
 
-def read_parquet(paths) -> Dataset:
+def _file_blocks(paths, read_one: Callable[[str], Any]) -> Dataset:
+    return Dataset([lambda p=p: read_one(p) for p in _expand_paths(paths)])
+
+
+def _read_parquet_one(path: str, columns=None, filter_expr=None):
     import pyarrow.parquet as pq
 
-    return _file_blocks(paths, lambda p: pq.read_table(p))
+    filters = filter_expr.to_arrow() if filter_expr is not None else None
+    return pq.read_table(path, columns=columns, filters=filters)
+
+
+def read_parquet(paths, *, columns=None, filter=None) -> Dataset:
+    """Parquet scan with projection/predicate support: `columns` prunes at
+    the reader, `filter` (an expressions.Expr) prunes row groups. Both are
+    also REACHED by the planner — a leading select_columns/filter(expr) on
+    the Dataset folds into the read (_plan.pushdown_reads; reference: the
+    logical planner's read-op pushdown rules)."""
+    import functools
+
+    expanded = _expand_paths(paths)
+    fns = [
+        functools.partial(_read_parquet_one, p, columns, filter)
+        for p in expanded
+    ]
+    return Dataset(
+        fns,
+        read_meta={
+            "kind": "parquet",
+            "paths": expanded,
+            "columns": columns,
+            "filter": filter,
+        },
+    )
 
 
 def read_csv(paths) -> Dataset:

@@ -1,0 +1,182 @@
+"""Column expressions: introspectable predicates the planner can push down.
+
+Reference parity: the reference's logical planner pushes structured
+predicates/projections into file reads (data/_internal/logical/ rules +
+datasource-level `columns`/`filter` args; pyarrow dataset expressions).
+Opaque Python lambdas can't be reordered safely — an expression tree can:
+
+    from ray_tpu.data import col
+    ds = read_parquet(path).filter((col("score") > 0.5) & (col("split") == "train"))
+
+`Dataset.filter(expr)` evaluates vectorized in column space, and the
+pushdown rule rewrites parquet reads to `pq.read_table(..., filters=expr)`
+so pruned row groups never leave disk.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Set
+
+import numpy as np
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "&": lambda a, b: a & b,
+    "|": lambda a, b: a | b,
+}
+
+
+class Expr:
+    """Base: comparisons/logic build a tree; `mask(cols)` evaluates it."""
+
+    def __gt__(self, other):
+        return _Cmp(">", self, _wrap(other))
+
+    def __ge__(self, other):
+        return _Cmp(">=", self, _wrap(other))
+
+    def __lt__(self, other):
+        return _Cmp("<", self, _wrap(other))
+
+    def __le__(self, other):
+        return _Cmp("<=", self, _wrap(other))
+
+    def __eq__(self, other):  # type: ignore[override]
+        return _Cmp("==", self, _wrap(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return _Cmp("!=", self, _wrap(other))
+
+    def __and__(self, other):
+        return _Cmp("&", self, _wrap(other))
+
+    def __or__(self, other):
+        return _Cmp("|", self, _wrap(other))
+
+    def __invert__(self):
+        return _Not(self)
+
+    __hash__ = None  # type: ignore[assignment]
+
+    def isin(self, values):
+        return _IsIn(self, list(values))
+
+    # -- interface --
+    def mask(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+    def columns(self) -> Set[str]:
+        raise NotImplementedError
+
+    def to_arrow(self):
+        """pyarrow.compute expression for datasource pushdown."""
+        raise NotImplementedError
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def mask(self, cols):
+        return np.asarray(cols[self.name])
+
+    def columns(self):
+        return {self.name}
+
+    def to_arrow(self):
+        import pyarrow.compute as pc
+
+        return pc.field(self.name)
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class _Lit(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def mask(self, cols):
+        return self.value
+
+    def columns(self):
+        return set()
+
+    def to_arrow(self):
+        import pyarrow.compute as pc
+
+        return pc.scalar(self.value)
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else _Lit(v)
+
+
+class _Cmp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op, self.left, self.right = op, left, right
+
+    def mask(self, cols):
+        return _OPS[self.op](self.left.mask(cols), self.right.mask(cols))
+
+    def columns(self):
+        return self.left.columns() | self.right.columns()
+
+    def to_arrow(self):
+        l, r = self.left.to_arrow(), self.right.to_arrow()
+        if self.op == "&":
+            return l & r
+        if self.op == "|":
+            return l | r
+        return _OPS[self.op](l, r)
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class _Not(Expr):
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def mask(self, cols):
+        return ~np.asarray(self.inner.mask(cols))
+
+    def columns(self):
+        return self.inner.columns()
+
+    def to_arrow(self):
+        return ~self.inner.to_arrow()
+
+    def __repr__(self):
+        return f"~{self.inner!r}"
+
+
+class _IsIn(Expr):
+    def __init__(self, inner: Expr, values: list):
+        self.inner, self.values = inner, values
+
+    def mask(self, cols):
+        return np.isin(np.asarray(self.inner.mask(cols)), self.values)
+
+    def columns(self):
+        return self.inner.columns()
+
+    def to_arrow(self):
+        import pyarrow.compute as pc
+
+        return self.inner.to_arrow().isin(self.values)
+
+    def __repr__(self):
+        return f"{self.inner!r}.isin({self.values!r})"
+
+
+def col(name: str) -> Col:
+    return Col(name)

@@ -76,3 +76,28 @@ def summarize_tasks() -> Dict[str, int]:
     for t in list_tasks():
         counts[t["state"]] = counts.get(t["state"], 0) + 1
     return counts
+
+
+def profile_worker(
+    worker_id: str,
+    *,
+    kind: str = "cpu",
+    duration_s: float = 2.0,
+    interval_s: float = 0.01,
+) -> dict:
+    """On-demand profile of a live worker (reference: the dashboard's
+    py-spy/memray endpoints, dashboard/modules/reporter/profile_manager.py).
+
+    kind="cpu"  -> collapsed-stack samples + hot-function table
+    kind="mem"  -> tracemalloc allocation-site diff over the window
+    kind="dump" -> instantaneous stack of every thread (py-spy dump)
+    """
+    return _request(
+        {
+            "t": "profile_worker",
+            "worker_id": worker_id,
+            "kind": kind,
+            "duration_s": duration_s,
+            "interval_s": interval_s,
+        }
+    )

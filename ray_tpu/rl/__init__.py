@@ -23,6 +23,14 @@ from .config import AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
 from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace  # noqa: F401
 from .learner import Learner, LearnerGroup  # noqa: F401
+from .offline_algos import (  # noqa: F401
+    BC,
+    BCConfig,
+    CQL,
+    CQLConfig,
+    MARWIL,
+    MARWILConfig,
+)
 from .models import ac_apply, init_ac_params  # noqa: F401
 from .policy import Policy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401

@@ -154,6 +154,40 @@ def cmd_timeline(obs: _Observer, args) -> None:
     print(f"wrote {len(events)} events to {args.output} (open in chrome://tracing)")
 
 
+def cmd_profile(obs: _Observer, args) -> None:
+    """`ray_tpu profile <worker_id>` (reference: the dashboard's py-spy
+    "CPU Flame Graph"/"Stack Trace" buttons, profile_manager.py)."""
+    prof = obs.request(
+        {
+            "t": "profile_worker",
+            "worker_id": args.worker_id,
+            "kind": args.kind,
+            "duration_s": args.duration,
+        }
+    )
+    if args.json:
+        print(json.dumps(prof, indent=2))
+        return
+    if prof["kind"] == "cpu":
+        print(f"# {prof['samples']} samples over {prof['duration_s']}s")
+        print("# hot functions (self time):")
+        for row in prof["top"]:
+            print(f"  {row['pct']:5.1f}%  {row['samples']:6d}  {row['fn']}")
+        print("# collapsed stacks (flamegraph.pl format):")
+        for line in prof["collapsed"]:
+            print(line)
+    elif prof["kind"] == "mem":
+        print(f"# traced {prof['traced_current_kb']} KB now, "
+              f"peak {prof['traced_peak_kb']} KB; top growth sites:")
+        for row in prof["top"]:
+            print(f"  {row['size_diff_kb']:+10.1f} KB  {row['site']}")
+    else:
+        for name, stack in prof["threads"].items():
+            print(f"thread {name}:")
+            for frame in stack:
+                print(f"  {frame}")
+
+
 def cmd_metrics(obs: _Observer, args) -> None:
     store = obs.request({"t": "get_metrics"})
     # per-process dump (export_prometheus's cluster merge needs a connected
@@ -230,6 +264,11 @@ def main(argv=None) -> None:
     p_ev = sub.add_parser("events", help="head handler latency stats")
     p_ev.add_argument("--json", action="store_true")
     sub.add_parser("dashboard", help="print (and open) the live dashboard URL")
+    p_prof = sub.add_parser("profile", help="profile a live worker (CPU/mem/stack)")
+    p_prof.add_argument("worker_id")
+    p_prof.add_argument("--kind", choices=("cpu", "mem", "dump"), default="cpu")
+    p_prof.add_argument("--duration", type=float, default=2.0)
+    p_prof.add_argument("--json", action="store_true")
     p_start = sub.add_parser("start", help="start a head or join as a node agent")
     p_start.add_argument("--head", action="store_true")
     p_start.add_argument("--address", help="head host:port to join as a node")
@@ -265,6 +304,7 @@ def main(argv=None) -> None:
             "list": cmd_list,
             "timeline": cmd_timeline,
             "metrics": cmd_metrics,
+            "profile": cmd_profile,
         }[args.cmd](obs, args)
     finally:
         obs.close()

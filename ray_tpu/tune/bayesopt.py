@@ -1,0 +1,233 @@
+"""Gaussian-process Bayesian-optimization searcher (numpy-only).
+
+Reference parity: tune/search/bayesopt/bayesopt_search.py — the reference
+wraps the external `bayes_opt` package (GP + acquisition-function argmax).
+This is a self-contained equivalent: an RBF-kernel GP posterior fit on
+observed (config, score) pairs in the unit cube, Expected Improvement
+acquisition maximized over a random candidate cloud. Handles Float /
+Integer / Quantized / loguniform dimensions (via the same unit-cube warps
+TPE uses) and Categoricals by one-hot relaxation.
+
+Mode handling matches the Searcher contract: scores are internally
+maximized (mode="min" negates).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .search import Categorical, Domain, Searcher
+from .tpe import _NumericDim, _flatten_domains, _unflatten
+
+
+class _GP:
+    """RBF-kernel GP regression with a tiny 1-D lengthscale grid search."""
+
+    def __init__(self, noise: float = 1e-6):
+        self.noise = noise
+        self.X: Optional[np.ndarray] = None
+        self.y_mean = 0.0
+        self.y_std = 1.0
+        self.alpha: Optional[np.ndarray] = None
+        self.L: Optional[np.ndarray] = None
+        self.ls = 0.3
+
+    @staticmethod
+    def _k(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (ls * ls))
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> None:
+        self.X = X
+        self.y_mean = float(y.mean())
+        self.y_std = float(y.std()) or 1.0
+        yn = (y - self.y_mean) / self.y_std
+        best_ll, best = -np.inf, None
+        n = len(X)
+        for ls in (0.1, 0.2, 0.3, 0.5, 1.0):
+            K = self._k(X, X, ls) + (self.noise + 1e-8) * np.eye(n)
+            try:
+                L = np.linalg.cholesky(K)
+            except np.linalg.LinAlgError:
+                continue
+            a = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            # log marginal likelihood (up to constants)
+            ll = -0.5 * yn @ a - np.log(np.diag(L)).sum()
+            if ll > best_ll:
+                best_ll, best = ll, (ls, L, a)
+        if best is None:  # numerically degenerate: flat prior
+            self.alpha = None
+            return
+        self.ls, self.L, self.alpha = best[0], best[1], best[2]
+
+    def predict(self, Xq: np.ndarray):
+        if self.alpha is None or self.X is None:
+            mu = np.zeros(len(Xq))
+            return mu + self.y_mean, np.ones(len(Xq)) * self.y_std
+        Ks = self._k(Xq, self.X, self.ls)
+        mu = Ks @ self.alpha
+        v = np.linalg.solve(self.L, Ks.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        return mu * self.y_std + self.y_mean, np.sqrt(var) * self.y_std
+
+
+def _norm_pdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+
+
+def _norm_cdf(z: np.ndarray) -> np.ndarray:
+    from math import sqrt
+
+    try:
+        from scipy.special import erf  # scipy ships with pyarrow env; optional
+        return 0.5 * (1.0 + erf(z / sqrt(2.0)))
+    except Exception:
+        # Abramowitz-Stegun erf approximation (max err ~1.5e-7)
+        x = z / np.sqrt(2.0)
+        s = np.sign(x)
+        x = np.abs(x)
+        t = 1.0 / (1.0 + 0.3275911 * x)
+        poly = t * (0.254829592 + t * (-0.284496736 + t * (1.421413741
+                    + t * (-1.453152027 + t * 1.061405429))))
+        return 0.5 * (1.0 + s * (1.0 - poly * np.exp(-x * x)))
+
+
+class BayesOptSearcher(Searcher):
+    """GP-EI searcher: random for `n_startup_trials`, then argmax-EI over a
+    random candidate cloud. Usage mirrors TPESearcher:
+
+        Tuner(train_fn, param_space=space,
+              tune_config=TuneConfig(search_alg=BayesOptSearcher(),
+                                     metric="loss", mode="min",
+                                     num_samples=30))
+    """
+
+    def __init__(
+        self,
+        space: Optional[Dict[str, Any]] = None,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        n_startup_trials: int = 8,
+        n_candidates: int = 512,
+        xi: float = 0.01,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric=metric, mode=mode)
+        self._space: Dict[str, Any] = {}
+        self._dims: Dict[str, Any] = {}
+        self._startup = n_startup_trials
+        self._n_cand = n_candidates
+        self._xi = xi
+        self._rng = np.random.default_rng(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}  # trial_id -> flat config
+        self._obs: List[Dict[str, Any]] = []
+        self._scores: List[float] = []
+        if space:
+            self._ingest_space(space)
+
+    # -- space ------------------------------------------------------------
+    def _ingest_space(self, config: Dict[str, Any]) -> None:
+        self._space = _flatten_domains(config)
+        for path, dom in self._space.items():
+            if isinstance(dom, Categorical):
+                self._dims[path] = dom
+            elif isinstance(dom, Domain):
+                self._dims[path] = _NumericDim(dom)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        ok = super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._ingest_space(config)
+        return ok
+
+    def _vec_width(self) -> int:
+        w = 0
+        for d in self._dims.values():
+            w += len(d.categories) if isinstance(d, Categorical) else 1
+        return w
+
+    def _to_vec(self, flat: Dict[str, Any]) -> np.ndarray:
+        out: List[float] = []
+        for path, d in self._dims.items():
+            v = flat[path]
+            if isinstance(d, Categorical):
+                one = [0.0] * len(d.categories)
+                try:
+                    one[d.categories.index(v)] = 1.0
+                except ValueError:
+                    pass
+                out.extend(one)
+            else:
+                out.append(d.to_unit(v))
+        return np.asarray(out)
+
+    def _from_vec(self, vec: np.ndarray) -> Dict[str, Any]:
+        flat: Dict[str, Any] = {}
+        i = 0
+        for path, d in self._dims.items():
+            if isinstance(d, Categorical):
+                k = len(d.categories)
+                flat[path] = d.categories[int(np.argmax(vec[i:i + k]))]
+                i += k
+            else:
+                flat[path] = d.from_unit(float(vec[i]))
+                i += 1
+        # constants (non-Domain leaves) pass through
+        for path, v in self._space.items():
+            if path not in self._dims:
+                flat[path] = v
+        return flat
+
+    def _random_vec(self, n: int) -> np.ndarray:
+        cols: List[np.ndarray] = []
+        for d in self._dims.values():
+            if isinstance(d, Categorical):
+                k = len(d.categories)
+                pick = self._rng.integers(0, k, size=n)
+                oh = np.zeros((n, k))
+                oh[np.arange(n), pick] = 1.0
+                cols.append(oh)
+            else:
+                cols.append(self._rng.random((n, 1)))
+        return np.concatenate(cols, axis=1) if cols else np.zeros((n, 0))
+
+    # -- Searcher API ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._space:
+            raise ValueError("BayesOptSearcher needs a param_space")
+        n_done = len(self._scores)
+        if n_done < self._startup or self._vec_width() == 0:
+            vec = self._random_vec(1)[0]
+        else:
+            X = np.stack([self._to_vec(f) for f in self._obs])
+            y = np.asarray(self._scores)  # already max-oriented
+            gp = _GP()
+            gp.fit(X, y)
+            cand = self._random_vec(self._n_cand)
+            # densify around the incumbent: half the cloud perturbs the best
+            best = X[int(np.argmax(y))]
+            half = len(cand) // 2
+            cand[:half] = np.clip(
+                best[None, :] + self._rng.normal(0, 0.1, size=(half, cand.shape[1])),
+                0.0, 1.0,
+            )
+            mu, sigma = gp.predict(cand)
+            f_best = float(y.max())
+            z = (mu - f_best - self._xi) / sigma
+            ei = (mu - f_best - self._xi) * _norm_cdf(z) + sigma * _norm_pdf(z)
+            vec = cand[int(np.argmax(ei))]
+        flat = self._from_vec(vec)
+        self._live[trial_id] = flat
+        return _unflatten(flat)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        flat = self._live.pop(trial_id, None)
+        if flat is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if (self.mode or "max") == "min":
+            score = -score
+        self._obs.append(flat)
+        self._scores.append(score)

@@ -1,0 +1,117 @@
+"""On-demand profiling (reference: dashboard/modules/reporter/
+profile_manager.py — py-spy/memray; here: in-process samplers)."""
+
+import threading
+import time
+
+import numpy as np
+
+
+def _busy_marker_fn(stop):
+    """Recognizable leaf frame that burns CPU until told to stop."""
+    while not stop.is_set():
+        sum(i * i for i in range(2000))
+
+
+def test_cpu_sampler_finds_hot_function():
+    from ray_tpu.util.profiling import (
+        collapsed_lines, cpu_profile, sample_stacks, top_functions,
+    )
+
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,), name="busy")
+    t.start()
+    try:
+        agg = sample_stacks(duration_s=1.0, interval_s=0.005)
+    finally:
+        stop.set()
+        t.join()
+    lines = collapsed_lines(agg)
+    assert any("_busy_marker_fn" in ln for ln in lines), lines[:5]
+    top = top_functions(agg)
+    assert any("_busy_marker_fn" in row["fn"] or "genexpr" in row["fn"]
+               for row in top[:3]), top
+    # full RPC body shape
+    stop2 = threading.Event()
+    t2 = threading.Thread(target=_busy_marker_fn, args=(stop2,))
+    t2.start()
+    try:
+        prof = cpu_profile(duration_s=0.5)
+    finally:
+        stop2.set()
+        t2.join()
+    assert prof["kind"] == "cpu" and prof["samples"] > 0
+    assert isinstance(prof["collapsed"], list) and prof["top"]
+
+
+def test_memory_profile_sees_allocations():
+    from ray_tpu.util.profiling import memory_profile
+
+    hold = []
+
+    def alloc():
+        deadline = time.monotonic() + 0.8
+        while time.monotonic() < deadline:
+            hold.append(np.ones(64 * 1024, dtype=np.uint8))
+            time.sleep(0.01)
+
+    t = threading.Thread(target=alloc)
+    t.start()
+    prof = memory_profile(duration_s=0.6)
+    t.join()
+    assert prof["kind"] == "mem"
+    assert prof["traced_peak_kb"] > 0
+    assert isinstance(prof["top"], list) and prof["top"]
+    del hold
+
+
+def test_profile_worker_rpc(ray_start_regular):
+    """Driver -> head -> worker profile round-trip (reference: dashboard
+    profiling endpoints; here the state API's profile_worker)."""
+    import ray_tpu
+    from ray_tpu.experimental.state.api import list_actors, profile_worker
+
+    @ray_tpu.remote
+    class Burner:
+        def ready(self):
+            return True
+
+        def burn(self, seconds):
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                sum(i * i for i in range(5000))
+            return True
+
+    a = Burner.remote()
+    assert ray_tpu.get(a.ready.remote())
+    wid = next(
+        act["worker_id"] for act in list_actors() if act["class_name"] == "Burner"
+    )
+    ref = a.burn.remote(4.0)  # keep the executor thread hot while sampling
+    prof = profile_worker(wid, kind="cpu", duration_s=1.0)
+    assert prof["kind"] == "cpu" and prof["samples"] > 0
+    assert any("burn" in ln for ln in prof["collapsed"]), prof["collapsed"][:5]
+    dump = profile_worker(wid, kind="dump")
+    assert dump["threads"]
+    mem = profile_worker(wid, kind="mem", duration_s=0.3)
+    assert mem["kind"] == "mem"
+    assert ray_tpu.get(ref)
+    import pytest
+
+    with pytest.raises(Exception):
+        profile_worker("nonexistent-worker-id")
+
+
+def test_stack_dump_lists_threads():
+    from ray_tpu.util.profiling import stack_dump
+
+    stop = threading.Event()
+    t = threading.Thread(target=lambda: stop.wait(5), name="parked")
+    t.start()
+    try:
+        d = stack_dump()
+    finally:
+        stop.set()
+        t.join()
+    assert d["kind"] == "dump"
+    assert "parked" in d["threads"]

@@ -1,0 +1,117 @@
+"""Offline RL: BC / MARWIL / CQL train from JsonWriter shards without an env
+(reference: rllib/algorithms/bc, marwil, cql)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.offline import JsonWriter
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+
+
+def _expert_action(obs: np.ndarray) -> np.ndarray:
+    """Ground truth policy: action = which half of the 2-D obs is larger."""
+    return (obs[:, 1] > obs[:, 0]).astype(np.int64)
+
+
+def _make_offline(tmp_path, n=2048, expert_frac=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    best = _expert_action(obs)
+    rand = rng.integers(0, 2, size=n)
+    pick_expert = rng.random(n) < expert_frac
+    actions = np.where(pick_expert, best, rand).astype(np.int64)
+    rewards = (actions == best).astype(np.float32)  # 1 for the right action
+    batch = SampleBatch({
+        OBS: obs,
+        ACTIONS: actions,
+        REWARDS: rewards,
+        NEXT_OBS: rng.uniform(-1, 1, size=(n, 2)).astype(np.float32),
+        DONES: np.ones(n, np.float32),  # 1-step bandit episodes
+    })
+    path = str(tmp_path / "shards")
+    with JsonWriter(path) as w:
+        w.write(batch)
+    return path
+
+
+def _accuracy(learner, seed=123):
+    rng = np.random.default_rng(seed)
+    obs = rng.uniform(-1, 1, size=(512, 2)).astype(np.float32)
+    pred = learner.compute_actions(obs)
+    return float((pred == _expert_action(obs)).mean())
+
+
+def test_bc_imitates_expert(tmp_path):
+    from ray_tpu.rl.offline_algos import BC, BCConfig
+
+    cfg = BCConfig()
+    cfg.input_path = _make_offline(tmp_path, expert_frac=1.0)
+    cfg.training(lr=3e-3, train_batch_size=2048, minibatch_size=256,
+                 num_epochs=2)
+    algo = BC(cfg)
+    for _ in range(20):
+        metrics = algo.step()
+    assert np.isfinite(metrics["loss"])
+    assert _accuracy(algo.learner_group) > 0.9
+    # checkpoint round-trip restores the policy
+    ckpt = algo.save_checkpoint()
+    algo2 = BC(cfg)
+    algo2.load_checkpoint(ckpt)
+    assert _accuracy(algo2.learner_group) > 0.9
+
+
+def test_marwil_advantage_weighting_beats_bc_on_mixed_data(tmp_path):
+    """With half-random data, plain BC imitates the mixture; MARWIL's
+    exp-advantage weighting should lean toward the rewarded actions."""
+    from ray_tpu.rl.offline_algos import BC, BCConfig, MARWIL, MARWILConfig
+
+    path = _make_offline(tmp_path, expert_frac=0.5, seed=1)
+
+    bc_cfg = BCConfig()
+    bc_cfg.input_path = path
+    bc_cfg.training(lr=3e-3, train_batch_size=2048, minibatch_size=256,
+                    num_epochs=2)
+    bc = BC(bc_cfg)
+    for _ in range(15):
+        bc.step()
+
+    mw_cfg = MARWILConfig()
+    mw_cfg.input_path = path
+    mw_cfg.training(lr=3e-3, train_batch_size=2048, minibatch_size=256,
+                    num_epochs=2, beta=3.0)
+    mw = MARWIL(mw_cfg)
+    for _ in range(15):
+        mw.step()
+
+    acc_bc = _accuracy(bc.learner_group)
+    acc_mw = _accuracy(mw.learner_group)
+    # mixture data: BC ceiling ~ the 75% action frequency; MARWIL should
+    # exceed it by weighting rewarded transitions
+    assert acc_mw > acc_bc - 0.02  # never meaningfully worse
+    assert acc_mw > 0.85
+
+
+def test_cql_learns_q_from_rewards(tmp_path):
+    from ray_tpu.rl.offline_algos import CQL, CQLConfig
+
+    cfg = CQLConfig()
+    cfg.input_path = _make_offline(tmp_path, expert_frac=0.5, seed=2)
+    cfg.training(lr=3e-3, train_batch_size=2048, minibatch_size=256,
+                 num_epochs=2, cql_alpha=0.5)
+    algo = CQL(cfg)
+    for _ in range(25):
+        metrics = algo.step()
+    assert np.isfinite(metrics["loss"])
+    # greedy-Q policy should recover the rewarded action from mixed data
+    assert _accuracy(algo.learner_group) > 0.9
+
+
+def test_missing_input_path_raises():
+    from ray_tpu.rl.offline_algos import CQL, CQLConfig, MARWIL, MARWILConfig
+
+    with pytest.raises(ValueError, match="input_path"):
+        MARWIL(MARWILConfig())
+    with pytest.raises(ValueError, match="input_path"):
+        CQL(CQLConfig())
