@@ -1,0 +1,105 @@
+// Concurrency stress harness for the shared-memory object store.
+//
+// Reference parity: the reference builds its C++ core under TSAN/ASAN in CI
+// (.bazelrc tsan/asan configs) and relies on stress tests to surface data
+// races. This binary is compiled together with shm_store.cc under
+// -fsanitize=thread / -fsanitize=address (cpp/Makefile stress_tsan /
+// stress_asan targets) and driven from tests/test_sanitizers.py: N threads
+// hammer create/seal/get/release/delete/evict against ONE store session;
+// any race/UB the sanitizer sees fails the run.
+//
+// Usage: shm_store_stress <session> [threads] [iters]
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* shm_store_connect(const char* session, int64_t capacity_bytes);
+void* shm_store_create(void* handle, const char* name, int64_t size, int32_t pin);
+int shm_store_seal(void* handle, const char* name);
+void* shm_store_get(void* handle, const char* name, int64_t* size_out);
+int shm_store_release(void* handle, const char* name, void* mem);
+int shm_store_delete(void* handle, const char* name);
+int64_t shm_store_evict(void* handle, int64_t want_bytes);
+int64_t shm_store_used(void* handle);
+void shm_store_disconnect(void* handle);
+void shm_store_destroy(const char* session);
+}
+
+namespace {
+
+std::atomic<int64_t> g_errors{0};
+
+void worker(const char* session, int tid, int iters) {
+  // one handle per thread: exercises concurrent mappers of the same
+  // control block, the real multi-process topology collapsed to threads
+  void* h = shm_store_connect(session, 64 << 20);
+  if (h == nullptr) {
+    g_errors.fetch_add(1);
+    return;
+  }
+  char name[64];
+  for (int i = 0; i < iters; i++) {
+    snprintf(name, sizeof(name), "obj-%d-%d", tid, i % 32);
+    const int64_t size = 1024 + 512 * (i % 17);
+    void* buf = shm_store_create(h, name, size, /*pin=*/0);
+    if (buf == nullptr) {
+      // capacity pressure: evict and move on (allocation failure is a
+      // legal outcome under contention, not an error)
+      shm_store_evict(h, 4 << 20);
+      continue;
+    }
+    memset(buf, tid & 0xff, static_cast<size_t>(size));
+    if (shm_store_seal(h, name) != 0) g_errors.fetch_add(1);
+    int64_t got_size = 0;
+    void* ro = shm_store_get(h, name, &got_size);
+    if (ro != nullptr) {
+      if (got_size != size ||
+          static_cast<const unsigned char*>(ro)[size - 1] != (tid & 0xff)) {
+        // another thread may have deleted + reused the slot only for ITS
+        // OWN names (names are tid-scoped), so content must match
+        g_errors.fetch_add(1);
+      }
+      shm_store_release(h, name, ro);
+    }
+    if (i % 7 == 0) shm_store_delete(h, name);
+    if (i % 97 == 0) shm_store_evict(h, 1 << 20);
+  }
+  shm_store_disconnect(h);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <session> [threads] [iters]\n", argv[0]);
+    return 2;
+  }
+  const char* session = argv[1];
+  const int threads = argc > 2 ? atoi(argv[2]) : 8;
+  const int iters = argc > 3 ? atoi(argv[3]) : 2000;
+
+  shm_store_destroy(session);  // fresh segments for this run
+  std::vector<std::thread> ts;
+  ts.reserve(threads);
+  for (int t = 0; t < threads; t++) ts.emplace_back(worker, session, t, iters);
+  for (auto& t : ts) t.join();
+
+  void* h = shm_store_connect(session, 64 << 20);
+  const int64_t used = h ? shm_store_used(h) : -1;
+  if (h) shm_store_disconnect(h);
+  shm_store_destroy(session);
+
+  if (g_errors.load() != 0) {
+    fprintf(stderr, "FAIL: %ld errors\n", static_cast<long>(g_errors.load()));
+    return 1;
+  }
+  printf("OK threads=%d iters=%d used_at_end=%ld\n", threads, iters,
+         static_cast<long>(used));
+  return 0;
+}

@@ -194,8 +194,11 @@ class Dashboard:
             }
             try:
                 data = await self.head.handle(None, msg)
-            except Exception as e:
+            except ValueError as e:  # unknown/dead worker
                 return "404 Not Found", "text/plain", str(e).encode()
+            except Exception as e:  # timeout / internal failure
+                return ("500 Internal Server Error", "text/plain",
+                        (repr(e) or "profile failed").encode())
             return "200 OK", "application/json", json.dumps(data).encode()
         if kind == "logs":
             from urllib.parse import parse_qs, unquote

@@ -560,7 +560,8 @@ class Dataset:
         that can survive — _plan.push_limit)."""
         from ._plan import push_limit
 
-        capped = Dataset(self._block_fns, push_limit(self._ops, n))
+        capped = Dataset(self._block_fns, push_limit(self._ops, n),
+                         read_meta=self._read_meta)
         taken = []
         remaining = n
         for block in capped._iter_computed_blocks():
@@ -899,7 +900,8 @@ class Dataset:
     def take(self, limit: int = 20) -> List[Any]:
         from ._plan import push_limit
 
-        capped = Dataset(self._block_fns, push_limit(self._ops, limit))
+        capped = Dataset(self._block_fns, push_limit(self._ops, limit),
+                         read_meta=self._read_meta)
         out = []
         for row in capped.iter_rows():
             out.append(row)
@@ -920,7 +922,7 @@ class Dataset:
         ops = list(self._ops)
         while ops and _preserves_row_count(ops[-1]):
             ops.pop()
-        pruned = Dataset(self._block_fns, ops)
+        pruned = Dataset(self._block_fns, ops, read_meta=self._read_meta)
         return sum(_block_num_rows(b) for b in pruned._iter_computed_blocks())
 
     def explain(self) -> str:

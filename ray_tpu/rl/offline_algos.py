@@ -35,12 +35,17 @@ def _device_batch(batch: SampleBatch, keys) -> Dict[str, jnp.ndarray]:
 
 def _minibatch_scan(update_one, n_rows: int, minibatch_size: int, num_epochs: int):
     """Build the scan-of-scans driver shared by the offline learners:
-    epochs x minibatches with per-epoch reshuffle, all inside jit."""
+    epochs x minibatches with per-epoch reshuffle, all inside jit.
+
+    `update_one(state, mb, *extra)` receives `extra` traced as arguments of
+    the compiled program — anything that changes between calls (e.g. CQL's
+    target params) MUST ride through here, not a closure: jit would bake a
+    closed-over array in as a constant."""
     mbs = max(1, min(minibatch_size, n_rows))
     n_mb = max(1, n_rows // mbs)
 
     def epoch(carry, _):
-        state, data = carry
+        state, data, extra = carry
         rng, sub = jax.random.split(state.rng)
         perm = jax.random.permutation(sub, n_rows)
         state = state._replace(rng=rng)
@@ -48,15 +53,15 @@ def _minibatch_scan(update_one, n_rows: int, minibatch_size: int, num_epochs: in
         def mb_step(st, i):
             idx = jax.lax.dynamic_slice_in_dim(perm, i * mbs, mbs)
             mb = {k: v[idx] for k, v in data.items()}
-            st, metrics = update_one(st, mb)
+            st, metrics = update_one(st, mb, *extra)
             return st, metrics
 
         state, metrics = jax.lax.scan(mb_step, state, jnp.arange(n_mb))
-        return (state, data), metrics
+        return (state, data, extra), metrics
 
-    def run(state: TrainState, data: Dict[str, jnp.ndarray]):
-        (state, _), metrics = jax.lax.scan(
-            epoch, (state, data), None, length=num_epochs
+    def run(state: TrainState, data: Dict[str, jnp.ndarray], *extra):
+        (state, _, _), metrics = jax.lax.scan(
+            epoch, (state, data, extra), None, length=num_epochs
         )
         return state, {k: v[-1, -1] for k, v in metrics.items()}
 
@@ -267,9 +272,12 @@ class CQLLearner(Learner):
         data = _device_batch(batch, (OBS, ACTIONS, REWARDS, NEXT_OBS, DONES))
         n = data[OBS].shape[0]
 
-        def update_one(st, mb):
+        # target params ride as a traced ARGUMENT: a closure would be baked
+        # into the compiled program as a constant and target syncs below
+        # would silently never reach it
+        def update_one(st, mb, target_params):
             (_, metrics), grads = jax.value_and_grad(self.loss, has_aux=True)(
-                st.params, self.target_params, mb
+                st.params, target_params, mb
             )
             upd, opt_state = self.optimizer.update(grads, st.opt_state, st.params)
             return st._replace(
@@ -281,7 +289,7 @@ class CQLLearner(Learner):
             run = self._runs[n] = _minibatch_scan(
                 update_one, n, self.minibatch_size, self.num_epochs
             )
-        self.state, metrics = run(self.state, data)
+        self.state, metrics = run(self.state, data, self.target_params)
         self._updates += 1
         if self._updates % self.target_update_freq == 0:
             self.target_params = jax.tree_util.tree_map(jnp.copy, self.state.params)
@@ -314,3 +322,22 @@ class CQL(MARWIL):
         )
         self.workers = None
         self._rng = np.random.default_rng(cfg.seed)
+
+    def save_checkpoint(self) -> Any:
+        # the target network and sync counter are training state too — a
+        # resume that reinitializes them would bootstrap TD targets off a
+        # random network
+        return {
+            "weights": self.learner_group.get_weights(),
+            "target_weights": jax.device_get(self.learner_group.target_params),
+            "updates": self.learner_group._updates,
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.learner_group.set_weights(checkpoint["weights"])
+        tw = checkpoint.get("target_weights")
+        if tw is not None:
+            self.learner_group.target_params = jax.device_put(tw)
+        self.learner_group._updates = checkpoint.get("updates", 0)
+        self._timesteps_total = checkpoint.get("timesteps_total", 0)

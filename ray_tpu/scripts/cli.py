@@ -63,6 +63,13 @@ class _Observer:
         return fut.result(timeout + 5)
 
     def close(self):
+        # close the connection ON the loop first: stopping the loop with a
+        # live read-task leaks "Task was destroyed but it is pending" /
+        # "no running event loop" spew at interpreter exit
+        try:
+            asyncio.run_coroutine_threadsafe(self.conn.close(), self.loop).result(5)
+        except Exception:
+            pass
         self.loop.call_soon_threadsafe(self.loop.stop)
 
 
@@ -163,7 +170,10 @@ def cmd_profile(obs: _Observer, args) -> None:
             "worker_id": args.worker_id,
             "kind": args.kind,
             "duration_s": args.duration,
-        }
+        },
+        # the head itself waits duration+30 on the worker; an observer
+        # timeout below that would always fire first for long profiles
+        timeout=args.duration + 35.0,
     )
     if args.json:
         print(json.dumps(prof, indent=2))

@@ -108,6 +108,67 @@ def test_cql_learns_q_from_rewards(tmp_path):
     assert _accuracy(algo.learner_group) > 0.9
 
 
+def test_cql_target_network_syncs():
+    """The target net must follow the online net at sync points — a
+    closure-captured target would be jit-baked as a constant and never
+    move (regression guard for exactly that bug)."""
+    import jax
+
+    from ray_tpu.rl.offline_algos import CQLLearner
+
+    rng = np.random.default_rng(3)
+    n = 512
+    batch = SampleBatch({
+        OBS: rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        ACTIONS: rng.integers(0, 2, n).astype(np.int64),
+        REWARDS: rng.uniform(0, 1, n).astype(np.float32),
+        NEXT_OBS: rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        DONES: np.zeros(n, np.float32),  # NON-terminal: bootstrap term live
+    })
+    lrn = CQLLearner(2, 2, lr=1e-2, gamma=0.9, target_update_freq=3,
+                     minibatch_size=128, num_epochs=1, seed=0)
+    t0 = jax.device_get(lrn.target_params)
+    m1 = lrn.update(batch)
+    for _ in range(2):
+        m2 = lrn.update(batch)  # 3rd update triggers the sync
+    t1 = jax.device_get(lrn.target_params)
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(t0), jax.tree_util.tree_leaves(t1))
+    )
+    assert moved, "target network never synced"
+    # post-sync the target equals the online params exactly
+    online = jax.device_get(lrn.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(t1), jax.tree_util.tree_leaves(online)):
+        np.testing.assert_allclose(a, b)
+    # and the moved target changes the TD loss on the SAME data
+    assert m1["loss"] != m2["loss"]
+
+
+def test_cql_checkpoint_preserves_target(tmp_path):
+    from ray_tpu.rl.offline_algos import CQL, CQLConfig
+
+    cfg = CQLConfig()
+    cfg.input_path = _make_offline(tmp_path, expert_frac=0.5, seed=4)
+    cfg.training(lr=3e-3, train_batch_size=1024, minibatch_size=256,
+                 num_epochs=1, target_update_freq=2)
+    algo = CQL(cfg)
+    for _ in range(5):
+        algo.step()
+    ckpt = algo.save_checkpoint()
+    assert "target_weights" in ckpt and ckpt["updates"] == 5
+    algo2 = CQL(cfg)
+    algo2.load_checkpoint(ckpt)
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(algo.learner_group.target_params)),
+        jax.tree_util.tree_leaves(jax.device_get(algo2.learner_group.target_params)),
+    ):
+        np.testing.assert_allclose(a, b)
+    assert algo2.learner_group._updates == 5
+
+
 def test_missing_input_path_raises():
     from ray_tpu.rl.offline_algos import CQL, CQLConfig, MARWIL, MARWILConfig
 

@@ -1,0 +1,51 @@
+"""Sanitizer builds of the C++ shm store (reference parity: the tsan/asan
+CI configs for the C++ core, .bazelrc:95-102 + ci.sh asan build).
+
+The store's concurrency model (pthread robust mutex + atomics in a shared
+mapping) is exactly what TSAN exists to check; the stress harness
+(cpp/shm_store_stress.cc) hammers create/seal/get/release/delete/evict from
+many threads over one control block. Any reported race/UB fails the test
+via the sanitizer's nonzero exit (halt_on_error is the default for these
+flags' summaries: we additionally grep the output)."""
+
+import os
+import shutil
+import subprocess
+import uuid
+
+import pytest
+
+CPP = os.path.join(os.path.dirname(__file__), "..", "cpp")
+
+
+def _build(target: str) -> str:
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    r = subprocess.run(
+        ["make", "-s", "-C", CPP, target], capture_output=True, text=True
+    )
+    if r.returncode != 0:
+        pytest.skip(f"sanitizer build unavailable: {r.stderr[-300:]}")
+    return os.path.join(CPP, target)
+
+
+def _run_stress(binary: str, threads=8, iters=1500):
+    session = f"san{uuid.uuid4().hex[:8]}"
+    r = subprocess.run(
+        [binary, session, str(threads), str(iters)],
+        capture_output=True, text=True, timeout=300,
+    )
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "WARNING: ThreadSanitizer" not in out, out[-2000:]
+    assert "ERROR: AddressSanitizer" not in out, out[-2000:]
+    assert "runtime error" not in out, out[-2000:]  # UBSan
+    assert "OK threads=" in out
+
+
+def test_shm_store_stress_under_tsan():
+    _run_stress(_build("shm_store_stress_tsan"))
+
+
+def test_shm_store_stress_under_asan_ubsan():
+    _run_stress(_build("shm_store_stress_asan"))
