@@ -46,6 +46,10 @@ void worker(const char* session, int tid, int iters) {
   char name[64];
   for (int i = 0; i < iters; i++) {
     snprintf(name, sizeof(name), "obj-%d-%d", tid, i % 32);
+    // names are tid-scoped and cycle every 32 iterations: delete before
+    // reuse — a create on a LIVE name re-binds the existing entry, leaking
+    // its slab range and double-counting used/num_objects
+    if (i >= 32) shm_store_delete(h, name);
     const int64_t size = 1024 + 512 * (i % 17);
     void* buf = shm_store_create(h, name, size, /*pin=*/0);
     if (buf == nullptr) {
@@ -56,13 +60,17 @@ void worker(const char* session, int tid, int iters) {
     }
     memset(buf, tid & 0xff, static_cast<size_t>(size));
     if (shm_store_seal(h, name) != 0) g_errors.fetch_add(1);
+    // drop the CREATOR pin (the real client releases right after seal,
+    // shm.py — without this every object stays pinned forever and the
+    // evict / deferred-reap paths this harness exists to race never run)
+    shm_store_release(h, name, buf);
     int64_t got_size = 0;
     void* ro = shm_store_get(h, name, &got_size);
     if (ro != nullptr) {
       if (got_size != size ||
           static_cast<const unsigned char*>(ro)[size - 1] != (tid & 0xff)) {
-        // another thread may have deleted + reused the slot only for ITS
-        // OWN names (names are tid-scoped), so content must match
+        // names are tid-scoped, so content must match what THIS thread
+        // wrote (eviction yields ro==nullptr, not wrong bytes)
         g_errors.fetch_add(1);
       }
       shm_store_release(h, name, ro);

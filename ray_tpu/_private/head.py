@@ -1777,6 +1777,13 @@ class Head:
         if rec is None:
             raise ValueError("unknown placement group")
         timeout = msg.get("timeout")
+        # timeout=0 is a state POLL: wait_for(coro, 0) raises TimeoutError
+        # before the fresh event.wait() coroutine can even observe a set
+        # event, so check the flag directly first
+        if rec.ready_event.is_set():
+            return True
+        if timeout == 0:
+            return False
         try:
             await asyncio.wait_for(rec.ready_event.wait(), timeout)
             return True

@@ -1,0 +1,13 @@
+"""ray_tpu.air: shared execution substrate for the ML libraries.
+
+Reference parity: python/ray/air — here only the execution layer (the AIR
+Checkpoint/Predictor surfaces live in train/); see air/execution/.
+"""
+
+from .execution import (  # noqa: F401
+    ActorManager,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceRequest,
+    TrackedActor,
+)

@@ -1,0 +1,12 @@
+"""Generic tracked-actor fleets over pluggable resource managers
+(reference: air/execution/_internal/actor_manager.py:23 +
+air/execution/resources/)."""
+
+from .actor_manager import ActorManager, TrackedActor  # noqa: F401
+from .resources import (  # noqa: F401
+    AcquiredResources,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceManager,
+    ResourceRequest,
+)

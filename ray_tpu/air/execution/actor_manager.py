@@ -1,0 +1,232 @@
+"""ActorManager: the generic "fleet of tracked actors" layer.
+
+Reference parity: air/execution/_internal/actor_manager.py:23
+(RayActorManager) + tracked_actor.py/tracked_actor_task.py — the shared
+substrate under Tune trials and Train worker groups: request resources via
+a pluggable ResourceManager, start actors when grants arrive, route task
+results/errors to callbacks, and reclaim resources on stop/failure.
+
+Event delivery is callback-based and runs inside `next()` — the single-
+threaded poll loop the controller owns (the reference posts events into the
+same kind of loop). No background threads: determinism beats parallel
+bookkeeping at control-plane rates.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .resources import AcquiredResources, ResourceManager, ResourceRequest
+
+_counter = itertools.count()
+
+
+class TrackedActor:
+    """Opaque fleet member (reference: tracked_actor.py). States:
+    PENDING (waiting for resources) -> STARTING (actor created) ->
+    STARTED -> STOPPED | FAILED."""
+
+    PENDING = "PENDING"
+    STARTING = "STARTING"
+    STARTED = "STARTED"
+    STOPPED = "STOPPED"
+    FAILED = "FAILED"
+
+    def __init__(self, cls, kwargs, request, on_start, on_stop, on_error):
+        self.uid = next(_counter)
+        self.cls = cls
+        self.kwargs = dict(kwargs or {})
+        self.request = request
+        self.state = TrackedActor.PENDING
+        self.handle = None
+        self.acquired: Optional[AcquiredResources] = None
+        self.on_start = on_start
+        self.on_stop = on_stop
+        self.on_error = on_error
+        self._inflight: List[Tuple[Any, Optional[Callable], Optional[Callable]]] = []
+
+    def __repr__(self):
+        return f"TrackedActor({self.cls.__name__ if self.cls else '?'}#{self.uid}, {self.state})"
+
+
+class ActorManager:
+    def __init__(self, resource_manager: ResourceManager):
+        self.resource_manager = resource_manager
+        self._pending: List[TrackedActor] = []
+        self._live: Dict[int, TrackedActor] = {}
+
+    # ------------------------------------------------------------- fleet API
+
+    def add_actor(
+        self,
+        cls,
+        kwargs: Optional[Dict[str, Any]] = None,
+        resource_request: Optional[ResourceRequest] = None,
+        *,
+        on_start: Optional[Callable[[TrackedActor], None]] = None,
+        on_stop: Optional[Callable[[TrackedActor], None]] = None,
+        on_error: Optional[Callable[[TrackedActor, Exception], None]] = None,
+    ) -> TrackedActor:
+        request = resource_request or ResourceRequest([{"CPU": 1.0}])
+        ta = TrackedActor(cls, kwargs, request, on_start, on_stop, on_error)
+        self.resource_manager.request_resources(request)
+        self._pending.append(ta)
+        return ta
+
+    def schedule_actor_task(
+        self,
+        tracked: TrackedActor,
+        method: str,
+        args: tuple = (),
+        kwargs: Optional[Dict[str, Any]] = None,
+        *,
+        on_result: Optional[Callable[[TrackedActor, Any], None]] = None,
+        on_error: Optional[Callable[[TrackedActor, Exception], None]] = None,
+    ) -> None:
+        if tracked.state not in (TrackedActor.STARTING, TrackedActor.STARTED):
+            raise ValueError(f"{tracked} is not live")
+        ref = getattr(tracked.handle, method).remote(*args, **(kwargs or {}))
+        tracked._inflight.append((ref, on_result, on_error))
+
+    def remove_actor(self, tracked: TrackedActor) -> None:
+        """Graceful stop: kills the actor, frees its reservation, fires
+        on_stop. Safe on PENDING actors (cancels the resource request)."""
+        import ray_tpu
+
+        if tracked.state == TrackedActor.PENDING:
+            self.resource_manager.cancel_resource_request(tracked.request)
+            self._pending.remove(tracked)
+            tracked.state = TrackedActor.STOPPED
+            return
+        if tracked.handle is not None:
+            try:
+                ray_tpu.kill(tracked.handle)
+            except Exception:
+                pass
+        self._reclaim(tracked, TrackedActor.STOPPED)
+        if tracked.on_stop:
+            tracked.on_stop(tracked)
+
+    @property
+    def num_pending(self) -> int:
+        return len(self._pending)
+
+    @property
+    def num_live(self) -> int:
+        return len(self._live)
+
+    def live_actors(self) -> List[TrackedActor]:
+        return list(self._live.values())
+
+    # ------------------------------------------------------------- the loop
+
+    def next(self, timeout: float = 0.1) -> bool:
+        """Process ready events: start pending actors whose resources
+        arrived, deliver resolved task results, surface failures. Returns
+        True if anything happened (the controller's idle heuristic)."""
+        import time as _time
+
+        happened = self._start_ready()
+        happened = self._poll_tasks() or happened
+        happened = self._poll_health() or happened
+        if not happened and timeout > 0:
+            _time.sleep(min(timeout, 0.05))
+        return happened
+
+    def _start_ready(self) -> bool:
+        import ray_tpu
+
+        happened = False
+        for ta in list(self._pending):
+            acq = self.resource_manager.acquire_resources(ta.request)
+            if acq is None:
+                continue
+            opts = acq.annotate_remote_options({"max_concurrency": 2})
+            try:
+                ta.handle = ray_tpu.remote(ta.cls).options(**opts).remote(**ta.kwargs)
+            except Exception as e:
+                self.resource_manager.free_resources(acq)
+                self._pending.remove(ta)
+                ta.state = TrackedActor.FAILED
+                if ta.on_error:
+                    ta.on_error(ta, e)
+                happened = True
+                continue
+            ta.acquired = acq
+            ta.state = TrackedActor.STARTING
+            self._pending.remove(ta)
+            self._live[ta.uid] = ta
+            happened = True
+        return happened
+
+    def _poll_tasks(self) -> bool:
+        import ray_tpu
+
+        happened = False
+        for ta in list(self._live.values()):
+            still: List[Tuple[Any, Optional[Callable], Optional[Callable]]] = []
+            for ref, on_result, on_error in ta._inflight:
+                ready, _ = ray_tpu.wait([ref], timeout=0)
+                if not ready:
+                    still.append((ref, on_result, on_error))
+                    continue
+                happened = True
+                try:
+                    result = ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001
+                    if on_error:
+                        on_error(ta, e)
+                    else:
+                        self._fail(ta, e)
+                    continue
+                # first successful round-trip proves the actor is up
+                if ta.state == TrackedActor.STARTING:
+                    ta.state = TrackedActor.STARTED
+                    if ta.on_start:
+                        ta.on_start(ta)
+                if on_result:
+                    on_result(ta, result)
+            ta._inflight = still
+        return happened
+
+    def _poll_health(self) -> bool:
+        """Catch actors that died with no task in flight (restart storms,
+        OOM kills): the head's actor table is the truth."""
+        happened = False
+        for ta in list(self._live.values()):
+            if ta._inflight or ta.handle is None:
+                continue
+            try:
+                state = ta.handle._state()
+            except Exception:
+                continue
+            if state == "dead":
+                self._fail(ta, RuntimeError("actor died"))
+                happened = True
+            elif state == "alive" and ta.state == TrackedActor.STARTING:
+                ta.state = TrackedActor.STARTED
+                if ta.on_start:
+                    ta.on_start(ta)
+                happened = True
+        return happened
+
+    def _fail(self, ta: TrackedActor, err: Exception) -> None:
+        self._reclaim(ta, TrackedActor.FAILED)
+        if ta.on_error:
+            ta.on_error(ta, err)
+
+    def _reclaim(self, ta: TrackedActor, state: str) -> None:
+        self._live.pop(ta.uid, None)
+        ta.state = state
+        if ta.acquired is not None:
+            self.resource_manager.free_resources(ta.acquired)
+            ta.acquired = None
+
+    def shutdown(self) -> None:
+        for ta in list(self._live.values()):
+            self.remove_actor(ta)
+        for ta in list(self._pending):
+            self.remove_actor(ta)
+        self.resource_manager.clear()

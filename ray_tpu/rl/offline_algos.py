@@ -194,10 +194,24 @@ class MARWIL(Algorithm):
 
     def save_checkpoint(self) -> Any:
         return {"weights": self.learner_group.get_weights(),
+                "opt_state": jax.device_get(self.learner_group.state.opt_state),
+                "rng": jax.device_get(self.learner_group.state.rng),
+                # the driver-side batch sampler is training state too: a
+                # resumed run must draw the same sample sequence
+                "np_rng": self._rng.bit_generator.state,
                 "timesteps_total": self._timesteps_total}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
-        self.learner_group.set_weights(checkpoint["weights"])
+        lg = self.learner_group
+        lg.set_weights(checkpoint["weights"])
+        if checkpoint.get("opt_state") is not None:
+            lg.state = lg.state._replace(
+                opt_state=jax.device_put(checkpoint["opt_state"])
+            )
+        if checkpoint.get("rng") is not None:
+            lg.state = lg.state._replace(rng=jax.device_put(checkpoint["rng"]))
+        if checkpoint.get("np_rng") is not None:
+            self._rng.bit_generator.state = checkpoint["np_rng"]
         self._timesteps_total = checkpoint.get("timesteps_total", 0)
 
 
@@ -324,20 +338,34 @@ class CQL(MARWIL):
         self._rng = np.random.default_rng(cfg.seed)
 
     def save_checkpoint(self) -> Any:
-        # the target network and sync counter are training state too — a
-        # resume that reinitializes them would bootstrap TD targets off a
-        # random network
+        # target network, optimizer moments, rng, and sync counter are all
+        # training state — a resume that reinitializes any of them diverges
+        # from the uninterrupted run (random TD targets / zeroed Adam
+        # moments / replayed shuffles)
+        lg = self.learner_group
         return {
-            "weights": self.learner_group.get_weights(),
-            "target_weights": jax.device_get(self.learner_group.target_params),
-            "updates": self.learner_group._updates,
+            "weights": lg.get_weights(),
+            "target_weights": jax.device_get(lg.target_params),
+            "opt_state": jax.device_get(lg.state.opt_state),
+            "rng": jax.device_get(lg.state.rng),
+            "np_rng": self._rng.bit_generator.state,
+            "updates": lg._updates,
             "timesteps_total": self._timesteps_total,
         }
 
     def load_checkpoint(self, checkpoint: Any) -> None:
-        self.learner_group.set_weights(checkpoint["weights"])
+        lg = self.learner_group
+        lg.set_weights(checkpoint["weights"])
         tw = checkpoint.get("target_weights")
         if tw is not None:
-            self.learner_group.target_params = jax.device_put(tw)
-        self.learner_group._updates = checkpoint.get("updates", 0)
+            lg.target_params = jax.device_put(tw)
+        if checkpoint.get("opt_state") is not None:
+            lg.state = lg.state._replace(
+                opt_state=jax.device_put(checkpoint["opt_state"])
+            )
+        if checkpoint.get("rng") is not None:
+            lg.state = lg.state._replace(rng=jax.device_put(checkpoint["rng"]))
+        if checkpoint.get("np_rng") is not None:
+            self._rng.bit_generator.state = checkpoint["np_rng"]
+        lg._updates = checkpoint.get("updates", 0)
         self._timesteps_total = checkpoint.get("timesteps_total", 0)

@@ -1,0 +1,111 @@
+"""Generic actor-manager layer (reference: air/execution/_internal/
+actor_manager.py + air/execution/resources/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air.execution import (
+    ActorManager,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceRequest,
+    TrackedActor,
+)
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def crash(self):
+        import os
+
+        os._exit(1)
+
+
+def _drive(mgr, until, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not until():
+        mgr.next(timeout=0.05)
+        assert time.monotonic() < deadline, "actor-manager drive timed out"
+
+
+def test_fixed_manager_fleet_and_results(ray_start_regular):
+    mgr = ActorManager(FixedResourceManager({"CPU": 2.0}))
+    started, results = [], []
+    actors = [
+        mgr.add_actor(
+            Counter, {"start": i * 100},
+            ResourceRequest([{"CPU": 1.0}]),
+            on_start=started.append,
+        )
+        for i in range(4)  # budget admits 2 at a time
+    ]
+    _drive(mgr, lambda: mgr.num_live == 2)
+    assert mgr.num_pending == 2  # budget respected
+
+    for ta in mgr.live_actors():
+        mgr.schedule_actor_task(ta, "incr", (5,),
+                                on_result=lambda ta, r: results.append(r))
+    _drive(mgr, lambda: len(results) == 2)
+    assert sorted(r % 100 for r in results) == [5, 5]
+    assert len(started) == 2  # first round-trip marked them STARTED
+
+    # removing live actors frees budget: the two pending ones start
+    for ta in list(mgr.live_actors()):
+        mgr.remove_actor(ta)
+    _drive(mgr, lambda: mgr.num_live == 2 and mgr.num_pending == 0)
+    mgr.shutdown()
+    assert mgr.num_live == 0
+
+
+def test_actor_failure_reclaims_resources(ray_start_regular):
+    mgr = ActorManager(FixedResourceManager({"CPU": 1.0}))
+    errors = []
+    ta = mgr.add_actor(Counter, resource_request=ResourceRequest([{"CPU": 1.0}]),
+                       on_error=lambda ta, e: errors.append(e))
+    _drive(mgr, lambda: mgr.num_live == 1)
+    mgr.schedule_actor_task(ta, "crash")
+    _drive(mgr, lambda: len(errors) == 1)
+    assert ta.state == TrackedActor.FAILED or errors
+    # budget is free again: a replacement starts
+    tb = mgr.add_actor(Counter, resource_request=ResourceRequest([{"CPU": 1.0}]))
+    _drive(mgr, lambda: tb.state in (TrackedActor.STARTING, TrackedActor.STARTED))
+    mgr.shutdown()
+
+
+def test_pg_manager_gang_grant(ray_start_regular):
+    mgr = ActorManager(PlacementGroupResourceManager())
+    req = ResourceRequest([{"CPU": 1.0}, {"CPU": 1.0}], strategy="PACK")
+    results = []
+    ta = mgr.add_actor(Counter, {"start": 7}, req)
+    _drive(mgr, lambda: mgr.num_live == 1)
+    mgr.schedule_actor_task(ta, "incr", on_result=lambda ta, r: results.append(r))
+    _drive(mgr, lambda: results == [8])
+    # the grant was a real PG
+    assert ta.acquired is not None and getattr(ta.acquired, "pg", None) is not None
+    pgid = ta.acquired.pg.id
+    from ray_tpu.util.placement_group import placement_group_table
+
+    assert placement_group_table()[pgid]["state"] == "created"
+    mgr.remove_actor(ta)
+    # freeing removed the PG
+    tbl = placement_group_table()
+    assert pgid not in tbl or tbl[pgid]["state"] == "removed"
+    mgr.shutdown()
+
+
+def test_cancel_pending_request(ray_start_regular):
+    mgr = ActorManager(FixedResourceManager({"CPU": 0.0}))  # nothing fits
+    ta = mgr.add_actor(Counter)
+    mgr.next(timeout=0.01)
+    assert ta.state == TrackedActor.PENDING
+    mgr.remove_actor(ta)
+    assert ta.state == TrackedActor.STOPPED and mgr.num_pending == 0
+    mgr.shutdown()
