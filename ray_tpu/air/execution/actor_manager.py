@@ -15,7 +15,6 @@ bookkeeping at control-plane rates.
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .resources import AcquiredResources, ResourceManager, ResourceRequest
@@ -92,9 +91,14 @@ class ActorManager:
 
     def remove_actor(self, tracked: TrackedActor) -> None:
         """Graceful stop: kills the actor, frees its reservation, fires
-        on_stop. Safe on PENDING actors (cancels the resource request)."""
+        on_stop. Safe on PENDING actors (cancels the resource request).
+        Idempotent: a second call — or a call after _fail already settled
+        the actor — is a no-op, so a controller reacting to on_error with
+        remove_actor never sees on_stop shadow the failure."""
         import ray_tpu
 
+        if tracked.state in (TrackedActor.STOPPED, TrackedActor.FAILED):
+            return
         if tracked.state == TrackedActor.PENDING:
             self.resource_manager.cancel_resource_request(tracked.request)
             self._pending.remove(tracked)
@@ -164,12 +168,21 @@ class ActorManager:
     def _poll_tasks(self) -> bool:
         import ray_tpu
 
+        # ONE wait RPC for every in-flight ref across the fleet (not one
+        # per ref): a 50-actor fleet polling at 20 Hz must not turn into
+        # 1000 head round-trips/s of idle overhead
+        all_refs = [ref for ta in self._live.values() for ref, _, _ in ta._inflight]
+        if not all_refs:
+            return False
+        ready_refs, _ = ray_tpu.wait(
+            all_refs, num_returns=len(all_refs), timeout=0
+        )
+        ready_set = set(ready_refs)
         happened = False
         for ta in list(self._live.values()):
             still: List[Tuple[Any, Optional[Callable], Optional[Callable]]] = []
             for ref, on_result, on_error in ta._inflight:
-                ready, _ = ray_tpu.wait([ref], timeout=0)
-                if not ready:
+                if ref not in ready_set:
                     still.append((ref, on_result, on_error))
                     continue
                 happened = True
@@ -191,9 +204,19 @@ class ActorManager:
             ta._inflight = still
         return happened
 
+    _HEALTH_PERIOD_S = 0.5
+
     def _poll_health(self) -> bool:
         """Catch actors that died with no task in flight (restart storms,
-        OOM kills): the head's actor table is the truth."""
+        OOM kills): the head's actor table is the truth. Rate-limited — a
+        tight controller loop must not turn idle actors into a per-tick
+        actor_state RPC storm on the head."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now - getattr(self, "_last_health", 0.0) < self._HEALTH_PERIOD_S:
+            return False
+        self._last_health = now
         happened = False
         for ta in list(self._live.values()):
             if ta._inflight or ta.handle is None:
@@ -213,6 +236,20 @@ class ActorManager:
         return happened
 
     def _fail(self, ta: TrackedActor, err: Exception) -> None:
+        # idempotent: two errored in-flight refs resolving in one poll pass
+        # must not fire on_error twice or double-free the reservation
+        if ta.state in (TrackedActor.FAILED, TrackedActor.STOPPED):
+            return
+        # the process may still be running (an app-level exception does not
+        # kill an actor) — a reservation must never be freed while its
+        # holder lives, or the replacement oversubscribes the node
+        if ta.handle is not None:
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(ta.handle)
+            except Exception:
+                pass
         self._reclaim(ta, TrackedActor.FAILED)
         if ta.on_error:
             ta.on_error(ta, err)

@@ -31,7 +31,10 @@ from ..ops.norm import rms_norm
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import apply_rope, apply_rope_bhsd, rope_frequencies
 from ..ops.ulysses import ulysses_attention
-from ..ops.losses import softmax_cross_entropy_with_int_labels
+from ..ops.losses import (
+    blockwise_softmax_cross_entropy,
+    softmax_cross_entropy_with_int_labels,
+)
 from ..parallel.sharding import ShardingRules, constrain
 
 
@@ -80,6 +83,11 @@ class TransformerConfig:
     # pipeline parallelism: >1 splits the layer stack into pp stages
     pp_stages: int = 1
     pp_microbatches: int = 4
+    # >0: the training loss never materializes full [tokens, vocab] logits;
+    # the unembed matmul + log-softmax run per seq-chunk of this size under
+    # jax.checkpoint (ops/losses.py blockwise_softmax_cross_entropy). Frees
+    # O(tokens x vocab) residual HBM — worth a batch-size step on 16G chips
+    loss_chunk: int = 0
 
     def flops_per_token(self) -> float:
         """Approximate training FLOPs/token (fwd+bwd ≈ 6 * params-matmul)."""
@@ -319,6 +327,7 @@ def make_forward(
     cfg: TransformerConfig,
     rules: Optional[ShardingRules] = None,
     mesh=None,
+    _return_backbone: bool = False,
 ):
     """Build forward(params, tokens) -> logits.
 
@@ -459,7 +468,10 @@ def make_forward(
 
     _MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router")
 
-    def forward(params, tokens):
+    def backbone(params, tokens):
+        """Everything up to (and including) the final norm; returns the
+        final hidden states plus the compute-dtype unembed matrix so the
+        loss can choose how to project them (dense vs blockwise)."""
         x = params["embed"].astype(cfg.dtype)[tokens]
         x = _constrain(x, "batch", "seq", "embed")
         # cast the stacked matmul weights to compute dtype ONCE — otherwise
@@ -476,23 +488,38 @@ def make_forward(
         unembed = params.get("unembed")
         if unembed is None:
             unembed = params["embed"].T
-        logits = jnp.einsum("bse,ev->bsv", x, unembed.astype(cfg.dtype))
+        return x, unembed.astype(cfg.dtype)
+
+    def forward(params, tokens):
+        x, unembed = backbone(params, tokens)
+        logits = jnp.einsum("bse,ev->bsv", x, unembed)
         logits = _constrain(logits, "batch", "seq", "vocab")
         return logits
 
+    if _return_backbone:
+        return forward, backbone, _constrain
     return forward
 
 
 def make_loss_fn(cfg: TransformerConfig, rules=None, mesh=None):
-    forward = make_forward(cfg, rules, mesh)
+    forward, backbone, _constrain = make_forward(
+        cfg, rules, mesh, _return_backbone=True
+    )
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
-        logits = forward(params, tokens[:, :-1])
         labels = tokens[:, 1:]
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:].astype(bool)
+        if cfg.loss_chunk:
+            x, unembed = backbone(params, tokens[:, :-1])
+            loss, _ = blockwise_softmax_cross_entropy(
+                x, unembed, labels, where=mask, chunk=cfg.loss_chunk,
+                constrain_logits=lambda l: _constrain(l, "batch", "seq", "vocab"),
+            )
+            return loss
+        logits = forward(params, tokens[:, :-1])
         loss, _ = softmax_cross_entropy_with_int_labels(logits, labels, where=mask)
         return loss
 

@@ -338,34 +338,21 @@ class CQL(MARWIL):
         self._rng = np.random.default_rng(cfg.seed)
 
     def save_checkpoint(self) -> Any:
-        # target network, optimizer moments, rng, and sync counter are all
-        # training state — a resume that reinitializes any of them diverges
-        # from the uninterrupted run (random TD targets / zeroed Adam
-        # moments / replayed shuffles)
+        # MARWIL's checkpoint (weights/opt_state/rng/np_rng/timesteps)
+        # plus CQL's extra training state: the target network and the
+        # target-sync counter — a resume that reinitializes either
+        # diverges (random TD targets / off-schedule syncs)
         lg = self.learner_group
         return {
-            "weights": lg.get_weights(),
+            **super().save_checkpoint(),
             "target_weights": jax.device_get(lg.target_params),
-            "opt_state": jax.device_get(lg.state.opt_state),
-            "rng": jax.device_get(lg.state.rng),
-            "np_rng": self._rng.bit_generator.state,
             "updates": lg._updates,
-            "timesteps_total": self._timesteps_total,
         }
 
     def load_checkpoint(self, checkpoint: Any) -> None:
+        super().load_checkpoint(checkpoint)
         lg = self.learner_group
-        lg.set_weights(checkpoint["weights"])
         tw = checkpoint.get("target_weights")
         if tw is not None:
             lg.target_params = jax.device_put(tw)
-        if checkpoint.get("opt_state") is not None:
-            lg.state = lg.state._replace(
-                opt_state=jax.device_put(checkpoint["opt_state"])
-            )
-        if checkpoint.get("rng") is not None:
-            lg.state = lg.state._replace(rng=jax.device_put(checkpoint["rng"]))
-        if checkpoint.get("np_rng") is not None:
-            self._rng.bit_generator.state = checkpoint["np_rng"]
         lg._updates = checkpoint.get("updates", 0)
-        self._timesteps_total = checkpoint.get("timesteps_total", 0)

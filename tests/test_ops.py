@@ -91,6 +91,69 @@ def test_cross_entropy_masked():
     np.testing.assert_allclose(loss, np.log(8), atol=1e-5)
 
 
+def test_cross_entropy_gradient_is_softmax_minus_onehot():
+    """The lse max-shift must be fully stop-gradded: the gradient is exactly
+    (softmax - onehot(label)) / n — a half-stop-gradded shift leaks a
+    spurious +onehot(argmax) term (caught live: 0.25 max grad error)."""
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 7)) * 3
+    labels = jnp.array([1, 2, 3, 0])
+    g = jax.grad(
+        lambda l: softmax_cross_entropy_with_int_labels(l, labels)[0]
+    )(logits)
+    ref = (jax.nn.softmax(logits) - jax.nn.one_hot(labels, 7)) / 4
+    np.testing.assert_allclose(g, ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk,seq", [(4, 16), (5, 16), (16, 16), (32, 16)])
+def test_blockwise_cross_entropy_matches_dense(chunk, seq):
+    from ray_tpu.ops.losses import blockwise_softmax_cross_entropy
+
+    key = jax.random.PRNGKey(0)
+    b, d, v = 3, 8, 32
+    x = jax.random.normal(key, (b, seq, d))
+    unembed = jax.random.normal(jax.random.PRNGKey(1), (d, v))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (b, seq), 0, v)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(3), 0.8, (b, seq))
+
+    def dense(x, u):
+        return softmax_cross_entropy_with_int_labels(
+            jnp.einsum("bsd,dv->bsv", x, u), labels, where=mask
+        )[0]
+
+    def blockwise(x, u):
+        return blockwise_softmax_cross_entropy(
+            x, u, labels, where=mask, chunk=chunk
+        )[0]
+
+    ld, (gxd, gud) = jax.value_and_grad(dense, argnums=(0, 1))(x, unembed)
+    lb, (gxb, gub) = jax.value_and_grad(blockwise, argnums=(0, 1))(x, unembed)
+    np.testing.assert_allclose(lb, ld, rtol=1e-5)
+    np.testing.assert_allclose(gxb, gxd, atol=1e-5)
+    np.testing.assert_allclose(gub, gud, atol=1e-5)
+
+
+def test_loss_chunk_config_end_to_end():
+    """A loss_chunk model trains to the same loss as the dense-loss model."""
+    import dataclasses
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.transformer import init_params, make_loss_fn
+
+    # f32: the chunked scan accumulates the unembed cotangent in a different
+    # order than the one-shot matmul; in bf16 that is ~5e-4 noise
+    cfg = dataclasses.replace(CONFIGS["tiny"], dtype=jnp.float32)
+    cfg_c = dataclasses.replace(cfg, loss_chunk=7)  # non-dividing chunk
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size)
+    batch = {"tokens": tokens, "mask": jnp.ones_like(tokens)}
+    l_dense, g_dense = jax.value_and_grad(make_loss_fn(cfg))(params, batch)
+    l_chunk, g_chunk = jax.value_and_grad(make_loss_fn(cfg_c))(params, batch)
+    np.testing.assert_allclose(l_chunk, l_dense, rtol=2e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-4),
+        g_dense, g_chunk,
+    )
+
+
 def test_rms_norm_and_rope():
     from ray_tpu.ops import rms_norm, apply_rope, rope_frequencies
 
