@@ -1,0 +1,48 @@
+// Demo + test binary for C++ task execution (Executor in
+// ray_tpu_client.hpp). Registers arithmetic/string functions under the
+// executor name "calc" and serves calls pushed by the head until the
+// connection closes. Exercised by tests/test_cpp_executor.py.
+// Usage: demo_executor <head_host:port>
+
+#include <cstdio>
+#include <numeric>
+
+#include "ray_tpu_client.hpp"
+
+using ray_tpu::Json;
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <host:port>\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray_tpu::Executor ex(argv[1], "calc");
+    ex.Register("Add", [](const std::vector<Json> &a) {
+      return Json::of(a.at(0).as_int() + a.at(1).as_int());
+    });
+    ex.Register("Sum", [](const std::vector<Json> &a) {
+      int64_t total = 0;
+      for (const Json &v : a.at(0).arr) total += v.as_int();
+      return Json::of(total);
+    });
+    ex.Register("Greet", [](const std::vector<Json> &a) {
+      return Json::of("hello " + a.at(0).as_str() + " from c++");
+    });
+    ex.Register("Fail", [](const std::vector<Json> &) -> Json {
+      throw std::runtime_error("intentional failure");
+    });
+    ex.Register("Sleep", [](const std::vector<Json> &a) {
+      usleep(static_cast<useconds_t>(a.at(0).as_int()) * 1000);
+      return Json::of(true);
+    });
+    std::printf("SERVING\n");
+    std::fflush(stdout);
+    ex.Serve();
+    return 0;
+  } catch (const std::exception &e) {
+    // head shutdown closes the connection: a clean end of service
+    std::fprintf(stderr, "executor exit: %s\n", e.what());
+    return 0;
+  }
+}

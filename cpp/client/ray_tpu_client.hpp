@@ -27,6 +27,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <limits>
 #include <map>
 #include <memory>
@@ -339,14 +340,14 @@ inline std::string B64Decode(const std::string &in) {
 }
 
 // ---------------------------------------------------------------------------
-// Client
+// Framed socket (shared by Client and Executor)
 // ---------------------------------------------------------------------------
 
-class Client {
+namespace detail {
+
+class FrameSocket {
  public:
-  // address: "host:port" of the head's TCP control plane
-  // (<session_dir>/head_addr on the head machine).
-  explicit Client(const std::string &address) {
+  explicit FrameSocket(const std::string &address) {
     auto colon = address.rfind(':');
     if (colon == std::string::npos)
       throw std::runtime_error("address must be host:port");
@@ -365,17 +366,81 @@ class Client {
       throw std::runtime_error("failed to connect to " + address);
     }
     freeaddrinfo(res);
+  }
 
+  ~FrameSocket() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  FrameSocket(const FrameSocket &) = delete;
+  FrameSocket &operator=(const FrameSocket &) = delete;
+
+  void SendFrame(const std::string &body) {
+    uint64_t n = body.size();
+    char hdr[8];
+    for (int k = 0; k < 8; ++k) hdr[k] = static_cast<char>((n >> (8 * k)) & 0xFF);
+    WriteAll(hdr, 8);
+    WriteAll(body.data(), body.size());
+  }
+
+  void SendJson(const Json &msg) {
+    std::string body;
+    msg.dump(body);
+    SendFrame(body);
+  }
+
+  std::string RecvFrame() {
+    char hdr[8];
+    ReadAll(hdr, 8);
+    uint64_t n = 0;
+    for (int k = 0; k < 8; ++k)
+      n |= static_cast<uint64_t>(static_cast<unsigned char>(hdr[k])) << (8 * k);
+    std::string body(n, '\0');
+    ReadAll(body.data(), n);
+    return body;
+  }
+
+ private:
+  int fd_ = -1;
+
+  void WriteAll(const char *p, size_t n) {
+    while (n) {
+      // MSG_NOSIGNAL: a half-closed socket (head restart) must surface as
+      // the documented exception, not kill the process with SIGPIPE
+      ssize_t w = ::send(fd_, p, n, MSG_NOSIGNAL);
+      if (w <= 0) throw std::runtime_error("connection write failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void ReadAll(char *p, size_t n) {
+    while (n) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+};
+
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  // address: "host:port" of the head's TCP control plane
+  // (<session_dir>/head_addr on the head machine).
+  explicit Client(const std::string &address) : sock_(address) {
     Json reg = Json::object();
     reg.obj["t"] = Json::of("register_driver");
     reg.obj["proto"] = Json::of(kProtocolVersion);
     Json info = Request(reg);
     const Json *nid = info.get("node_id");
     node_id_ = nid ? nid->as_str() : "";
-  }
-
-  ~Client() {
-    if (fd_ >= 0) close(fd_);
   }
 
   const std::string &node_id() const { return node_id_; }
@@ -508,7 +573,7 @@ class Client {
   }
 
  private:
-  int fd_ = -1;
+  detail::FrameSocket sock_;
   int64_t rid_ = 0;
   int64_t oid_counter_ = 0;
   std::string node_id_;
@@ -521,44 +586,90 @@ class Client {
     return buf;
   }
 
-  void SendFrame(const std::string &body) {
-    uint64_t n = body.size();
-    char hdr[8];
-    for (int k = 0; k < 8; ++k) hdr[k] = static_cast<char>((n >> (8 * k)) & 0xFF);
-    WriteAll(hdr, 8);
-    WriteAll(body.data(), body.size());
+  void SendFrame(const std::string &body) { sock_.SendFrame(body); }
+  std::string RecvFrame() { return sock_.RecvFrame(); }
+};
+
+// ---------------------------------------------------------------------------
+// Executor: C++ task execution (reference parity: the C++ worker API's
+// task execution side, cpp/src/ray/runtime/task/task_executor.h — functions
+// registered by name, invoked by the runtime; here calls arrive as
+// cpp_exec pushes from the head and results return as cpp_result frames
+// that the head stores into the object directory).
+//
+//   ray_tpu::Executor ex(head_addr, "calc");
+//   ex.Register("Add", [](const std::vector<Json> &a) {
+//     return Json::of(a.at(0).as_int() + a.at(1).as_int());
+//   });
+//   ex.Serve();  // blocks; Python: cross_language.cpp_function("calc","Add")
+// ---------------------------------------------------------------------------
+
+class Executor {
+ public:
+  using Fn = std::function<Json(const std::vector<Json> &)>;
+
+  Executor(const std::string &address, const std::string &name)
+      : sock_(address), name_(name) {}
+
+  void Register(const std::string &fn_name, Fn fn) {
+    fns_[fn_name] = std::move(fn);
   }
 
-  std::string RecvFrame() {
-    char hdr[8];
-    ReadAll(hdr, 8);
-    uint64_t n = 0;
-    for (int k = 0; k < 8; ++k)
-      n |= static_cast<uint64_t>(static_cast<unsigned char>(hdr[k])) << (8 * k);
-    std::string body(n, '\0');
-    ReadAll(body.data(), n);
-    return body;
-  }
+  // Registers with the head and serves calls until the connection closes
+  // (throws "connection closed" on head shutdown) or a served function
+  // calls Stop().
+  void Serve() {
+    Json reg = Json::object();
+    reg.obj["t"] = Json::of("register_cpp_executor");
+    reg.obj["proto"] = Json::of(kProtocolVersion);
+    reg.obj["name"] = Json::of(name_);
+    reg.obj["rid"] = Json::of(static_cast<int64_t>(1));
+    Json fl = Json::array();
+    for (const auto &kv : fns_) fl.arr.push_back(Json::of(kv.first));
+    reg.obj["functions"] = fl;
+    sock_.SendJson(reg);
 
-  void WriteAll(const char *p, size_t n) {
-    while (n) {
-      // MSG_NOSIGNAL: a half-closed socket (head restart) must surface as
-      // the documented exception, not kill the process with SIGPIPE
-      ssize_t w = ::send(fd_, p, n, MSG_NOSIGNAL);
-      if (w <= 0) throw std::runtime_error("connection write failed");
-      p += w;
-      n -= static_cast<size_t>(w);
+    running_ = true;
+    while (running_) {
+      Json msg = JsonParser(sock_.RecvFrame()).parse();
+      const Json *t = msg.get("t");
+      if (!t) continue;
+      if (t->as_str() == "reply") {
+        // the registration ack; a name collision surfaces here
+        const Json *ok = msg.get("ok");
+        if (ok && !ok->as_bool()) {
+          const Json *err = msg.get("error");
+          throw std::runtime_error("register failed: " +
+                                   (err ? err->as_str() : "unknown"));
+        }
+        continue;
+      }
+      if (t->as_str() != "cpp_exec") continue;
+      Json res = Json::object();
+      res.obj["t"] = Json::of("cpp_result");
+      res.obj["call_id"] = *msg.get("call_id");
+      try {
+        auto it = fns_.find(msg.get("fn")->as_str());
+        if (it == fns_.end())
+          throw std::runtime_error("unknown function " + msg.get("fn")->as_str());
+        const Json *a = msg.get("args");
+        res.obj["value"] = it->second(a ? a->arr : std::vector<Json>{});
+        res.obj["ok"] = Json::of(true);
+      } catch (const std::exception &e) {
+        res.obj["ok"] = Json::of(false);
+        res.obj["error"] = Json::of(std::string(e.what()));
+      }
+      sock_.SendJson(res);
     }
   }
 
-  void ReadAll(char *p, size_t n) {
-    while (n) {
-      ssize_t r = ::read(fd_, p, n);
-      if (r <= 0) throw std::runtime_error("connection closed");
-      p += r;
-      n -= static_cast<size_t>(r);
-    }
-  }
+  void Stop() { running_ = false; }
+
+ private:
+  detail::FrameSocket sock_;
+  std::string name_;
+  std::map<std::string, Fn> fns_;
+  bool running_ = false;
 };
 
 }  // namespace ray_tpu

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 __version__ = "0.1.0"
 
 from . import exceptions  # noqa: F401
+from . import cross_language  # noqa: F401
 from .actor import ActorClass, ActorHandle
 from .object_ref import ObjectRef
 from .remote_function import RemoteFunction

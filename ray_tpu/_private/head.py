@@ -290,6 +290,10 @@ class Head:
         self.workers: Dict[str, WorkerRecord] = {}
         self.actors: Dict[str, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (namespace, name) -> actor_id
+        # name -> {conn, functions, inflight: call_id->return_id, next_call}
+        # (cross-language task execution; reference: cpp/src/ray/runtime
+        # task_executor — C++ processes registering callables by name)
+        self.cpp_executors: Dict[str, dict] = {}
         self.placement_groups: Dict[str, PlacementGroupRecord] = {}
         self.tasks: Dict[str, TaskRecord] = {}
         self.pending_queue: collections.deque = collections.deque()
@@ -919,6 +923,7 @@ class Head:
             w = self.workers.get(wid)
             if w is not None and w.state != "dead":
                 await self._return_leased_worker(w)
+        self._drop_cpp_executor(conn)
         for n in list(self.nodes.values()):
             if n.conn is conn and n.alive:
                 await self._on_node_death(n, reason="agent connection closed")
@@ -1346,6 +1351,104 @@ class Head:
             else:
                 out.append({"format": "json", "value": value})
         return out
+
+    # --- cross-language task execution (cpp/client Executor; reference:
+    # cpp/src/ray/runtime task execution — the C++ worker registers named
+    # functions and the runtime pushes calls to it) ---
+
+    async def _h_register_cpp_executor(self, conn, msg):
+        protocol.check_protocol_version(msg, f"cpp executor {msg.get('name')}")
+        name = msg["name"]
+        prev = self.cpp_executors.get(name)
+        if prev is not None and not prev["conn"].closed:
+            raise ValueError(f"cpp executor {name!r} already registered")
+        conn._cpp_executor_name = name
+        self.cpp_executors[name] = {
+            "conn": conn,
+            "functions": list(msg.get("functions") or []),
+            "inflight": {},
+            "next_call": 0,
+        }
+        return {"name": name}
+
+    async def _h_list_cpp_executors(self, conn, msg):
+        return {
+            name: rec["functions"]
+            for name, rec in self.cpp_executors.items()
+            if not rec["conn"].closed
+        }
+
+    async def _h_cpp_call(self, conn, msg):
+        """Python -> C++ call: push {fn, args} to the named executor; its
+        cpp_result lands in the object directory under return_id, so the
+        caller's ordinary get() resolves it."""
+        rec = self.cpp_executors.get(msg["executor"])
+        if rec is None or rec["conn"].closed:
+            raise ValueError(f"no live cpp executor {msg['executor']!r}")
+        return_id = msg["return_id"]
+        rec["next_call"] += 1
+        call_id = rec["next_call"]
+        # register BEFORE the send: the await can yield to the read loop,
+        # and an instant cpp_result must find its inflight entry — but
+        # unwind on send failure (the closed flag lags the actual death),
+        # or the +1 and entry would leak an error object nobody holds
+        self.objects.add_ref(return_id, 1)
+        rec["inflight"][call_id] = return_id
+        try:
+            await rec["conn"].send(
+                {"t": "cpp_exec", "call_id": call_id, "fn": msg["fn"],
+                 "args": msg.get("args") or []}
+            )
+        except Exception:
+            rec["inflight"].pop(call_id, None)
+            self.objects.remove_ref(return_id, 1)
+            raise
+        return return_id
+
+    async def _h_cpp_result(self, conn, msg):
+        from .serialization import serialize
+
+        rec = self.cpp_executors.get(getattr(conn, "_cpp_executor_name", "") or "")
+        if rec is None or rec["conn"] is not conn:
+            return
+        return_id = rec["inflight"].pop(msg["call_id"], None)
+        if return_id is None:
+            return
+        # the caller may have dropped its ref while the call ran: the
+        # refcount entry is gone, and storing now would leak the envelope
+        # forever (no decrement will ever arrive)
+        if return_id not in self.objects.refcounts:
+            return
+        if msg.get("ok"):
+            env = serialize(msg.get("value"))
+        else:
+            from ..exceptions import CrossLanguageError
+
+            env = serialize(CrossLanguageError(str(msg.get("error"))))
+            env.is_error = True  # type: ignore[attr-defined]
+        self.objects.put(return_id, env)
+
+    def _drop_cpp_executor(self, conn) -> None:
+        """Executor connection died: surface every in-flight call as an
+        error object (callers are parked in get())."""
+        from .serialization import serialize
+
+        name = getattr(conn, "_cpp_executor_name", None)
+        rec = self.cpp_executors.get(name or "")
+        if rec is None or rec["conn"] is not conn:
+            return
+        del self.cpp_executors[name]
+        if rec["inflight"]:
+            from ..exceptions import CrossLanguageError
+
+            env = serialize(
+                CrossLanguageError(f"cpp executor {name!r} died mid-call")
+            )
+            env.is_error = True  # type: ignore[attr-defined]
+            for return_id in rec["inflight"].values():
+                if return_id in self.objects.refcounts:  # see _h_cpp_result
+                    self.objects.put(return_id, env)
+            rec["inflight"].clear()
 
     async def _h_add_refs(self, conn, msg):
         for oid, n in msg["counts"].items():

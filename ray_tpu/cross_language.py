@@ -1,0 +1,91 @@
+"""Cross-language calls: invoke functions registered by C++ executor
+processes (cpp/client/ray_tpu_client.hpp `Executor`).
+
+Reference parity: python/ray/cross_language.py (`cpp_function` — the
+Python-side handle for calling into the C++ worker API by name). Arguments
+and results cross the wire as JSON values; the result arrives as a normal
+object, so `ray_tpu.get()` on the returned ref behaves exactly like any
+task result (including raising CrossLanguageError on failure).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ._private.ids import ObjectID
+
+
+class CppFunction:
+    """Handle to one named function on one named C++ executor."""
+
+    def __init__(self, executor: str, fn: str):
+        self._executor = executor
+        self._fn = fn
+
+    def remote(self, *args: Any):
+        from ._private.worker import global_worker
+        from .object_ref import ObjectRef
+
+        _check_json_args(args)
+        oid = ObjectID.from_put(global_worker.job_id).hex()
+        global_worker.request(
+            {
+                "t": "cpp_call",
+                "executor": self._executor,
+                "fn": self._fn,
+                "args": list(args),
+                "return_id": oid,
+            }
+        )
+        # the head took the +1 for this ref inside cpp_call
+        return ObjectRef(oid, skip_adding_local_ref=True)
+
+    def __repr__(self):
+        return f"CppFunction({self._executor}.{self._fn})"
+
+
+def cpp_function(executor: str, fn: str) -> CppFunction:
+    """`cpp_function("calc", "Add").remote(1, 2)` -> ObjectRef."""
+    return CppFunction(executor, fn)
+
+
+def list_cpp_executors() -> Dict[str, List[str]]:
+    """Live executors -> the function names each registered."""
+    from ._private.worker import global_worker
+
+    return global_worker.request({"t": "list_cpp_executors"})
+
+
+_JSON_TYPES = (type(None), bool, int, float, str, list, tuple, dict)
+_INT64_MIN, _INT64_MAX = -(2**63), 2**63 - 1
+
+
+def _check_json_args(args) -> None:
+    """Reject anything the C++ JSON parser can't round-trip: non-finite
+    floats (json.dumps emits bare NaN/Infinity, which kills the executor's
+    parser), ints outside int64, and non-string dict keys (json.dumps would
+    silently stringify them — data corruption, not an error)."""
+    import math
+
+    for a in args:
+        if not isinstance(a, _JSON_TYPES):
+            raise TypeError(
+                f"cross-language args must be JSON-representable, got "
+                f"{type(a).__name__}"
+            )
+        if isinstance(a, bool):
+            continue
+        if isinstance(a, float) and not math.isfinite(a):
+            raise TypeError(f"cross-language float args must be finite, got {a!r}")
+        if isinstance(a, int) and not (_INT64_MIN <= a <= _INT64_MAX):
+            raise TypeError(f"cross-language int args must fit int64, got {a!r}")
+        if isinstance(a, (list, tuple)):
+            _check_json_args(a)
+        elif isinstance(a, dict):
+            for k in a:
+                if not isinstance(k, str):
+                    raise TypeError(
+                        f"cross-language dict keys must be str, got "
+                        f"{type(k).__name__}"
+                    )
+            _check_json_args(a.values())

@@ -129,3 +129,9 @@ class PlacementGroupError(RayTpuError):
 
 class OutOfMemoryError(RayTpuError):
     pass
+
+
+class CrossLanguageError(RayTpuError):
+    """A cross-language (C++ executor) call failed: the function raised,
+    was unknown, or its executor died mid-call (reference:
+    CrossLanguageError in python/ray/exceptions.py)."""
