@@ -18,7 +18,9 @@ sharded batches instead of the reference's NCCL allreduce between learner
 actors.
 """
 
+from .a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from .algorithm import Algorithm, WorkerSet  # noqa: F401
+from .appo import APPO, APPOConfig, APPOLearner  # noqa: F401
 from .config import AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
 from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace  # noqa: F401
@@ -37,6 +39,7 @@ from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay_buffer import ReplayBuffer  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner  # noqa: F401
+from .td3 import TD3, TD3Config, TD3Learner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401
 from . import offline  # noqa: F401,E402
 

@@ -1,0 +1,54 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference parity: rllib/algorithms/a2c/a2c.py (A2C = synchronous sampling +
+one plain policy-gradient pass per batch — PPO's pipeline minus the clipped
+surrogate and the epoch loop). Reuses PPO's sampling/GAE machinery; the
+learner runs exactly one epoch of unclipped pg updates per train batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .learner import PPOLearner
+from .models import ac_apply
+from .ppo import PPO, PPOConfig
+from .sample_batch import ACTIONS, ADVANTAGES, OBS, TARGETS
+
+
+class A2CConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = A2C
+        # A2C is strictly on-policy single-pass: more epochs would reuse
+        # the batch with stale advantages and no trust region to guard it
+        self.num_epochs = 1
+        self.lr = 7e-4
+        self.entropy_coeff = 0.01
+
+
+class A2CLearner(PPOLearner):
+    """PPO's compiled update skeleton with the vanilla pg loss."""
+
+    def loss(self, params, mb):
+        logits, value = ac_apply(params, mb[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, mb[ACTIONS][:, None], axis=-1)[:, 0]
+        adv = mb[ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg_loss = -jnp.mean(logp * adv)
+        vf_loss = 0.5 * jnp.mean((value - mb[TARGETS]) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pg_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+        return total, {
+            "total_loss": total,
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+
+class A2C(PPO):
+    _config_class = A2CConfig
+    _learner_cls = A2CLearner

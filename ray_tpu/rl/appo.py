@@ -1,0 +1,80 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline with PPO's clipped surrogate.
+
+Reference parity: rllib/algorithms/appo/appo.py (APPO = IMPALA-style async
+sampling + V-trace off-policy correction + the PPO clip on the importance
+ratio instead of IMPALA's bare rho-weighted pg loss). Reuses IMPALA's
+async training_step and vtrace; only the policy term of the loss changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace
+from .sample_batch import ACTIONS, DONES, LOGP, OBS, REWARDS
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_eps: float = 0.3  # reference appo.py clip_param default
+
+
+class APPOLearner(ImpalaLearner):
+    def __init__(self, *args, clip_eps: float = 0.3, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.clip_eps = clip_eps
+
+    def loss(self, params, batch):
+        from .models import ac_apply
+
+        T, E = batch[ACTIONS].shape
+        obs = batch[OBS].reshape(T * E, -1)
+        logits, values = ac_apply(params, obs)
+        logits = logits.reshape(T, E, -1)
+        values = values.reshape(T, E)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
+        log_rho = logp - batch[LOGP]
+        rho = jnp.minimum(self.rho_clip, jnp.exp(log_rho))
+        c = jnp.minimum(self.c_clip, jnp.exp(log_rho))
+        vs, pg_adv = vtrace(
+            jax.lax.stop_gradient(values),
+            batch[REWARDS],
+            batch[DONES],
+            batch["bootstrap_value"],
+            jax.lax.stop_gradient(rho),
+            jax.lax.stop_gradient(c),
+            self.gamma,
+        )
+        # the APPO difference: clipped-surrogate on the (unclipped)
+        # importance ratio, with v-trace advantages as the target
+        ratio = jnp.exp(log_rho)
+        pg_loss = -jnp.mean(
+            jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1.0 - self.clip_eps, 1.0 + self.clip_eps) * pg_adv,
+            )
+        )
+        vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pg_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+        return total, {
+            "total_loss": total,
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.mean(rho),
+        }
+
+
+class APPO(IMPALA):
+    _config_class = APPOConfig
+    _learner_cls = APPOLearner
+
+    def _extra_learner_kwargs(self) -> Dict[str, Any]:
+        return {"clip_eps": getattr(self.algo_config, "clip_eps", 0.3)}

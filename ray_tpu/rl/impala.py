@@ -152,6 +152,10 @@ class ImpalaLearner(Learner):
 
 class IMPALA(Algorithm):
     _config_class = ImpalaConfig
+    _learner_cls = ImpalaLearner  # APPO swaps in its clipped-surrogate learner
+
+    def _extra_learner_kwargs(self) -> Dict[str, Any]:
+        return {}
 
     def _build_learner(self) -> LearnerGroup:
         cfg = self.algo_config
@@ -160,8 +164,12 @@ class IMPALA(Algorithm):
         num_actions = int(env.action_space.n)
         env.close()
 
+        learner_cls = self._learner_cls
+        extra = self._extra_learner_kwargs()
+
         def factory():
-            return ImpalaLearner(
+            return learner_cls(
+                **extra,
                 obs_dim=obs_dim,
                 num_actions=num_actions,
                 hidden=tuple(cfg.model.get("hidden", (64, 64))),

@@ -28,6 +28,7 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     _config_class = PPOConfig
+    _learner_cls = PPOLearner  # A2C swaps in its unclipped learner
 
     def _build_learner(self) -> LearnerGroup:
         cfg = self.algo_config
@@ -42,8 +43,10 @@ class PPO(Algorithm):
         num_actions = int(env.action_space.n)
         env.close()
 
+        learner_cls = self._learner_cls
+
         def factory():
-            return PPOLearner(
+            return learner_cls(
                 obs_dim=obs_dim,
                 num_actions=num_actions,
                 hidden=tuple(cfg.model.get("hidden", (64, 64))),

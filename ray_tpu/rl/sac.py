@@ -72,6 +72,12 @@ class _ContinuousWorker(EnvLoopWorker):
     def _scale(self, a: np.ndarray) -> np.ndarray:
         return self.act_low + (a + 1.0) * 0.5 * (self.act_high - self.act_low)
 
+    def _action(self, mean: np.ndarray, log_std: np.ndarray) -> np.ndarray:
+        """Exploration policy in squashed [-1,1] space; TD3's worker swaps
+        the learned-std Gaussian for deterministic + fixed noise."""
+        noise = self._rng.standard_normal(mean.shape).astype(np.float32)
+        return np.tanh(mean + np.exp(log_std) * noise)
+
     def sample(self) -> SampleBatch:
         E = self.num_envs
         cols = {
@@ -83,8 +89,7 @@ class _ContinuousWorker(EnvLoopWorker):
         }
         for t in range(self.T):
             mean, log_std = jax.device_get(self._pi(self.params, self._obs))
-            noise = self._rng.standard_normal(mean.shape).astype(np.float32)
-            act = np.tanh(mean + np.exp(log_std) * noise)
+            act = self._action(mean, log_std)
             cols[OBS][t] = self._obs
             cols[ACTIONS][t] = act
             for e in range(E):

@@ -1,0 +1,131 @@
+"""A2C / APPO / TD3 (reference: rllib per-algorithm tests + learning tests
+asserting reward thresholds, SURVEY §4.1)."""
+
+import numpy as np
+
+from ray_tpu.rl import (
+    A2C,
+    A2CConfig,
+    APPO,
+    APPOConfig,
+    TD3,
+    TD3Config,
+)
+
+
+def _local(cfg):
+    cfg.num_rollout_workers = 0
+    return cfg
+
+
+def test_a2c_learns_cartpole():
+    config = _local(A2CConfig()).environment("CartPole-v1")
+    config.rollout_fragment_length = 64
+    config.num_envs_per_worker = 4
+    config.train_batch_size = 1024
+    config.minibatch_size = 256
+    algo = config.build()
+    assert algo.algo_config.num_epochs == 1
+    best = 0.0
+    for _ in range(40):
+        result = algo.train()
+        r = result.get("episode_reward_mean", float("nan"))
+        if not np.isnan(r):
+            best = max(best, r)
+        if best >= 100:
+            break
+    algo.stop()
+    assert best >= 100, f"A2C failed to learn CartPole (best={best})"
+
+
+def test_appo_learns_cartpole_local():
+    config = _local(APPOConfig()).environment("CartPole-v1")
+    config.rollout_fragment_length = 64
+    config.num_envs_per_worker = 4
+    config.train_batch_size = 1024
+    algo = config.build()
+    best = 0.0
+    for _ in range(30):
+        result = algo.train()
+        r = result.get("episode_reward_mean", float("nan"))
+        if not np.isnan(r):
+            best = max(best, r)
+        if best >= 120:
+            break
+    algo.stop()
+    assert best >= 120, f"APPO failed to learn CartPole (best={best})"
+    # clipped-surrogate metrics present
+    assert "mean_rho" in algo.train()
+
+
+def test_appo_async_pipeline(ray_start_regular):
+    config = APPOConfig().environment("CartPole-v1")
+    config.num_rollout_workers = 2
+    config.rollout_fragment_length = 32
+    config.num_envs_per_worker = 2
+    config.train_batch_size = 256
+    algo = config.build()
+    r1 = algo.train()
+    r2 = algo.train()
+    assert r1["num_env_steps_sampled_this_iter"] >= 256
+    assert r2["timesteps_total"] >= 512
+    algo.stop()
+
+
+def test_td3_improves_pendulum():
+    config = _local(TD3Config()).environment("Pendulum-v1")
+    config.rollout_fragment_length = 64
+    config.train_batch_size = 256
+    config.learning_starts = 512
+    config.num_sgd_iter = 64
+    config.model = {"hidden": (64, 64)}
+    algo = config.build()
+    first, last = None, None
+    for _ in range(100):
+        result = algo.train()
+        r = result.get("episode_reward_mean", float("nan"))
+        if not np.isnan(r):
+            if first is None:
+                first = r
+            last = r
+    algo.stop()
+    assert last is not None and first is not None
+    assert last > first + 150 or last > -600, f"TD3 did not improve ({first} -> {last})"
+
+
+def test_td3_delayed_actor_schedule():
+    """The actor/target update fires every policy_delay critic steps: with
+    delay == num_sgd_iter the target nets move once per update call."""
+    import jax
+
+    from ray_tpu.rl.td3 import TD3Learner
+    from ray_tpu.rl import ReplayBuffer, SampleBatch
+
+    rng = np.random.default_rng(0)
+    n = 512
+    buf = ReplayBuffer(capacity=n, seed=0)
+    buf.add(
+        SampleBatch(
+            {
+                "obs": rng.standard_normal((n, 3)).astype(np.float32),
+                "actions": rng.uniform(-1, 1, (n, 1)).astype(np.float32),
+                "rewards": rng.standard_normal(n).astype(np.float32),
+                "next_obs": rng.standard_normal((n, 3)).astype(np.float32),
+                "dones": np.zeros(n, np.float32),
+            }
+        )
+    )
+    learner = TD3Learner(
+        obs_dim=3, act_dim=1, hidden=(16,), num_sgd_iter=4, minibatch_size=32,
+        policy_delay=2, seed=0,
+    )
+    t0 = jax.device_get(learner.state.params["target"])
+    m = learner.update(buf)
+    assert np.isfinite(m["critic_loss"])
+    t1 = jax.device_get(learner.state.params["target"])
+    # targets moved (2 of the 4 steps were delayed-update steps)
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(a - b).max()), t0, t1
+    )
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+    assert int(learner.state.params["it"]) == 4
