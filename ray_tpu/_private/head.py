@@ -297,6 +297,12 @@ class Head:
         self.placement_groups: Dict[str, PlacementGroupRecord] = {}
         self.tasks: Dict[str, TaskRecord] = {}
         self.pending_queue: collections.deque = collections.deque()
+        # demand shapes with no current placement; persists across pumps
+        # (see _pump/_capacity_changed) so submit storms stay O(1) each.
+        # Their tasks wait in _parked, OUT of pending_queue, so pumps stay
+        # O(new work) even with a 100k-task unplaceable backlog
+        self._blocked_sigs: Set[Any] = set()
+        self._parked: Dict[Any, collections.deque] = {}
         self.idle_workers: Dict[str, List[str]] = collections.defaultdict(list)
         self.server: Optional[asyncio.base_events.Server] = None
         self.tcp_server: Optional[asyncio.base_events.Server] = None
@@ -633,6 +639,14 @@ class Head:
         loop = asyncio.get_running_loop()
         while not self._shutdown:
             await asyncio.sleep(period)
+            # safety valve for the persistent blocked-shape memo: any
+            # capacity transition that forgot to call _capacity_changed
+            # costs at most one health period of scheduling delay. The
+            # incremental probe (O(#shapes), promotes until the probe
+            # misses) is sufficient to make progress — a bulk requeue here
+            # would re-walk a 100k parked backlog every tick forever
+            if self._blocked_sigs or self._parked:
+                self._capacity_changed(bulk=False)
             for w in list(self.workers.values()):
                 if w.state in ("dead", "starting") or w.conn is None or w.probing:
                     continue
@@ -918,11 +932,14 @@ class Head:
                 if not subs:
                     del self.channel_subscribers[ch]
         # caller died holding direct task leases: reclaim the workers
+        had_leases = bool(getattr(conn, "_task_leases", None))
         for wid in list(getattr(conn, "_task_leases", ())):
             self._drop_task_lease(wid)
             w = self.workers.get(wid)
             if w is not None and w.state != "dead":
                 await self._return_leased_worker(w)
+        if had_leases:
+            self._capacity_changed(bulk=False)
         self._drop_cpp_executor(conn)
         for n in list(self.nodes.values()):
             if n.conn is conn and n.alive:
@@ -1036,7 +1053,7 @@ class Head:
                 if w is not None and w.node_id == node_id and w.state != "dead":
                     self._adopt_actor_resources(rec, node_id)
         self._prestart_workers(node_id)
-        self._pump()
+        self._capacity_changed()
         return {"session": os.path.basename(self.session_dir),
                 "session_dir": self.session_dir}
 
@@ -1057,7 +1074,11 @@ class Head:
                 return
             if w.state == "idle" and not self._shutdown:
                 self.idle_workers[node_id].append(w.worker_id)
-                self._pump()
+                # a worker joining adds EXECUTION slots, not node resource
+                # capacity — the incremental probe suffices, and a bulk
+                # requeue here would re-walk the whole parked backlog per
+                # spawn (quadratic under worker churn)
+                self._capacity_changed(bulk=False)
 
         async def _spawn_idle():
             # concurrent spawns: the pool warms in ONE cold-start interval,
@@ -1107,7 +1128,9 @@ class Head:
                 self.idle_workers[w.node_id].append(w.worker_id)
         if w.registered is not None and not w.registered.done():
             w.registered.set_result(None)
-        self._pump()
+        # worker registration adds execution slots only (see prestart note):
+        # incremental probe, not a bulk parked-backlog requeue
+        self._capacity_changed(bulk=False)
         return {"node_id": w.node_id, "session_dir": self.session_dir}
 
     async def _h_get_actor_route(self, conn, msg):
@@ -1190,6 +1213,7 @@ class Head:
             self._release_node(nid, res, None)
             if w is not None:  # un-dialable worker: back to the pool
                 await self._return_leased_worker(w)
+                self._capacity_changed(bulk=False)
             return None
         self._task_leases[w.worker_id] = {
             "conn": conn, "node_id": nid, "resources": res,
@@ -1221,7 +1245,6 @@ class Head:
             self.idle_workers[w.node_id].append(w.worker_id)
         else:
             await self._kill_worker(w, reason="direct lease done")
-        self._pump()
 
     async def _h_release_task_lease(self, conn, msg):
         wid = msg["worker_id"]
@@ -1229,6 +1252,11 @@ class Head:
         w = self.workers.get(wid)
         if w is not None:
             await self._return_leased_worker(w)
+        # AFTER the lease drop, regardless of worker state: the node
+        # capacity was freed by _drop_task_lease even when the worker died
+        # mid-lease, and parked tasks that now fit must not wait for the
+        # health valve
+        self._capacity_changed(bulk=False)
         return True
 
     async def _h_record_tasks(self, conn, msg):
@@ -1486,6 +1514,12 @@ class Head:
         for oid in rec.spec.get("deps", []):
             await self.objects.wait_available(oid)
         rec.mark("pending")
+        # known-blocked shape: park silently; the next capacity change
+        # requeues everything (keeps a same-shape submit storm O(1) each)
+        sig = rec._sig = self._demand_sig(rec)
+        if sig in self._blocked_sigs:
+            self._parked.setdefault(sig, collections.deque()).append(rec)
+            return
         self.pending_queue.append(rec)
         self._pump()
 
@@ -1833,6 +1867,9 @@ class Head:
             if self._try_place_pg(rec):
                 rec.state = "created"
                 rec.ready_event.set()
+                # tasks targeting this PG may have parked while it was
+                # pending — their sigs become placeable exactly now
+                self._capacity_changed(bulk=False)
                 return
             await asyncio.sleep(0.05)
 
@@ -1903,6 +1940,8 @@ class Head:
                     # return only what the PG still holds
                     held = {k: v - (b.resources[k] - b.available.get(k, 0.0)) for k, v in b.resources.items()}
                     _release(self.nodes[b.node_id].available, held)
+            # bundle resources returned to their nodes: parked tasks may fit
+            self._capacity_changed(bulk=False)
         rec.state = "removed"
         return True
 
@@ -1924,7 +1963,7 @@ class Head:
     async def _h_add_node(self, conn, msg):
         node_id = msg["node_id"]
         self.nodes[node_id] = NodeRecord(node_id, dict(msg["resources"]), labels=msg.get("labels", {}))
-        self._pump()
+        self._capacity_changed()
         return node_id
 
     async def _h_remove_node(self, conn, msg):
@@ -1950,6 +1989,9 @@ class Head:
         demands: List[Dict[str, float]] = []
         for rec in self.pending_queue:
             demands.append(dict(rec.resources))
+        for dq in self._parked.values():
+            for rec in dq:
+                demands.append(dict(rec.resources))
         for a in self.actors.values():
             if a.state in ("pending", "starting") and not a.node_acquired:
                 res = dict(a.spec.get("resources") or {})
@@ -2169,6 +2211,11 @@ class Head:
     # state API + observability (reference: dashboard/state_aggregator.py,
     # experimental/state/api.py; task events: gcs_task_manager.h:61)
     # ------------------------------------------------------------------
+
+    async def _h_task_count(self, conn, msg):
+        # O(1) backlog probe: stress monitors must not pay the O(n) pickle
+        # of list_tasks just to watch a 100k-task queue fill
+        return len(self.tasks)
 
     async def _h_list_tasks(self, conn, msg):
         # limit=0 means "all" (client-side filters need the full set)
@@ -2491,30 +2538,94 @@ class Head:
             strategy if isinstance(strategy, str) else repr(strategy),
         )
 
+    def _capacity_changed(self, bulk: bool = True):
+        """Cluster capacity moved: previously-unplaceable demand shapes may
+        fit now. Two regimes, because requeue cost must match the size of
+        the capacity event, or a 100k-task parked backlog melts the head:
+
+        - bulk=True (node joined/registered ONLY — the sites that add node
+          resource capacity): rare, arbitrarily large capacity — requeue
+          EVERYTHING and re-pump.
+        - bulk=False (everything else: lease released, worker registered/
+          died, PG created/removed, safety valve): probe each parked
+          shape's HEAD and keep promoting until the probe misses —
+          O(#shapes + #promoted) per event, never O(parked tasks).
+
+        Submit paths must NOT call this; they call _pump() (or park
+        directly when their shape is known-blocked)."""
+        if bulk:
+            if self._parked:
+                for dq in self._parked.values():
+                    self.pending_queue.extend(dq)
+                self._parked.clear()
+            self._blocked_sigs.clear()
+            self._pump()
+            return
+        for sig in list(self._parked):
+            dq = self._parked[sig]
+            promoted_any = False
+            # keep promoting this shape until the probe misses: a freed
+            # lease can be bigger than one task (e.g. {CPU: 4} released
+            # over 1-CPU parked tasks) and under-promoting serializes the
+            # node until the next capacity event
+            while dq:
+                head = dq[0]
+                # _select_node ACQUIRES capacity on success — dispatch the
+                # head directly on the returned node rather than requeueing
+                # it for _pump (which would acquire a second time and leak
+                # the probe's acquisition, wedging the node as full)
+                nid = self._select_node(head.resources, head.spec.get("scheduling_strategy"))
+                if nid is None:
+                    break
+                dq.popleft()
+                promoted_any = True
+                self._dispatch_on(head, nid)
+            if not dq:
+                del self._parked[sig]
+            if promoted_any:
+                # unblock so new same-shape submits pump normally; a
+                # placement miss simply re-blocks. Whatever stays parked
+                # does so because the probe just missed — only as much
+                # work unparks as capacity arrived
+                self._blocked_sigs.discard(sig)
+        if self.pending_queue:
+            self._pump()
+
     def _pump(self):
         if self._shutdown:
             return
-        still_pending = collections.deque()
-        # demand signatures that already failed THIS pass: with thousands
-        # of queued same-shape tasks, one placement miss proves the rest
-        # can't place either — without this the pump is O(pending x nodes)
-        # per call and the head melts at 10k+ queued tasks
-        blocked: Set[Any] = set()
+        # demand signatures that already failed: with thousands of queued
+        # same-shape tasks, one placement miss proves the rest can't place
+        # either. Blocked shapes PARK out of the queue until
+        # _capacity_changed requeues them, so both a same-shape submit
+        # storm AND later unrelated submits cost O(1) each — the per-pass
+        # memo alone still melted the head quadratically at many_tasks
+        # scale (each new submit re-walked the whole backlog)
+        blocked: Set[Any] = self._blocked_sigs
         while self.pending_queue:
             rec = self.pending_queue.popleft()
-            sig = self._demand_sig(rec)
+            # sig cached on the record: a parked backlog is rescanned many
+            # times and the tuple/sort/repr per record dominates the scan
+            sig = getattr(rec, "_sig", None)
+            if sig is None:
+                sig = rec._sig = self._demand_sig(rec)
             if sig in blocked:
-                still_pending.append(rec)
+                self._parked.setdefault(sig, collections.deque()).append(rec)
                 continue
             nid = self._select_node(rec.resources, rec.spec.get("scheduling_strategy"))
             if nid is None:
                 blocked.add(sig)
-                still_pending.append(rec)
+                self._parked.setdefault(sig, collections.deque()).append(rec)
                 continue
-            rec.node_id = nid
-            rec.mark("scheduled")
-            asyncio.get_running_loop().create_task(self._dispatch_task(rec))
-        self.pending_queue = still_pending
+            self._dispatch_on(rec, nid)
+
+    def _dispatch_on(self, rec: TaskRecord, nid: str):
+        """Hand a task whose node capacity is ALREADY acquired (by
+        _select_node) to the dispatch coroutine — the single handshake for
+        both the pump and the parked-promotion path."""
+        rec.node_id = nid
+        rec.mark("scheduled")
+        asyncio.get_running_loop().create_task(self._dispatch_task(rec))
 
     async def _dispatch_task(self, rec: TaskRecord):
         w = await self._lease_worker(
@@ -2551,7 +2662,7 @@ class Head:
                     self.idle_workers[w.node_id].append(w.worker_id)
                 else:
                     await self._kill_worker(w, reason="non-poolable lease done")
-                self._pump()
+                self._capacity_changed(bulk=False)
         if reply.get("lost_deps"):
             # dep buffers were evicted under the worker: rebuild them from
             # lineage and re-dispatch this task (pins stay held; not a retry)
@@ -2811,3 +2922,7 @@ class Head:
                         self._unregister_name(rec)
                     await self._fail_backlog(rec)
         _ = was_actor
+        if not self._shutdown:
+            # the dropped lease / released actor node share may unblock
+            # parked tasks
+            self._capacity_changed(bulk=False)

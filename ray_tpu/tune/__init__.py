@@ -20,6 +20,7 @@ from .schedulers import (  # noqa: F401
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )

@@ -229,3 +229,114 @@ class PopulationBasedTraining(TrialScheduler):
             }
             return PAUSE
         return CONTINUE
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with GP-bandit exploration (reference: tune/schedulers/pb2.py;
+    Parker-Holder et al., "Provably Efficient Online Hyperparameter
+    Optimization with Population-Based Bandits", 2020).
+
+    Where PBT perturbs an exploited config by random factors, PB2 fits a GP
+    to (time, hyperparams) -> per-interval reward improvement across the
+    whole population and picks the next hyperparams by maximizing a UCB
+    acquisition — data-efficient for small populations. Only continuous
+    hyperparams participate; declare them in `hyperparam_bounds`.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: float = 5,
+        hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+        quantile_fraction: float = 0.25,
+        ucb_kappa: float = 1.5,
+        max_observations: int = 512,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds={key: (lo, hi)}")
+        self.bounds = {k: (float(lo), float(hi)) for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.max_obs = max_observations
+        self._keys = sorted(self.bounds)
+        # rows [t, x1..xd] -> reward delta over the last interval
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._last_score: Dict[str, float] = {}
+        self._np_rng = __import__("numpy").random.default_rng(seed)
+
+    # -- observation collection: every result contributes a delta point --
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is not None:
+            prev = self._last_score.get(trial.trial_id)
+            if prev is not None:
+                t = float(result.get(self.time_attr, 0))
+                row = [t] + [float(trial.config.get(k, 0.0)) for k in self._keys]
+                self._X.append(row)
+                self._y.append(score - prev)
+                if len(self._y) > self.max_obs:  # bound GP cost
+                    self._X = self._X[-self.max_obs:]
+                    self._y = self._y[-self.max_obs:]
+            self._last_score[trial.trial_id] = score
+        decision = super().on_trial_result(trial, result)
+        if decision == PAUSE and getattr(trial, "_pbt_exploit", None):
+            # the trial restarts from the donor's checkpoint under a new
+            # config: its next score jump is restore, not reward — without
+            # this reset the jump enters the GP as a huge fake delta
+            # credited to the fresh config
+            self._last_score.pop(trial.trial_id, None)
+        return decision
+
+    # -- exploration: GP-UCB over the bounded box instead of perturbation --
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        n_cand = 256
+        cand = np.empty((n_cand, len(self._keys)), dtype=float)
+        for j, k in enumerate(self._keys):
+            lo, hi = self.bounds[k]
+            cand[:, j] = self._np_rng.uniform(lo, hi, n_cand)
+        if len(self._y) >= 4:
+            from .bayesopt import _GP
+
+            X = np.asarray(self._X, dtype=float)
+            y = np.asarray(self._y, dtype=float)
+            # normalize: time and each hyperparam to [0,1], y to zero-mean
+            t_max = max(X[:, 0].max(), 1.0)
+            Xn = X.copy()
+            Xn[:, 0] /= t_max
+            for j, k in enumerate(self._keys):
+                lo, hi = self.bounds[k]
+                Xn[:, j + 1] = (X[:, j + 1] - lo) / max(hi - lo, 1e-12)
+            gp = _GP()
+            gp.fit(Xn, y)  # _GP.fit standardizes y internally
+            t_now = X[:, 0].max() / t_max
+            Q = np.empty((n_cand, Xn.shape[1]), dtype=float)
+            Q[:, 0] = t_now
+            for j, k in enumerate(self._keys):
+                lo, hi = self.bounds[k]
+                Q[:, j + 1] = (cand[:, j] - lo) / max(hi - lo, 1e-12)
+            mu, std = gp.predict(Q)
+            best = int(np.argmax(mu + self.kappa * std))
+        else:  # cold start: uniform sample (matches reference pb2 warmup)
+            best = 0
+        for j, k in enumerate(self._keys):
+            cur = config.get(k)
+            val = float(cand[best, j])
+            out[k] = type(cur)(val) if isinstance(cur, (int, float)) else val
+        return out
+
+    def on_trial_complete(self, trial: Trial) -> None:
+        self._last_score.pop(trial.trial_id, None)
+        super().on_trial_complete(trial)
