@@ -228,9 +228,15 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     )
 
 
-def cancel(object_ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    # Round-1: cancellation of queued (not yet running) tasks only.
-    global_worker.send({"t": "cancel_task", "task_id": object_ref.task_id()})
+def cancel(object_ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> bool:
+    """Cancel the task that produces `object_ref` (reference:
+    python/ray/_private/worker.py cancel). Queued tasks are dropped and
+    their refs resolve to TaskCancelledError; running tasks get the
+    cancellation raised in the executing thread; force=True kills the
+    worker process instead. `recursive` is accepted for API parity —
+    child-task trees are not tracked, so it has no effect. Returns True
+    when the cancel took effect."""
+    return global_worker.cancel_task(object_ref, force=force)
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

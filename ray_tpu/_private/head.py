@@ -102,9 +102,11 @@ class TaskRecord:
     retries_left: int = 0
     resources: Dict[str, float] = field(default_factory=dict)
     node_id: Optional[str] = None
-    state: str = "pending"  # pending|waiting_deps|scheduled|running|done|failed
+    state: str = "pending"  # pending|waiting_deps|scheduled|running|done|failed|cancelled
     deps_remaining: int = 0
     worker_id: Optional[str] = None
+    # set by _h_cancel_task; queued records are dropped lazily when popped
+    cancel_requested: bool = False
     # (state, wall-time) transitions — feeds the state API + `timeline()`
     # (reference: core_worker/task_event_buffer.h -> gcs_task_manager.h:61)
     events: List = field(default_factory=list)
@@ -303,6 +305,9 @@ class Head:
         # O(new work) even with a 100k-task unplaceable backlog
         self._blocked_sigs: Set[Any] = set()
         self._parked: Dict[Any, collections.deque] = {}
+        # head-routed actor calls in flight: task_id -> worker_id, so
+        # cancel_task can reach a call that has no TaskRecord
+        self._actor_inflight: Dict[str, str] = {}
         self.idle_workers: Dict[str, List[str]] = collections.defaultdict(list)
         self.server: Optional[asyncio.base_events.Server] = None
         self.tcp_server: Optional[asyncio.base_events.Server] = None
@@ -1507,12 +1512,26 @@ class Head:
         self.tasks[spec["task_id"]] = rec
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
-        asyncio.get_running_loop().create_task(self._resolve_and_enqueue(rec))
+        rec._resolve_task = asyncio.get_running_loop().create_task(
+            self._resolve_and_enqueue(rec)
+        )
 
     async def _resolve_and_enqueue(self, rec: TaskRecord):
+        if rec.cancel_requested:
+            # cancelled before this coroutine first ran, or re-entered via
+            # the lost_deps re-dispatch path after a cancel: settle (the
+            # _finish_cancel no-ops if the pending-branch already did)
+            self._finish_cancel(rec)
+            return
         rec.mark("waiting_deps")
-        for oid in rec.spec.get("deps", []):
-            await self.objects.wait_available(oid)
+        try:
+            for oid in rec.spec.get("deps", []):
+                await self.objects.wait_available(oid)
+        except asyncio.CancelledError:
+            return  # _finish_cancel cancelled us; returns already settled
+        if rec.cancel_requested:
+            self._finish_cancel(rec)
+            return
         rec.mark("pending")
         # known-blocked shape: park silently; the next capacity change
         # requeues everything (keeps a same-shape submit storm O(1) each)
@@ -1727,6 +1746,9 @@ class Head:
             if w is None or w.conn is None or w.conn.closed:
                 self._fail_task_returns(spec, ActorDiedError(rec.actor_id, "actor worker gone"))
                 return
+            # visible to cancel_task while the call is in flight (actor
+            # calls have no TaskRecord; see _cancel_actor_call)
+            self._actor_inflight[spec["task_id"]] = w.worker_id
             reply_fut = asyncio.ensure_future(
                 w.conn.request(
                     {
@@ -1773,6 +1795,7 @@ class Head:
             self._fail_task_returns(spec, ActorDiedError(rec.actor_id, repr(e)))
             return
         finally:
+            self._actor_inflight.pop(spec["task_id"], None)
             for oid in spec.get("deps", []):
                 self.objects.unpin(oid)
         self._store_task_results(spec, reply)
@@ -2212,6 +2235,101 @@ class Head:
     # experimental/state/api.py; task events: gcs_task_manager.h:61)
     # ------------------------------------------------------------------
 
+    async def _h_cancel_task(self, conn, msg):
+        """Cancel a task (reference: python/ray/_private/worker.py
+        ray.cancel -> CoreWorker::CancelTask). Queued tasks are dropped and
+        their returns resolve to TaskCancelledError; running tasks get the
+        cancellation raised asynchronously in the executing worker thread;
+        force=True kills the worker process instead. Returns True when the
+        cancel took effect (False: unknown/already finished)."""
+        tid = msg["task_id"]
+        rec = self.tasks.get(tid)
+        if rec is None:
+            return await self._cancel_actor_call(tid, msg.get("force", False))
+        if rec.state in ("done", "failed", "cancelled"):
+            return False
+        rec.cancel_requested = True
+        if rec.state in ("pending", "waiting_deps"):
+            # sits in pending_queue/_parked (or a dep/retry wait): finish
+            # now, the queues drop the record lazily when they pop it
+            self._finish_cancel(rec)
+            return True
+        if rec.state == "scheduled":
+            return True  # _dispatch_task checks the flag before pushing
+        # running
+        w = self.workers.get(rec.worker_id or "")
+        if w is not None and w.state != "dead":
+            if msg.get("force"):
+                # the 'running' state may be a LAGGED batched record for a
+                # direct-pushed task that already finished — ask the worker
+                # whether it is actually executing this task before killing
+                # it (the probe itself async-cancels when it is)
+                running = True
+                if w.conn is not None and not w.conn.closed:
+                    try:
+                        running = await w.conn.request(
+                            {"t": "cancel_task", "task_id": tid}, timeout=5
+                        )
+                    except Exception:
+                        running = True  # conn broken: the kill is moot/safe
+                if not running:
+                    return False
+                await self._kill_worker(w, reason=f"task {tid} force-cancelled")
+            elif w.conn is not None and not w.conn.closed:
+                try:
+                    await w.conn.send({"t": "cancel_task", "task_id": tid})
+                except Exception:
+                    pass
+        return True
+
+    async def _cancel_actor_call(self, tid: str, force: bool) -> bool:
+        """Cancel a head-routed actor method call — these have no
+        TaskRecord. Backlogged (actor still starting/restarting): drop the
+        spec and settle its returns. In flight on the actor's worker:
+        forward so the worker raises in the executing thread. force is
+        deliberately ignored for actor calls (killing the worker would
+        destroy actor state; reference rejects force on actor tasks)."""
+        from ..exceptions import TaskCancelledError
+
+        for a in self.actors.values():
+            for spec in a.backlog:
+                if spec["task_id"] == tid:
+                    a.backlog.remove(spec)
+                    for oid in spec.get("deps", []):
+                        self.objects.unpin(oid)
+                    self._fail_task_returns(
+                        spec, TaskCancelledError(f"task {tid} was cancelled")
+                    )
+                    return True
+        wid = self._actor_inflight.get(tid)
+        if wid:
+            w = self.workers.get(wid)
+            if w is not None and w.state != "dead" and w.conn is not None:
+                try:
+                    await w.conn.send({"t": "cancel_task", "task_id": tid})
+                except Exception:
+                    pass
+                return True
+        return False
+
+    def _finish_cancel(self, rec: TaskRecord):
+        from ..exceptions import TaskCancelledError
+
+        if rec.state == "cancelled":
+            return  # idempotent: racing paths must not double-unpin deps
+        rec.mark("cancelled")
+        for oid in rec.spec.get("deps", []):
+            self.objects.unpin(oid)
+        self._fail_task_returns(
+            rec.spec,
+            TaskCancelledError(f"task {rec.spec.get('task_id')} was cancelled"),
+        )
+        t = getattr(rec, "_resolve_task", None)
+        if t is not None and t is not asyncio.current_task():
+            # a dep-waiting coroutine would otherwise park on
+            # wait_available forever if the dep never materializes
+            t.cancel()
+
     async def _h_task_count(self, conn, msg):
         # O(1) backlog probe: stress monitors must not pay the O(n) pickle
         # of list_tasks just to watch a 100k-task queue fill
@@ -2570,6 +2688,9 @@ class Head:
             # node until the next capacity event
             while dq:
                 head = dq[0]
+                if head.state == "cancelled":
+                    dq.popleft()  # cancelled while parked: drop lazily
+                    continue
                 # _select_node ACQUIRES capacity on success — dispatch the
                 # head directly on the returned node rather than requeueing
                 # it for _pump (which would acquire a second time and leak
@@ -2604,6 +2725,8 @@ class Head:
         blocked: Set[Any] = self._blocked_sigs
         while self.pending_queue:
             rec = self.pending_queue.popleft()
+            if rec.state == "cancelled":
+                continue  # cancelled while queued: drop lazily
             # sig cached on the record: a parked backlog is rescanned many
             # times and the tuple/sort/repr per record dominates the scan
             sig = getattr(rec, "_sig", None)
@@ -2627,7 +2750,29 @@ class Head:
         rec.mark("scheduled")
         asyncio.get_running_loop().create_task(self._dispatch_task(rec))
 
+    async def _release_dispatch(self, rec: TaskRecord, w: Optional[WorkerRecord]):
+        """Give back everything _dispatch_task holds: the node capacity
+        acquired at scheduling and (if leased) the worker — then probe the
+        parked backlog. The single teardown for the normal finally, the
+        cancel short-circuits, and any future exit path."""
+        self._release_node(rec.node_id, rec.resources, rec.spec.get("scheduling_strategy"))
+        if w is not None and w.state == "busy":
+            if w.pooled:
+                w.state = "idle"
+                self.idle_workers[w.node_id].append(w.worker_id)
+            else:
+                await self._kill_worker(w, reason="lease done")
+        # probe even with no worker to return: the released NODE capacity
+        # alone can unblock parked tasks
+        self._capacity_changed(bulk=False)
+
     async def _dispatch_task(self, rec: TaskRecord):
+        if rec.cancel_requested:
+            # cancelled between scheduling and dispatch: give the acquired
+            # capacity back and settle the returns
+            await self._release_dispatch(rec, None)
+            self._finish_cancel(rec)
+            return
         w = await self._lease_worker(
             rec.node_id,
             needs_tpu=rec.resources.get("TPU", 0) > 0,
@@ -2636,6 +2781,12 @@ class Head:
         if w is None:
             self._release_node(rec.node_id, rec.resources, rec.spec.get("scheduling_strategy"))
             await self._retry_or_fail(rec, RuntimeError("failed to lease a worker"))
+            return
+        if rec.cancel_requested:
+            # cancelled during the lease await (state was still
+            # "scheduled", so _h_cancel_task relies on this check)
+            await self._release_dispatch(rec, w)
+            self._finish_cancel(rec)
             return
         rec.worker_id = w.worker_id
         rec.mark("running")
@@ -2655,14 +2806,7 @@ class Head:
             await self._retry_or_fail(rec, e)
             return
         finally:
-            self._release_node(rec.node_id, rec.resources, rec.spec.get("scheduling_strategy"))
-            if w.state == "busy":
-                if w.pooled:
-                    w.state = "idle"
-                    self.idle_workers[w.node_id].append(w.worker_id)
-                else:
-                    await self._kill_worker(w, reason="non-poolable lease done")
-                self._capacity_changed(bulk=False)
+            await self._release_dispatch(rec, w)
         if reply.get("lost_deps"):
             # dep buffers were evicted under the worker: rebuild them from
             # lineage and re-dispatch this task (pins stay held; not a retry)
@@ -2682,6 +2826,11 @@ class Head:
     async def _retry_or_fail(self, rec: TaskRecord, error: Exception):
         from ..exceptions import OutOfMemoryError, WorkerCrashedError
 
+        if rec.cancel_requested:
+            # a cancelled task never retries; a force-kill's broken conn
+            # lands here and must surface as cancellation, not a crash
+            self._finish_cancel(rec)
+            return
         w = self.workers.get(rec.worker_id or "")
         if w is not None and w.kill_reason:
             error = OutOfMemoryError(w.kill_reason)

@@ -94,6 +94,16 @@ def _recv_exact_into(sock, buf: bytearray) -> bytearray:
     return buf
 
 
+def _flag_bounded(od, key, cap: int = 1024) -> None:
+    """Record a best-effort flag in an OrderedDict with FIFO eviction —
+    misses (ids that never come back) must not pile up for the process
+    lifetime; dropping the oldest only downgrades a rare cancel to
+    best-effort."""
+    od[key] = None
+    while len(od) > cap:
+        od.popitem(last=False)
+
+
 async def _swallow_conn_errors(coro):
     """Fire-and-forget sends: a connection torn down mid-send (shutdown,
     worker death) must not leave an unretrieved-exception future."""
@@ -138,6 +148,7 @@ class _ActorChannel:
         self.direct_addr: Optional[str] = None  # for the sync bypass socket
         self.head_routed = False  # permanent fallback: order must not mix
         self.inflight = 0  # direct calls sent, reply not yet settled
+        self.inflight_tids: set = set()  # their task ids, for cancel()
         # sync-bypass stash: at most ONE deferred call (see Worker.get's
         # bypass path); guarded by worker._stash_lock
         self.stashed: Optional[dict] = None
@@ -245,10 +256,12 @@ class _ActorChannel:
             self._to_head(spec)
             return
         self.inflight += 1
+        self.inflight_tids.add(spec["task_id"])
         try:
             resolved = await self._resolve_deps(spec)
         except BaseException:
             self.inflight -= 1
+            self.inflight_tids.discard(spec["task_id"])
             raise
         msg = {
             "t": "run_task",
@@ -277,6 +290,7 @@ class _ActorChannel:
             if not settled[0]:
                 settled[0] = True
                 self.inflight -= 1
+                self.inflight_tids.discard(spec["task_id"])
 
         try:
             try:
@@ -334,10 +348,12 @@ class _ActorChannel:
             # deps stay pinned until the actor has consumed (or we failed)
             await self._release_deps(spec)
 
-    async def _fail_returns(self, spec: dict, reason: str):
+    async def _fail_returns(self, spec: dict, reason: str, error=None):
         from ..exceptions import ActorDiedError
 
-        err = serialization.serialize(ActorDiedError(self.actor_id, reason))
+        err = serialization.serialize(
+            error if error is not None else ActorDiedError(self.actor_id, reason)
+        )
         err.is_error = True
         for oid in spec["return_ids"]:
             self.worker._cache_local_object(oid, err)
@@ -372,6 +388,49 @@ class _ActorChannel:
         if self.conn is not None:
             await self.conn.close()
 
+    def cancel(self, tid: str) -> bool:
+        """Cancel an actor method call (io loop). Queued caller-side or
+        stashed (sync bypass): drop + settle returns. Sent to the actor:
+        forward so the worker raises in the executing thread (a call still
+        queued worker-side is remembered and dropped before it runs)."""
+        loop = asyncio.get_running_loop()
+        for spec in list(self.deque):
+            if spec is not None and spec.get("task_id") == tid:
+                try:
+                    self.deque.remove(spec)
+                except ValueError:
+                    continue
+                loop.create_task(self._cancel_spec(spec))
+                return True
+        with self.worker._stash_lock:
+            s = self.stashed if (
+                self.stashed is not None and self.stashed.get("task_id") == tid
+            ) else None
+        if s is not None and self.claim_stash(s) is not None:
+            loop.create_task(self._cancel_spec(s))
+            return True
+        # only claim tids this channel actually sent: reporting True for a
+        # foreign tid would stop Worker.cancel_task before the head sees it
+        if (
+            tid in self.inflight_tids
+            and self.conn is not None
+            and not self.conn.closed
+        ):
+            loop.create_task(_swallow_conn_errors(
+                self.conn.send({"t": "cancel_task", "task_id": tid})
+            ))
+            return True
+        return False
+
+    async def _cancel_spec(self, spec: dict):
+        from ..exceptions import TaskCancelledError
+
+        await self._fail_returns(
+            spec, "cancelled",
+            error=TaskCancelledError(f"task {spec['task_id']} was cancelled"),
+        )
+        await self._release_deps(spec)
+
     def flush_stale_stash(self, now: float) -> bool:
         """(io loop, via the sweeper) flush an unclaimed stash to the
         ordered queue — `remote()` without a matching get must still
@@ -391,13 +450,14 @@ class _TaskLease:
     """One granted worker lease (direct_task_transport.cc:191): a direct
     connection to a leased worker, reused across tasks until idle."""
 
-    __slots__ = ("worker_id", "node_id", "conn", "inflight", "last_used")
+    __slots__ = ("worker_id", "node_id", "conn", "inflight", "inflight_tids", "last_used")
 
     def __init__(self, worker_id: str, node_id: str, conn):
         self.worker_id = worker_id
         self.node_id = node_id
         self.conn = conn
         self.inflight = 0
+        self.inflight_tids: set = set()  # task ids pushed, reply pending
         self.last_used = 0.0
 
 
@@ -419,6 +479,14 @@ class _TaskChannel:
         self.resources = resources
         self.queue: asyncio.Queue = asyncio.Queue()
         self.leases: List[_TaskLease] = []
+        # ids cancelled while their spec was in dep-resolution limbo or the
+        # lease-wait loop (not in the queue, not on a lease); _dispatch
+        # drops them. Bounded: misses (task finished/not ours) would
+        # otherwise accumulate — a dropped entry only downgrades a rare
+        # cancel to best-effort. _resolving tracks specs parked in
+        # _resolve_then_requeue so cancel() can claim them as ours
+        self._cancelled_tids: "collections.OrderedDict" = collections.OrderedDict()
+        self._resolving: set = set()
         self._acquiring = 0  # in-flight lease requests
         self._no_lease_until = 0.0
         self.max_leases = max(1, cfg.direct_task_max_leases)
@@ -449,8 +517,10 @@ class _TaskChannel:
             spec["_resolved"] = await _resolve_spec_deps(self.worker, spec)
         except Exception:
             logger.exception("dep resolution failed; routing via head")
+            self._resolving.discard(spec["task_id"])
             self._to_head(spec)
             return
+        self._resolving.discard(spec["task_id"])
         self.queue.put_nowait(spec)
 
     async def _dispatch(self, spec: dict):
@@ -459,48 +529,66 @@ class _TaskChannel:
         launched in parallel for the visible backlog; when every lease is
         busy and growth is exhausted, wait for a completion — and after
         sustained saturation hand the spec to the head, which owns queuing."""
+        if spec["task_id"] in self._cancelled_tids:
+            self._cancelled_tids.pop(spec["task_id"], None)
+            await self._cancel_spec(spec)
+            return
         if spec.get("deps") and "_resolved" not in spec:
             # park dep waits concurrently; ready specs re-enter the queue
+            self._resolving.add(spec["task_id"])
             asyncio.get_running_loop().create_task(
                 self._resolve_then_requeue(spec)
             )
             return
         loop = asyncio.get_running_loop()
         saturated_since = None
-        while True:
-            # head connection down (crash + restart window): hold the spec —
-            # a _to_head fallback would silently drop it on the dead conn.
-            # The caller's next sync request() performs the reconnect.
-            while self.worker.conn is None or self.worker.conn.closed:
-                if not self.worker.connected:
-                    return  # disconnected for real; get() waiters released
-                if not await self.worker._reconnect_async():
-                    await asyncio.sleep(0.3)
-            lease = self._pick_lease()
-            if lease is not None and lease.inflight == 0:
-                await self._submit_one(lease, spec)
-                return
-            room = self.max_leases - len(self.leases) - self._acquiring
-            if room > 0 and loop.time() >= self._no_lease_until:
-                want = min(self.queue.qsize() + 1, room)
-                for _ in range(want):
-                    self._acquiring += 1
-                    loop.create_task(self._acquire())
-            if lease is None and self._acquiring == 0:
-                self._to_head(spec)  # no lease obtainable: head queues it
-                return
-            if saturated_since is None:
-                saturated_since = loop.time()
-            elif loop.time() - saturated_since > 1.0:
-                # long-running tasks hold every lease; the head may have
-                # capacity beyond our lease cap — let it schedule/queue
-                self._to_head(spec)
-                return
-            self._wake.clear()
-            try:
-                await asyncio.wait_for(self._wake.wait(), 0.1)
-            except asyncio.TimeoutError:
-                pass
+        tid = spec["task_id"]
+        # visible to cancel() while we wait for a lease below (same
+        # "owned but not queued" window as the dep-resolution park)
+        self._resolving.add(tid)
+        try:
+            while True:
+                if tid in self._cancelled_tids:
+                    # cancelled while this spec waited here for a free lease
+                    self._cancelled_tids.pop(tid, None)
+                    await self._cancel_spec(spec)
+                    return
+                # head connection down (crash + restart window): hold the
+                # spec — a _to_head fallback would silently drop it on the
+                # dead conn. The caller's next sync request() reconnects.
+                while self.worker.conn is None or self.worker.conn.closed:
+                    if not self.worker.connected:
+                        return  # disconnected for real; waiters released
+                    if not await self.worker._reconnect_async():
+                        await asyncio.sleep(0.3)
+                lease = self._pick_lease()
+                if lease is not None and lease.inflight == 0:
+                    self._resolving.discard(tid)
+                    await self._submit_one(lease, spec)
+                    return
+                room = self.max_leases - len(self.leases) - self._acquiring
+                if room > 0 and loop.time() >= self._no_lease_until:
+                    want = min(self.queue.qsize() + 1, room)
+                    for _ in range(want):
+                        self._acquiring += 1
+                        loop.create_task(self._acquire())
+                if lease is None and self._acquiring == 0:
+                    self._to_head(spec)  # no lease obtainable: head queues
+                    return
+                if saturated_since is None:
+                    saturated_since = loop.time()
+                elif loop.time() - saturated_since > 1.0:
+                    # long-running tasks hold every lease; the head may have
+                    # capacity beyond our lease cap — let it schedule/queue
+                    self._to_head(spec)
+                    return
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), 0.1)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._resolving.discard(tid)
 
     def _pick_lease(self) -> Optional[_TaskLease]:
         live = [l for l in self.leases if l.conn is not None and not l.conn.closed]
@@ -561,6 +649,7 @@ class _TaskChannel:
         # idle reaper must never see inflight==0 between pick and send —
         # it would close the conn under this task
         lease.inflight += 1
+        lease.inflight_tids.add(spec["task_id"])
         lease.last_used = loop.time()
         resolved = spec.pop("_resolved", None) or {}
         msg = {
@@ -592,6 +681,17 @@ class _TaskChannel:
                 # executed. Reference semantics: rerun only when the user
                 # opted into retries (max_retries), else WorkerCrashedError.
                 lease.conn = None
+                if spec["task_id"] in self._cancelled_tids:
+                    # the worker died around a cancel (force kill, or the
+                    # async raise landing as the process fell over): a
+                    # cancelled task never retries
+                    self._cancelled_tids.pop(spec["task_id"], None)
+                    from ..exceptions import TaskCancelledError
+
+                    await self._fail_returns(
+                        spec, "cancelled", error_cls=TaskCancelledError
+                    )
+                    return
                 used = spec.get("_retries_used", 0)
                 if used < spec.get("max_retries", 0):
                     spec["_retries_used"] = used + 1
@@ -658,6 +758,12 @@ class _TaskChannel:
                 self.worker._release_pending(spec["return_ids"])
         finally:
             lease.inflight -= 1
+            lease.inflight_tids.discard(spec["task_id"])
+            if not requeued:
+                # settled one way or another: drop any cancel flag so a
+                # too-late cancel doesn't linger (a requeued retry keeps
+                # it — the re-dispatch check consumes it)
+                self._cancelled_tids.pop(spec["task_id"], None)
             lease.last_used = asyncio.get_running_loop().time()
             self._wake.set()  # the dispatcher may be waiting for a free lease
             if not requeued:
@@ -678,6 +784,15 @@ class _TaskChannel:
         self.worker._enqueue_task_record(spec, "failed", None, None)
 
     def _to_head(self, spec: dict):
+        if spec["task_id"] in self._cancelled_tids:
+            # cancel() already claimed this spec (it was parked resolving /
+            # waiting): handing it to the head would run it anyway
+            self._cancelled_tids.pop(spec["task_id"], None)
+            try:
+                asyncio.get_running_loop().create_task(self._cancel_spec(spec))
+            except Exception:
+                pass
+            return
         # the head resolves deps itself: shipping pre-resolved envelopes
         # would bloat the socket + the head's stored TaskRecord
         spec.pop("_resolved", None)
@@ -692,6 +807,48 @@ class _TaskChannel:
             loop.create_task(_release_spec_deps(self.worker, spec))
         except Exception:
             pass
+
+    def cancel(self, tid: str) -> bool:
+        """Cancel a task owned by this channel (io loop). Queued caller-
+        side: drop it and settle its returns. Pushed to a leased worker:
+        forward the cancel so the worker raises it in the executing
+        thread. In dep-resolution limbo: flag for _dispatch to drop.
+        Reference: the direct-path half of CoreWorker::CancelTask."""
+        loop = asyncio.get_running_loop()
+        q = self.queue._queue  # type: ignore[attr-defined]
+        for spec in list(q):
+            if spec is not None and spec.get("task_id") == tid:
+                try:
+                    q.remove(spec)
+                except ValueError:
+                    continue  # consumer claimed it between list and remove
+                loop.create_task(self._cancel_spec(spec))
+                return True
+        for lease in self.leases:
+            if tid in lease.inflight_tids and lease.conn is not None:
+                # flag BEFORE forwarding: if the worker dies instead of
+                # replying (e.g. a force kill racing this send), _finish's
+                # retry path must fail the task as cancelled, not rerun it
+                # on a fresh lease. _finish pops the flag when the spec
+                # settles, whatever the outcome
+                _flag_bounded(self._cancelled_tids, tid)
+                loop.create_task(_swallow_conn_errors(
+                    lease.conn.send({"t": "cancel_task", "task_id": tid})
+                ))
+                return True
+        # flag for _dispatch's checks (dep-resolution limbo, lease-wait
+        # loop). When the spec is verifiably ours (parked resolving), the
+        # cancel WILL take effect -> report True; otherwise best-effort
+        _flag_bounded(self._cancelled_tids, tid)
+        return tid in self._resolving
+
+    async def _cancel_spec(self, spec: dict):
+        from ..exceptions import TaskCancelledError
+
+        await self._fail_returns(
+            spec, "cancelled by ray_tpu.cancel()", error_cls=TaskCancelledError
+        )
+        await _release_spec_deps(self.worker, spec)
 
     async def _idle_reaper(self):
         idle_s = cfg.task_lease_idle_ms / 1000.0
@@ -1278,6 +1435,40 @@ class Worker:
             self.io.post(_swallow_conn_errors(self.conn.send(msg)))
         except RuntimeError:
             pass  # loop shut down
+
+    def cancel_task(self, object_ref, force: bool = False) -> bool:
+        """ray_tpu.cancel() entry (reference: python/ray/_private/worker.py
+        cancel -> CoreWorker::CancelTask). Direct-path tasks are chased
+        caller-side first (queued specs dropped, in-flight ones forwarded
+        to their leased worker / actor); head-routed and already-recorded
+        tasks go through the head, which also owns force=True (kill the
+        worker)."""
+        tid = object_ref.task_id()
+
+        async def _try_channels():
+            for ch in list(self._task_channels.values()):
+                if ch.cancel(tid):
+                    return True
+            for ch in list(self._actor_channels.values()):
+                if ch.cancel(tid):
+                    return True
+            return False
+
+        found = False
+        if self.io is not None and (self._task_channels or self._actor_channels):
+            try:
+                found = self.io.run(_try_channels(), timeout=10)
+            except Exception:
+                found = False
+        if found and not force:
+            return True
+        try:
+            head_found = self.request(
+                {"t": "cancel_task", "task_id": tid, "force": bool(force)}
+            )
+        except Exception:
+            head_found = False
+        return bool(found or head_found)
 
     def send_ordered(self, msg: dict):
         """Fire-and-forget submit. Per-connection FIFO both on the asyncio

@@ -10,6 +10,7 @@ threads so the protocol loop stays responsive.
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import os
 import sys
@@ -40,6 +41,15 @@ class WorkerServer:
             max_workers=1, thread_name_prefix="task-exec"
         )
         self._loop: asyncio.AbstractEventLoop = None  # type: ignore
+        # task_id -> executing thread ident, for async cancellation; and
+        # cancels that arrived before their task started executing (the
+        # task may be queued behind another on the executor). _cancel_lock
+        # serializes the async raise against task start/end on the executor
+        # thread: without it, a cancel aimed at a task that just finished
+        # could land in the NEXT task on the same thread
+        self._task_threads: dict = {}
+        self._pending_cancels: "collections.OrderedDict" = collections.OrderedDict()
+        self._cancel_lock = threading.Lock()
 
     async def _start_direct_server(self) -> str:
         """Listen for direct caller->worker task pushes (reference:
@@ -155,6 +165,8 @@ class WorkerServer:
             return "pong"
         if t == "profile":
             return await self._profile(msg)
+        if t == "cancel_task":
+            return self._cancel(msg["task_id"])
         if t == "shutdown":
             self._loop.call_soon(sys.exit, 0)
             return True
@@ -180,6 +192,82 @@ class WorkerServer:
         return await asyncio.get_running_loop().run_in_executor(
             None, profiling.cpu_profile, duration, interval
         )
+
+    def _cancel(self, task_id: str) -> bool:
+        """Cancel a task on THIS worker (reference: _raylet.pyx
+        execute_task_with_cancellation_handler + CoreWorker::HandleCancelTask
+        — the cancellation is raised asynchronously in the thread executing
+        the task). Running: raise TaskCancelledError in its thread via the
+        C API. Not started yet (queued behind another task on the
+        executor): remember the id so _execute drops it before user code
+        runs."""
+        import ctypes
+
+        from ..exceptions import TaskCancelledError
+
+        from .worker import _flag_bounded
+
+        with self._cancel_lock:
+            ident = self._task_threads.get(task_id)
+            if ident is None:
+                _flag_bounded(self._pending_cancels, task_id)
+                return False
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
+            )
+            return True
+
+    @staticmethod
+    def _cancelled_reply(task_id: str, return_ids):
+        from . import serialization
+        from ..exceptions import TaskCancelledError
+
+        env = serialization.serialize(
+            TaskCancelledError(f"task {task_id} was cancelled")
+        )
+        env.is_error = True
+        return {"results": [env for _ in return_ids] or [env]}
+
+    def _execute(self, task_id: str, return_ids, body):
+        """Run a task body on the executor thread with cancellation
+        bookkeeping: short-circuit tasks cancelled before they started,
+        register the executing thread for the async raise, and CLEAR any
+        still-pending async exception afterwards so a cancel that lands
+        between task end and deregistration cannot escape into the
+        executor pool and kill its thread."""
+        import ctypes
+
+        from ..exceptions import TaskCancelledError
+
+        ident = threading.get_ident()
+        with self._cancel_lock:
+            # a cancel that fired in the narrow window after its task's
+            # body returned can escape past the finally below (the work
+            # item catches it): purge any stale registration left on THIS
+            # thread and clear a still-pending stray exc before running
+            # new user code
+            for stale_tid, stale_ident in list(self._task_threads.items()):
+                if stale_ident == ident:
+                    self._task_threads.pop(stale_tid, None)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(ident), None)
+            if task_id in self._pending_cancels:
+                self._pending_cancels.pop(task_id, None)
+                return self._cancelled_reply(task_id, return_ids)
+            self._task_threads[task_id] = ident
+        try:
+            return body()
+        except TaskCancelledError:
+            # the async raise usually lands inside the user function and is
+            # packaged by execute_and_package; this catches the rare landing
+            # in the result-packaging window
+            return self._cancelled_reply(task_id, return_ids)
+        finally:
+            with self._cancel_lock:
+                self._task_threads.pop(task_id, None)
+                self._pending_cancels.pop(task_id, None)
+                # clear a set-but-unfired async exc so it cannot escape
+                # into the pool and kill the thread between tasks
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(ident), None)
 
     async def _fetch_blob(self, ns: str, key: str, cache: dict):
         if key in cache:
@@ -236,7 +324,10 @@ class WorkerServer:
                         fn, method_name, msg["args"], msg["return_ids"], pin_results=True
                     )
 
-            return await self._loop.run_in_executor(self._executor, _call)
+            return await self._loop.run_in_executor(
+                self._executor,
+                lambda: self._execute(msg["task_id"], msg["return_ids"], _call),
+            )
         fn = await self._fetch_blob("fn", msg["fn_key"], self._fn_cache)
 
         def _run():
@@ -247,7 +338,10 @@ class WorkerServer:
             ):
                 return execute_and_package(fn, name, msg["args"], msg["return_ids"])
 
-        return await self._loop.run_in_executor(self._executor, _run)
+        return await self._loop.run_in_executor(
+            self._executor,
+            lambda: self._execute(msg["task_id"], msg["return_ids"], _run),
+        )
 
 
 def main():

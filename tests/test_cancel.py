@@ -1,0 +1,216 @@
+"""Task cancellation (reference: python/ray/_private/worker.py ray.cancel +
+core_worker cancellation — queued tasks are dropped, running tasks get an
+async-raised cancellation in the executing thread, force=True kills the
+worker). Covers the head queue, the parked (unplaceable) queue, the direct
+caller->worker path, and running-task interruption."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+@pytest.fixture
+def head_path():
+    ray_tpu.init(
+        num_cpus=2,
+        ignore_reinit_error=True,
+        _system_config={"direct_task_calls": False},
+    )
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def direct_path():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_unplaceable(head_path):
+    @ray_tpu.remote(resources={"never": 1.0})
+    def blocked():
+        return 1
+
+    ref = blocked.remote()
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_parked_backlog(head_path):
+    """Cancel tasks sitting in the PARKED (blocked-shape) queue, not just
+    the live pending queue."""
+
+    @ray_tpu.remote(resources={"never": 1.0})
+    def blocked():
+        return 1
+
+    refs = [blocked.remote() for _ in range(50)]
+    time.sleep(0.5)  # let the backlog park
+    mid = refs[25]
+    assert ray_tpu.cancel(mid)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(mid, timeout=30)
+
+
+def test_cancel_running_task(head_path):
+    @ray_tpu.remote
+    def slow():
+        for _ in range(600):
+            time.sleep(0.05)
+        return "finished"
+
+    ref = slow.remote()
+    time.sleep(1.5)  # let it start running
+    assert ray_tpu.cancel(ref)
+    t0 = time.perf_counter()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.perf_counter() - t0 < 25
+
+    # the worker survives a non-force cancel and runs new work
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 42
+
+
+def test_cancel_running_force(head_path):
+    @ray_tpu.remote
+    def slow():
+        for _ in range(600):
+            time.sleep(0.05)
+        return "finished"
+
+    ref = slow.remote()
+    time.sleep(1.5)
+    assert ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_finished_task_is_noop(head_path):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    assert not ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=60) == 7
+
+
+def test_cancel_direct_path_running(direct_path):
+    """Default config: tasks ride the caller->worker lease path; cancel
+    must chase the in-flight spec over the direct channel."""
+
+    @ray_tpu.remote
+    def slow():
+        for _ in range(600):
+            time.sleep(0.05)
+        return "finished"
+
+    ref = slow.remote()
+    time.sleep(2.0)
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_direct_path_queued(direct_path):
+    """A burst deeper than the lease pool leaves specs queued caller-side;
+    cancelling one drops it before it ever reaches a worker."""
+
+    @ray_tpu.remote
+    def slow():
+        for _ in range(100):
+            time.sleep(0.05)
+        return "finished"
+
+    refs = [slow.remote() for _ in range(12)]
+    victim = refs[-1]
+    assert ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=120)
+    for r in refs[:2]:
+        assert ray_tpu.get(r, timeout=120) == "finished"
+
+
+def test_cancel_actor_method(head_path):
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            for _ in range(600):
+                time.sleep(0.05)
+            return "finished"
+
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.slow.remote()
+    time.sleep(1.5)
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    # actor survives and serves the next call
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_cancel_head_routed_actor_method():
+    """Actor calls routed through the head have no TaskRecord — cancel
+    reaches them via the head's actor in-flight registry."""
+    ray_tpu.init(
+        num_cpus=2,
+        ignore_reinit_error=True,
+        _system_config={"direct_task_calls": False, "direct_actor_calls": False},
+    )
+    try:
+
+        @ray_tpu.remote
+        class A:
+            def slow(self):
+                for _ in range(600):
+                    time.sleep(0.05)
+                return "finished"
+
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        ref = a.slow.remote()
+        time.sleep(1.5)
+        assert ray_tpu.cancel(ref)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(ref, timeout=60)
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_force_cancel_defeats_caller_side_retry(direct_path):
+    """Force-cancelling a direct-path task kills the worker; the caller's
+    lease-retry machinery must fail the task as cancelled, NOT rerun it on
+    a fresh lease (max_retries default is 3)."""
+
+    @ray_tpu.remote
+    def slow():
+        for _ in range(600):
+            time.sleep(0.05)
+        return "finished"
+
+    ref = slow.remote()
+    time.sleep(2.0)
+    assert ray_tpu.cancel(ref, force=True)
+    t0 = time.perf_counter()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    # a retried run would take ~30s; cancellation settles promptly
+    assert time.perf_counter() - t0 < 15
