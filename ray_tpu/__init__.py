@@ -16,7 +16,7 @@ __version__ = "0.1.0"
 from . import exceptions  # noqa: F401
 from . import cross_language  # noqa: F401
 from .actor import ActorClass, ActorHandle
-from .object_ref import ObjectRef
+from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
 from ._private.config import GLOBAL_CONFIG
 from ._private.worker import global_worker
@@ -35,6 +35,7 @@ __all__ = [
     "available_resources",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "get_runtime_context",
     "method",
@@ -203,7 +204,20 @@ def method(num_returns: int = 1):
 
 
 def get(object_refs, *, timeout: Optional[float] = None):
-    return global_worker.get(object_refs, timeout=timeout)
+    from .object_ref import ObjectRefGenerator, StreamDescriptor
+
+    result = global_worker.get(object_refs, timeout=timeout)
+    # num_returns="dynamic" parity: the task's single ref resolves to an
+    # ObjectRefGenerator over the yielded objects (reference:
+    # DynamicObjectRefGenerator via ray.get)
+    if isinstance(result, StreamDescriptor) and isinstance(object_refs, ObjectRef):
+        return ObjectRefGenerator(object_refs, count=result.count)
+    if isinstance(result, list) and any(isinstance(v, StreamDescriptor) for v in result):
+        return [
+            ObjectRefGenerator(r, count=v.count) if isinstance(v, StreamDescriptor) else v
+            for v, r in zip(result, object_refs)
+        ]
+    return result
 
 
 def put(value) -> ObjectRef:

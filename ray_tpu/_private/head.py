@@ -308,6 +308,11 @@ class Head:
         # head-routed actor calls in flight: task_id -> worker_id, so
         # cancel_task can reach a call that has no TaskRecord
         self._actor_inflight: Dict[str, str] = {}
+        # streaming-generator bookkeeping: the yields' baseline refs are
+        # owned by the task's completion object — freeing it frees them
+        # (reference: dynamic returns are freed with their generator ref)
+        self._stream_children: Dict[str, List[str]] = {}  # task_id -> oids
+        self._stream_completion: Dict[str, str] = {}  # completion oid -> task_id
         self.idle_workers: Dict[str, List[str]] = collections.defaultdict(list)
         self.server: Optional[asyncio.base_events.Server] = None
         self.tcp_server: Optional[asyncio.base_events.Server] = None
@@ -328,7 +333,7 @@ class Head:
         # with their object's last reference.
         self.object_lineage: Dict[str, str] = {}
         self._reconstructing: Dict[str, asyncio.Future] = {}
-        self.objects.on_free_oid = self.object_lineage.pop
+        self.objects.on_free_oid = self._on_object_freed
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
         self.metrics_store: Dict[str, dict] = {}
         # named-channel pubsub (reference: src/ray/pubsub publisher.h:307 /
@@ -1180,8 +1185,19 @@ class Head:
 
     # --- objects ---
 
+    def _on_object_freed(self, oid: str, _default=None):
+        self.object_lineage.pop(oid, None)
+        tid = self._stream_completion.pop(oid, None)
+        if tid is not None:
+            # the stream's terminal object died: release every yield's
+            # baseline ref (consumers hold their own borrows)
+            for child in self._stream_children.pop(tid, []):
+                self.objects.remove_ref(child, 1)
+
     async def _h_put_object(self, conn, msg):
         oid = msg["object_id"]
+        if msg.get("stream_of"):
+            self._stream_children.setdefault(msg["stream_of"], []).append(oid)
         self.objects.put(oid, msg["envelope"])
         self.objects.add_ref(oid, msg.get("initial_refs", 1))
         # direct-transport results carry the caller's +1 here; if the caller
@@ -1309,7 +1325,9 @@ class Head:
         ids: List[str] = msg["object_ids"]
         num_returns = msg["num_returns"]
         timeout = msg.get("timeout")
-        ready = [oid for oid in ids if self.objects.contains(oid)]
+        # at most num_returns ids come back ready (reference ray.wait
+        # contract) — input order breaks ties among already-ready objects
+        ready = [oid for oid in ids if self.objects.contains(oid)][:num_returns]
         if len(ready) < num_returns:
             pending = {
                 asyncio.ensure_future(self.objects.wait_available(oid)): oid
@@ -1332,10 +1350,11 @@ class Head:
             finally:
                 for fut in pending:
                     fut.cancel()
+        # a FIRST_COMPLETED batch can deliver several at once: re-cap
         ready_set = set(ready)
-        return [oid for oid in ids if oid in ready_set], [
-            oid for oid in ids if oid not in ready_set
-        ]
+        ready_list = [oid for oid in ids if oid in ready_set][:num_returns]
+        ready_set = set(ready_list)
+        return ready_list, [oid for oid in ids if oid not in ready_set]
 
     # --- cross-language object exchange (JSON-codec clients, cpp/client/;
     # reference: the msgpack cross-language serialization the C++/Java
@@ -1510,6 +1529,8 @@ class Head:
             resources=spec.get("resources") or {"CPU": 1.0},
         )
         self.tasks[spec["task_id"]] = rec
+        if spec.get("streaming"):
+            self._stream_completion[spec["return_ids"][0]] = spec["task_id"]
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
         rec._resolve_task = asyncio.get_running_loop().create_task(
@@ -2800,6 +2821,7 @@ class Head:
                     "args": self._resolve_args(spec),
                     "return_ids": spec["return_ids"],
                     "trace_ctx": spec.get("trace_ctx"),
+                    "streaming": spec.get("streaming", False),
                 }
             )
         except Exception as e:

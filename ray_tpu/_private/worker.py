@@ -1891,6 +1891,7 @@ class Worker:
         max_retries: int = 0,
         scheduling_strategy=None,
         runtime_env: Optional[dict] = None,
+        streaming: bool = False,
     ) -> List["ObjectRef"]:
         from ..object_ref import ObjectRef
 
@@ -1918,12 +1919,18 @@ class Worker:
             "scheduling_strategy": scheduling_strategy,
             "runtime_env": self.merged_runtime_env(runtime_env),
         }
+        if streaming:
+            # a replayed generator would re-push yields over committed ids
+            spec["streaming"] = True
+            spec["max_retries"] = 0
         # Direct path (direct_task_transport.cc:588): push to a leased
         # worker, head out of the per-task loop. Head path for anything the
         # pooled-lease model can't serve: placement strategies, runtime
-        # envs, TPU workers (non-pooled).
+        # envs, TPU workers (non-pooled), streaming generators (yields ride
+        # the worker->head conn; the head must own the task's lifecycle).
         if (
             cfg.direct_task_calls
+            and not streaming
             and scheduling_strategy is None
             and not spec["runtime_env"]
             and not (resources or {}).get("TPU")
@@ -2113,8 +2120,64 @@ def resolve_task_args(args_msg: dict) -> Tuple[tuple, dict]:
     return args, kwargs
 
 
+def _stream_yields(fn, fn_name: str, args_msg: dict, return_ids: List[str]) -> dict:
+    """Execute a streaming-generator task (reference: _raylet.pyx
+    execute_streaming_generator + task_manager.cc HandleReportGeneratorItemReturns):
+    each yielded value is serialized and pushed to the head's object
+    directory IMMEDIATELY (consumers unblock per yield, not at task end);
+    the task's own return resolves to a StreamDescriptor carrying the final
+    count. Yields are pinned like actor results — a generator re-run is not
+    side-effect safe, so there is no lineage to rebuild an evicted yield."""
+    from ..exceptions import TaskError
+    from ..object_ref import StreamDescriptor, stream_object_id
+    from .config import GLOBAL_CONFIG as cfg
+    from .ids import ObjectID
+
+    task_id = ObjectID.from_hex(return_ids[0]).task_id().hex()
+    try:
+        args, kwargs = resolve_task_args(args_msg)
+    except exceptions.LostDepsError:
+        raise  # the caller converts this to a lost_deps reply
+    except Exception as e:  # noqa: BLE001 — bad arg envelope is a USER error
+        tb = traceback.format_exc()
+        env = serialization.serialize(TaskError(fn_name, tb, e))
+        env.is_error = True
+        return {"results": [env]}
+    count = 0
+    try:
+        gen = fn(*args, **kwargs)
+        for value in gen:
+            env = serialization.serialize(value)
+            env = serialization.externalize(
+                env, global_worker.shm, cfg.object_inline_limit_bytes, pin=True
+            )
+            # FIFO on the head conn: every yield lands in the directory
+            # before the completion reply that follows them
+            global_worker.send(
+                {
+                    "t": "put_object",
+                    "object_id": stream_object_id(task_id, count),
+                    "envelope": env,
+                    "initial_refs": 1,
+                    # ties this yield's baseline ref to the completion
+                    # object's lifetime head-side
+                    "stream_of": task_id,
+                }
+            )
+            count += 1
+    except Exception as e:  # noqa: BLE001 — mid-stream failure ends the stream
+        tb = traceback.format_exc()
+        err = e if isinstance(e, (exceptions.TaskError, exceptions.ActorError)) else TaskError(fn_name, tb, e)
+        env = serialization.serialize(err)
+        env.is_error = True  # consumed yields stay valid; the NEXT next() raises
+        return {"results": [env]}
+    env = serialization.serialize(StreamDescriptor(task_id, count))
+    return {"results": [env]}
+
+
 def execute_and_package(
-    fn, fn_name: str, args_msg: dict, return_ids: List[str], pin_results: bool = False
+    fn, fn_name: str, args_msg: dict, return_ids: List[str], pin_results: bool = False,
+    streaming: bool = False,
 ) -> dict:
     """Run a task function and package results as envelopes.
 
@@ -2124,6 +2187,11 @@ def execute_and_package(
 
     Reference: _raylet.pyx:1630 execute_task_with_cancellation_handler.
     """
+    if streaming:
+        try:
+            return _stream_yields(fn, fn_name, args_msg, return_ids)
+        except exceptions.LostDepsError as e:
+            return {"lost_deps": e.object_ids}
     try:
         try:
             args, kwargs = resolve_task_args(args_msg)

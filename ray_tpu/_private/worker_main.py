@@ -336,7 +336,10 @@ class WorkerServer:
             with tracing.span_for_execution(
                 f"task.{name}", msg.get("trace_ctx"), task_id=msg["task_id"]
             ):
-                return execute_and_package(fn, name, msg["args"], msg["return_ids"])
+                return execute_and_package(
+                    fn, name, msg["args"], msg["return_ids"],
+                    streaming=msg.get("streaming", False),
+                )
 
         return await self._loop.run_in_executor(
             self._executor,

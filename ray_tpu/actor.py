@@ -32,6 +32,11 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         from ._private.worker import global_worker
 
+        if isinstance(self._num_returns, str):
+            raise ValueError(
+                "streaming/dynamic generator returns are supported for tasks "
+                "only, not actor methods"
+            )
         refs = global_worker.submit_actor_task(
             self._handle._actor_id,
             self._method_name,

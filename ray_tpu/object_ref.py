@@ -96,3 +96,94 @@ class ObjectRef:
 
 def _make_ref(id_hex: str) -> ObjectRef:
     return ObjectRef(id_hex)
+
+
+# Streamed-generator task returns index their yielded objects from this
+# offset in the ObjectID index space, far above any static num_returns
+# (reference: object_id.h reserves the dynamic-return index range the same
+# way for streaming generators).
+STREAM_INDEX_BASE = 1_000_000
+
+
+def stream_object_id(task_id_hex: str, index: int) -> str:
+    from ._private.ids import ObjectID, TaskID
+
+    return ObjectID.for_return(
+        TaskID.from_hex(task_id_hex), STREAM_INDEX_BASE + index
+    ).hex()
+
+
+class StreamDescriptor:
+    """The terminal value of a streaming/dynamic generator task: how many
+    objects were yielded (their ids derive from the task id). ray_tpu.get
+    on the task's ref resolves this to an ObjectRefGenerator."""
+
+    def __init__(self, task_id_hex: str, count: int):
+        self.task_id = task_id_hex
+        self.count = count
+
+    def __reduce__(self):
+        return (StreamDescriptor, (self.task_id, self.count))
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs a generator task yields (reference:
+    python/ray/_raylet.pyx ObjectRefGenerator / DynamicObjectRefGenerator).
+    Yields become consumable AS the remote generator produces them —
+    iteration blocks on the next yield OR task completion, whichever comes
+    first; a mid-stream task error surfaces after the yields that preceded
+    it."""
+
+    def __init__(self, completion_ref: "ObjectRef", count: Optional[int] = None):
+        # Ownership model: every yield's baseline (+1 from the worker's
+        # put) belongs to the COMPLETION object — the head releases them
+        # all when it is freed. Refs handed out here are plain borrows
+        # (+1/-1 of their own), so consuming the same dynamic stream twice
+        # is safe and an abandoned generator leaks nothing once the
+        # completion ref dies.
+        self._completion_ref = completion_ref
+        self._task_id = completion_ref.task_id()
+        self._i = 0
+        self._count: Optional[int] = count
+        self._pending_ref: Optional[ObjectRef] = None
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def _take_pending(self) -> "ObjectRef":
+        ref = self._pending_ref
+        if ref is None:
+            ref = ObjectRef(stream_object_id(self._task_id, self._i))
+        self._pending_ref = None
+        self._i += 1
+        return ref
+
+    def __next__(self) -> "ObjectRef":
+        from ._private.worker import global_worker
+
+        while True:
+            if self._count is not None:
+                if self._i >= self._count:
+                    self._pending_ref = None  # borrow: safe to just drop
+                    raise StopIteration
+                return self._take_pending()
+            if self._pending_ref is None:
+                self._pending_ref = ObjectRef(stream_object_id(self._task_id, self._i))
+            ready, _ = global_worker.wait(
+                [self._pending_ref, self._completion_ref], num_returns=1, timeout=None
+            )
+            if ready and ready[0].id == self._pending_ref.id:
+                return self._take_pending()
+            # completion first: a yield with this index either never
+            # happened (StopIteration / task error) or raced in just
+            # before the terminal marker — resolve the count to decide
+            desc = global_worker.get(self._completion_ref)  # raises task errors
+            if not isinstance(desc, StreamDescriptor):
+                raise TypeError(
+                    f"expected a streaming task terminal marker, got {type(desc)}"
+                )
+            self._count = desc.count
+
+    def completed(self) -> "ObjectRef":
+        """The ref that settles when the generator task finishes."""
+        return self._completion_ref

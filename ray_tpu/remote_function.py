@@ -41,12 +41,17 @@ class RemoteFunction:
         from ._private.options import resolve_task_resources
 
         num_returns = opts.get("num_returns", 1)
+        # generator tasks (reference: num_returns="streaming" returns an
+        # ObjectRefGenerator from .remote(); "dynamic" returns a single ref
+        # whose get() resolves to the generator — _raylet.pyx
+        # ObjectRefGenerator / DynamicObjectRefGenerator)
+        streaming = num_returns in ("streaming", "dynamic")
         refs = global_worker.submit_task(
             self._function,
             args,
             kwargs,
             name=opts.get("name") or self._function.__name__,
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
             resources=resolve_task_resources(opts, is_actor=False),
             # reference default: tasks retry 3x on SYSTEM failures (worker
             # crash, lease failure) — ray_config_def.h task_max_retries;
@@ -54,8 +59,13 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", 3),
             scheduling_strategy=_strategy_to_wire(opts.get("scheduling_strategy")),
             runtime_env=_validated_runtime_env(opts.get("runtime_env")),
+            streaming=streaming,
         )
-        if num_returns == 1:
+        if num_returns == "streaming":
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0])
+        if num_returns in (1, "dynamic"):
             return refs[0]
         return refs
 

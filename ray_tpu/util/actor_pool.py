@@ -1,0 +1,144 @@
+"""Fixed-pool actor work distribution.
+
+Reference parity: python/ray/util/actor_pool.py (ActorPool: map /
+map_unordered / submit / get_next / get_next_unordered / has_next /
+has_free / pop_idle / push). Rebuilt on ray_tpu primitives: an idle-actor
+free list plus a future->actor table, with `wait` driving the unordered
+completion order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    """Operate on a fixed pool of actors, keeping every actor busy while
+    work remains.
+
+    Example:
+        pool = ActorPool([Actor.remote(), Actor.remote()])
+        list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    """
+
+    def __init__(self, actors: Iterable[Any]):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor: dict = {}  # ref key -> (index, actor)
+        self._index_to_future: dict = {}  # submit index -> ref
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []  # (fn, value) waiting for an actor
+
+    # -- bulk mapping ---------------------------------------------------
+
+    def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        """Apply fn(actor, value) over values; yield results IN ORDER as
+        they become ready."""
+        for v in values:
+            self.submit(fn, v)
+
+        def gen():
+            while self.has_next():
+                yield self.get_next()
+
+        return gen()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        """Like map, but yields in completion order (better utilization
+        under uneven task durations)."""
+        for v in values:
+            self.submit(fn, v)
+
+        def gen():
+            while self.has_next():
+                yield self.get_next_unordered()
+
+        return gen()
+
+    # -- incremental submission -----------------------------------------
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
+        """Schedule fn(actor, value) on an idle actor; queue it when every
+        actor is busy (drained as results are collected)."""
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float | None = None, ignore_if_timedout: bool = False):
+        """Next result in SUBMISSION order."""
+        import ray_tpu
+        from ..exceptions import GetTimeoutError
+
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        if self._next_return_index >= self._next_task_index:
+            raise ValueError("It is not allowed to call get_next() after get_next_unordered().")
+        future = self._index_to_future[self._next_return_index]
+        timed_out = False
+        if timeout is not None:
+            res, _ = ray_tpu.wait([future], timeout=timeout)
+            if not res:
+                timed_out = True
+        if timed_out:
+            if not ignore_if_timedout:
+                raise GetTimeoutError(f"get_next() timed out after {timeout}s")
+            return None
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        i, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def get_next_unordered(self, timeout: float | None = None, ignore_if_timedout: bool = False):
+        """Next result in COMPLETION order."""
+        import ray_tpu
+        from ..exceptions import GetTimeoutError
+
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        res, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if res:
+            [future] = res
+        else:
+            if not ignore_if_timedout:
+                raise GetTimeoutError(f"get_next_unordered() timed out after {timeout}s")
+            return None
+        i, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        del self._index_to_future[i]
+        self._next_return_index = max(self._next_return_index, i + 1)
+        return ray_tpu.get(future)
+
+    # -- pool membership -------------------------------------------------
+
+    def _return_actor(self, actor):
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def has_free(self) -> bool:
+        """True when an actor is idle AND no submits are queued."""
+        return bool(self._idle_actors) and not self._pending_submits
+
+    def pop_idle(self):
+        """Remove and return an idle actor (None if all are busy)."""
+        if self.has_free():
+            return self._idle_actors.pop()
+        return None
+
+    def push(self, actor):
+        """Add an actor to the pool (e.g. returning one from pop_idle)."""
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("Actor already belongs to current ActorPool")
+        self._return_actor(actor)

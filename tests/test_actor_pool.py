@@ -1,0 +1,71 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py +
+python/ray/tests/test_actor_pool.py semantics)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+@pytest.fixture(scope="module")
+def pool_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, v):
+        return 2 * v
+
+    def slow_double(self, v):
+        time.sleep(0.1 if v % 2 else 0.5)
+        return 2 * v
+
+
+def test_map_ordered(pool_cluster):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    assert list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4])) == [2, 4, 6, 8]
+    # the pool is reusable after a full drain
+    assert list(pool.map(lambda a, v: a.double.remote(v), [5])) == [10]
+
+
+def test_map_unordered_completion_order(pool_cluster):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map_unordered(lambda a, v: a.slow_double.remote(v), [0, 1, 2, 3]))
+    assert sorted(out) == [0, 2, 4, 6]
+
+
+def test_submit_get_next(pool_cluster):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)  # queues: one actor
+    assert pool.has_next()
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_get_next_timeout(pool_cluster):
+    from ray_tpu.exceptions import GetTimeoutError
+
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.slow_double.remote(v), 2)  # ~0.5s
+    with pytest.raises(GetTimeoutError):
+        pool.get_next(timeout=0.05)
+    assert pool.get_next_unordered(timeout=30) == 4
+
+
+def test_pop_idle_and_push(pool_cluster):
+    a1, a2 = Doubler.remote(), Doubler.remote()
+    pool = ActorPool([a1, a2])
+    popped = pool.pop_idle()
+    assert popped is not None
+    assert list(pool.map(lambda a, v: a.double.remote(v), [1, 2])) == [2, 4]
+    pool.push(popped)
+    with pytest.raises(ValueError):
+        pool.push(popped)
+    assert list(pool.map(lambda a, v: a.double.remote(v), [3])) == [6]

@@ -1,0 +1,131 @@
+"""Streaming / dynamic generator tasks (reference: _raylet.pyx
+ObjectRefGenerator + execute_streaming_generator; num_returns="streaming"
+returns the generator from .remote(), "dynamic" resolves it at ray.get)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import ObjectRefGenerator
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_streaming_yields_arrive_incrementally(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_range(n):
+        for i in range(n):
+            time.sleep(0.4)
+            yield i * 10
+
+    t0 = time.perf_counter()
+    gen = slow_range.remote(5)
+    assert isinstance(gen, ObjectRefGenerator)
+    first = ray_tpu.get(next(gen), timeout=30)
+    first_at = time.perf_counter() - t0
+    assert first == 0
+    # 5 yields x 0.4s = 2s total; the first must arrive well before the end
+    assert first_at < 1.5, f"first yield took {first_at:.2f}s — not streaming"
+    rest = [ray_tpu.get(r, timeout=30) for r in gen]
+    assert rest == [10, 20, 30, 40]
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_dynamic_resolves_at_get(cluster):
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen3():
+        yield "a"
+        yield "b"
+        yield "c"
+
+    ref = gen3.remote()
+    gen = ray_tpu.get(ref, timeout=30)
+    assert isinstance(gen, ObjectRefGenerator)
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == ["a", "b", "c"]
+
+
+def test_empty_generator(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def none():
+        if False:
+            yield 1
+
+    gen = none.remote()
+    assert list(gen) == []
+
+
+def test_midstream_exception_after_yields(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError("stream broke")
+
+    gen = bad.remote()
+    assert ray_tpu.get(next(gen), timeout=30) == 1
+    assert ray_tpu.get(next(gen), timeout=30) == 2
+    with pytest.raises(ValueError, match="stream broke"):
+        next(gen)
+
+
+def test_large_yields_go_through_shm(cluster):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def arrays():
+        for i in range(3):
+            yield np.full((512, 512), i, dtype=np.float32)  # 1MB each
+
+    vals = [ray_tpu.get(r, timeout=60) for r in arrays.remote()]
+    assert [v[0, 0] for v in vals] == [0.0, 1.0, 2.0]
+
+
+def test_actor_method_streaming_rejected(cluster):
+    @ray_tpu.remote
+    class A:
+        def gen(self):
+            yield 1
+
+    a = A.remote()
+    with pytest.raises(ValueError, match="tasks only"):
+        a.gen.options(num_returns="streaming").remote()
+
+
+def test_dynamic_stream_consumable_twice(cluster):
+    """Consumer refs are borrows; the yields' baseline refs belong to the
+    completion object — a second get() of the same dynamic ref must work."""
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen3():
+        for i in range(3):
+            yield i
+
+    ref = gen3.remote()
+    assert [ray_tpu.get(r, timeout=30) for r in ray_tpu.get(ref, timeout=30)] == [0, 1, 2]
+    assert [ray_tpu.get(r, timeout=30) for r in ray_tpu.get(ref, timeout=30)] == [0, 1, 2]
+
+
+def test_yield_survives_generator_drop_via_borrow(cluster):
+    """A yielded ref outlives the generator (and the completion ref's
+    baseline release) through its own borrow count."""
+    import gc
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen2():
+        yield "keep-me"
+        yield "other"
+
+    gen = gen2.remote()
+    kept = next(gen)
+    _ = ray_tpu.get(gen.completed(), timeout=30)  # stream finished
+    del gen  # completion ref dies -> head releases the baselines
+    gc.collect()
+    time.sleep(0.5)
+    assert ray_tpu.get(kept, timeout=30) == "keep-me"
