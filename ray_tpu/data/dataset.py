@@ -954,6 +954,243 @@ class Dataset:
             return pd.DataFrame(rows)
         return pd.DataFrame({"value": rows})
 
+    # ---- global aggregates (reference: dataset.py sum/min/max/mean/std
+    # via _aggregate_on -> AggregateFn; per-block partials stream through
+    # the windowed executor and combine driver-side) ----
+
+    def _column_values(self, block, on: Optional[str]):
+        if isinstance(block, dict):
+            if on is None:
+                raise ValueError("this dataset has named columns; pass on=<column>")
+            return np.asarray(block[on])
+        try:
+            import pyarrow as pa
+
+            if isinstance(block, pa.Table):
+                if on is None:
+                    raise ValueError("this dataset has named columns; pass on=<column>")
+                return block.column(on).to_numpy(zero_copy_only=False)
+        except ImportError:
+            pass
+        if on is not None:
+            return np.asarray([r[on] for r in block])
+        return np.asarray(block)
+
+    def _agg_partials(self, on: Optional[str]):
+        """Yield (n, sum, mean, M2, min, max) per block; empty blocks skip.
+        mean/M2 feed the Chan/Welford merge in std() — a naive global
+        sum-of-squares catastrophically cancels when |mean| >> spread."""
+        for block in self._iter_computed_blocks():
+            if _block_num_rows(block) == 0:
+                continue
+            v = self._column_values(block, on).astype(np.float64)
+            m = v.mean()
+            yield (v.size, v.sum(), m, ((v - m) ** 2).sum(), v.min(), v.max())
+
+    def sum(self, on: Optional[str] = None):
+        total, seen = 0.0, False
+        for n, s, _, _, _, _ in self._agg_partials(on):
+            total += s
+            seen = True
+        return total if seen else None
+
+    def min(self, on: Optional[str] = None):
+        out = None
+        for _, _, _, _, mn, _ in self._agg_partials(on):
+            out = mn if out is None else builtins.min(out, mn)
+        return out
+
+    def max(self, on: Optional[str] = None):
+        out = None
+        for _, _, _, _, _, mx in self._agg_partials(on):
+            out = mx if out is None else builtins.max(out, mx)
+        return out
+
+    def mean(self, on: Optional[str] = None):
+        n_total, s_total = 0, 0.0
+        for n, s, _, _, _, _ in self._agg_partials(on):
+            n_total += n
+            s_total += s
+        return s_total / n_total if n_total else None
+
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        # Chan's parallel variance merge over per-block (n, mean, M2)
+        n_a, mean_a, m2_a = 0, 0.0, 0.0
+        for n, _, mean_b, m2_b, _, _ in self._agg_partials(on):
+            if n_a == 0:
+                n_a, mean_a, m2_a = n, mean_b, m2_b
+                continue
+            delta = mean_b - mean_a
+            n_ab = n_a + n
+            m2_a += m2_b + delta * delta * n_a * n / n_ab
+            mean_a += delta * n / n_ab
+            n_a = n_ab
+        if n_a <= ddof:
+            return None
+        return float(np.sqrt(m2_a / (n_a - ddof)))
+
+    # ---- sampling / ordering ----
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        """Uniform per-row sample without a full shuffle (reference:
+        dataset.py random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        rng_seed = seed
+
+        def _sample(block, _seed=rng_seed, _frac=fraction):
+            import zlib
+
+            n = _block_num_rows(block)
+            if _seed is None:
+                rng = np.random.default_rng()
+            else:
+                # decorrelate equal-length blocks: fold a cheap content
+                # fingerprint into the seed (seeding on (seed, n) alone
+                # makes every 125-row block keep identical row positions)
+                rows = list(itertools.islice(_block_to_rows(block), 3))
+                fp = zlib.crc32(repr(rows).encode()) if rows else 0
+                rng = np.random.default_rng((_seed, n, fp))
+            keep = np.nonzero(rng.random(n) < _frac)[0]
+            return _block_take(block, keep)
+
+        return self._with_op(_Op("map_batches", _sample))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle BLOCK order only — cheap decorrelation for training
+        input (reference: dataset.py randomize_block_order)."""
+        fns = list(self._block_fns)
+        rng = np.random.default_rng(seed)
+        rng.shuffle(fns)
+        # read pushdown must NOT survive the shuffle (pushdown_reads would
+        # rebuild block_fns in source order, undoing it) — but keep the
+        # path list so input_files() still answers
+        meta = {"paths": list(self._read_meta.get("paths", []))} if self._read_meta else None
+        return Dataset(fns, list(self._ops), read_meta=meta)
+
+    # ---- inspection / conversion ----
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def take_batch(self, batch_size: int = 20):
+        for batch in self.iter_batches(batch_size=batch_size):
+            return batch
+        raise ValueError("dataset is empty")
+
+    def size_bytes(self) -> int:
+        total = 0
+        for block in self._iter_computed_blocks():
+            if isinstance(block, (list, tuple)):
+                # refine the backpressure helper's flat 64-bytes/row guess:
+                # user-facing size estimates should see real array payloads
+                for r in block:
+                    if isinstance(r, dict):
+                        total += builtins.sum(
+                            getattr(v, "nbytes", len(str(v))) for v in r.values()
+                        )
+                    else:
+                        total += getattr(r, "nbytes", len(str(r)))
+            else:
+                total += _block_size_bytes(block)
+        return total
+
+    def input_files(self) -> List[str]:
+        meta = self._read_meta or {}
+        return list(meta.get("paths", []))
+
+    def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
+        """Split by global ROW indices (reference: dataset.py
+        split_at_indices). Materializes once; each output holds its row
+        range."""
+        indices = list(indices)
+        if indices != sorted(indices) or (indices and indices[0] < 0):
+            raise ValueError(f"indices must be sorted and non-negative: {indices}")
+        blocks = self._compute_blocks()
+        rows: List[Any] = []
+        for b in blocks:
+            rows.extend(_block_to_rows(b))
+        if indices and indices[-1] > len(rows):
+            raise ValueError(
+                f"index {indices[-1]} out of range for {len(rows)} rows"
+            )
+        bounds = [0] + indices + [len(rows)]
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            chunk = rows[lo:hi]
+            out.append(from_items(chunk))
+        return out
+
+    def split_proportionately(self, proportions: Sequence[float]) -> List["Dataset"]:
+        if not proportions or any(p <= 0 for p in proportions) or builtins.sum(proportions) >= 1.0:
+            raise ValueError("proportions must be positive and sum to < 1")
+        n = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(n * acc))
+        return self.split_at_indices(indices)
+
+    def to_pandas_refs(self) -> List[Any]:
+        """One ObjectRef of a pandas DataFrame per block (reference:
+        dataset.py to_pandas_refs)."""
+        import pandas as pd
+
+        import ray_tpu
+
+        refs = []
+        for block in self._iter_computed_blocks():
+            rows = list(_block_to_rows(block))
+            df = pd.DataFrame(rows) if rows and isinstance(rows[0], dict) else pd.DataFrame({"value": rows})
+            refs.append(ray_tpu.put(df))
+        return refs
+
+    def to_numpy_refs(self) -> List[Any]:
+        import ray_tpu
+
+        refs = []
+        for block in self._iter_computed_blocks():
+            if isinstance(block, dict):
+                refs.append(ray_tpu.put({k: np.asarray(v) for k, v in block.items()}))
+                continue
+            # columnarize arrow/row blocks too, so the output shape does
+            # not depend on the internal block format
+            rows = list(_block_to_rows(block))
+            if rows and isinstance(rows[0], dict):
+                refs.append(
+                    ray_tpu.put({k: np.asarray([r[k] for r in rows]) for k in rows[0]})
+                )
+            else:
+                refs.append(ray_tpu.put(np.asarray(rows)))
+        return refs
+
+    def iter_tf_batches(self, *, batch_size: int = 256, drop_last: bool = False):
+        """Dict-of-ndarray batches shaped for tf.data consumption; yields
+        tf tensors when tensorflow is importable, numpy otherwise
+        (hermetic TPU images ship without TF)."""
+        try:
+            import tensorflow as tf  # type: ignore
+
+            conv = tf.convert_to_tensor
+        except Exception:
+            conv = None
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            if not isinstance(batch, dict):
+                try:
+                    import pyarrow as pa
+
+                    if isinstance(batch, pa.Table):
+                        batch = {c: batch.column(c).to_numpy(zero_copy_only=False)
+                                 for c in batch.column_names}
+                except ImportError:
+                    pass
+            if isinstance(batch, list) and batch and isinstance(batch[0], dict):
+                batch = {k: np.asarray([r[k] for r in batch]) for k in batch[0]}
+            if not isinstance(batch, dict):
+                batch = {"value": np.asarray(batch)}
+            yield {k: conv(v) for k, v in batch.items()} if conv is not None else batch
+
 
 # --------------------------------------------------------------------------
 # sources

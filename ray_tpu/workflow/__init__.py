@@ -10,15 +10,23 @@ to their checkpoints without relying on Python object ids.
 """
 
 from .api import (  # noqa: F401
+    cancel,
+    continuation,
     delete,
+    EventListener,
+    get_metadata,
     get_output,
     get_status,
     init,
     list_all,
     resume,
+    resume_all,
     resume_async,
     run,
     run_async,
+    sleep,
+    wait_for_event,
+    WorkflowCancellationError,
     WorkflowStatus,
 )
 

@@ -30,6 +30,26 @@ class WorkflowStatus(str, enum.Enum):
     SUCCESSFUL = "SUCCESSFUL"
     FAILED = "FAILED"
     RESUMABLE = "RESUMABLE"
+    CANCELED = "CANCELED"
+
+
+class WorkflowCancellationError(Exception):
+    """Raised from run()/resume() when the workflow was cancel()ed."""
+
+
+class Continuation:
+    """A step's 'the workflow continues with THIS dag' marker (reference:
+    workflow.continuation — a step returning continuation(dag) splices that
+    dag into the workflow; its result becomes the step's result)."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a DAG node (fn.bind(...))")
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
 
 
 def init(storage: Optional[str] = None) -> None:
@@ -93,6 +113,116 @@ def _step_path(wf: str, key: str) -> str:
     return os.path.join(_wf_dir(wf), "steps", key + ".pkl")
 
 
+def _cancel_requested(workflow_id: str) -> bool:
+    try:
+        with open(_meta_path(workflow_id)) as f:
+            return json.load(f).get("status") == WorkflowStatus.CANCELED.value
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
+    """Drive one DAG to completion under `prefix`-namespaced step keys.
+    Steps already checkpointed load from disk; a step result that is a
+    Continuation splices its dag in (own key namespace) and yields that
+    dag's result instead."""
+    import cloudpickle
+
+    import ray_tpu
+
+    input_args, input_kwargs = inputs
+    results: Dict[int, Any] = {}  # node id -> materialized value
+    memo = {"__input__": (input_args, input_kwargs)}
+
+    def persist(key: str, value: Any):
+        spath = _step_path(workflow_id, prefix + key)
+        os.makedirs(os.path.dirname(spath), exist_ok=True)
+        tmp = spath + ".tmp"
+        with open(tmp, "wb") as f:
+            # cloudpickle: continuation values carry DAG nodes + closures
+            f.write(cloudpickle.dumps(value))
+        os.replace(tmp, spath)
+
+    def settle(value: Any) -> Any:
+        """Timer markers wait out their deadline HERE on the driver (the
+        checkpoint keeps the raw marker, so resume waits the remainder)."""
+        if isinstance(value, _SleepUntil):
+            while True:
+                if _cancel_requested(workflow_id):
+                    raise WorkflowCancellationError(workflow_id)
+                rem = value.deadline - time.time()
+                if rem <= 0:
+                    return value.deadline
+                time.sleep(min(1.0, rem))
+        return value
+
+    plan = _step_plan(dag)
+    key_of = {id(node): key for key, node in plan}
+    remaining: List[DAGNode] = []
+    for key, node in plan:
+        spath = _step_path(workflow_id, prefix + key)
+        if os.path.exists(spath):
+            with open(spath, "rb") as f:
+                results[id(node)] = settle(pickle.loads(f.read()))
+        else:
+            remaining.append(node)
+
+    # Frontier executor: every ready FunctionNode is submitted as a task
+    # immediately, so independent branches run in parallel; each result
+    # is checkpointed as its ref resolves (durability stays per-step).
+    in_flight: Dict[Any, DAGNode] = {}  # ObjectRef -> node
+    while remaining or in_flight:
+        if _cancel_requested(workflow_id):
+            raise WorkflowCancellationError(workflow_id)
+        progressed = True
+        while progressed:
+            progressed = False
+            for node in list(remaining):
+                if not all(id(c) in results for c in node._children()):
+                    continue
+                if isinstance(node, (InputNode, InputAttributeNode)):
+                    value = node._execute_node(memo)
+                    persist(key_of[id(node)], value)
+                    results[id(node)] = value
+                elif isinstance(node, FunctionNode):
+                    # Parity with DAGNode.execute(): a node that IS a
+                    # top-level arg materializes to its value inside the
+                    # task; a node NESTED in a structure arrives as an
+                    # ObjectRef (the runtime only resolves top level)
+                    def sub(obj):
+                        if isinstance(obj, DAGNode):
+                            return results[id(obj)]
+                        return _map_structure(
+                            obj, lambda n: ray_tpu.put(results[id(n)])
+                        )
+
+                    args = tuple(sub(a) for a in node._bound_args)
+                    kwargs = {k: sub(v) for k, v in node._bound_kwargs.items()}
+                    in_flight[node._remote_function.remote(*args, **kwargs)] = node
+                else:
+                    raise ValueError(
+                        f"unsupported node type in workflow: {type(node).__name__}"
+                    )
+                remaining.remove(node)
+                progressed = True
+        if in_flight:
+            done, _ = ray_tpu.wait(list(in_flight), num_returns=1, timeout=1.0)
+            if not done:
+                continue  # timeout tick: re-check cancellation
+            node = in_flight.pop(done[0])
+            value = ray_tpu.get(done[0])
+            persist(key_of[id(node)], value)
+            results[id(node)] = settle(value)
+    out = results[id(dag)]
+    if isinstance(out, Continuation):
+        # splice: the continued dag's steps checkpoint under the parent
+        # step's namespace, so resume() replays the whole chain
+        out = _run_dag(
+            workflow_id, out.dag, ((), {}), prefix + key_of[id(dag)] + "@"
+        )
+    return out
+
+
 def _execute_workflow(workflow_id: str) -> Any:
     """(Re)drive a persisted workflow to completion. Steps already
     checkpointed are loaded, everything else runs as tasks."""
@@ -103,80 +233,17 @@ def _execute_workflow(workflow_id: str) -> Any:
         input_args, input_kwargs = pickle.loads(f.read())
 
     _write_meta(workflow_id, status=WorkflowStatus.RUNNING.value, driver_pid=os.getpid())
-    results: Dict[int, Any] = {}  # node id -> materialized value
-    memo = {"__input__": (input_args, input_kwargs)}
-
-    import ray_tpu
-
-    def persist(key: str, value: Any):
-        spath = _step_path(workflow_id, key)
-        os.makedirs(os.path.dirname(spath), exist_ok=True)
-        tmp = spath + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(pickle.dumps(value))
-        os.replace(tmp, spath)
-
     try:
-        plan = _step_plan(dag)
-        key_of = {id(node): key for key, node in plan}
-        remaining: List[DAGNode] = []
-        for key, node in plan:
-            spath = _step_path(workflow_id, key)
-            if os.path.exists(spath):
-                with open(spath, "rb") as f:
-                    results[id(node)] = pickle.loads(f.read())
-            else:
-                remaining.append(node)
-
-        # Frontier executor: every ready FunctionNode is submitted as a task
-        # immediately, so independent branches run in parallel; each result
-        # is checkpointed as its ref resolves (durability stays per-step).
-        in_flight: Dict[Any, DAGNode] = {}  # ObjectRef -> node
-        while remaining or in_flight:
-            progressed = True
-            while progressed:
-                progressed = False
-                for node in list(remaining):
-                    if not all(id(c) in results for c in node._children()):
-                        continue
-                    if isinstance(node, (InputNode, InputAttributeNode)):
-                        value = node._execute_node(memo)
-                        persist(key_of[id(node)], value)
-                        results[id(node)] = value
-                    elif isinstance(node, FunctionNode):
-                        # Parity with DAGNode.execute(): a node that IS a
-                        # top-level arg materializes to its value inside the
-                        # task; a node NESTED in a structure arrives as an
-                        # ObjectRef (the runtime only resolves top level)
-                        def sub(obj):
-                            if isinstance(obj, DAGNode):
-                                return results[id(obj)]
-                            return _map_structure(
-                                obj, lambda n: ray_tpu.put(results[id(n)])
-                            )
-
-                        args = tuple(sub(a) for a in node._bound_args)
-                        kwargs = {k: sub(v) for k, v in node._bound_kwargs.items()}
-                        in_flight[node._remote_function.remote(*args, **kwargs)] = node
-                    else:
-                        raise ValueError(
-                            f"unsupported node type in workflow: {type(node).__name__}"
-                        )
-                    remaining.remove(node)
-                    progressed = True
-            if in_flight:
-                done, _ = ray_tpu.wait(list(in_flight), num_returns=1)
-                node = in_flight.pop(done[0])
-                value = ray_tpu.get(done[0])
-                persist(key_of[id(node)], value)
-                results[id(node)] = value
-        out = results[id(dag)]
+        out = _run_dag(workflow_id, dag, (input_args, input_kwargs), "")
         with open(os.path.join(wdir, "result.pkl"), "wb") as f:
             f.write(pickle.dumps(out))
         _write_meta(
             workflow_id, status=WorkflowStatus.SUCCESSFUL.value, finished_at=time.time()
         )
         return out
+    except WorkflowCancellationError:
+        _write_meta(workflow_id, status=WorkflowStatus.CANCELED.value)
+        raise
     except Exception as e:
         _write_meta(workflow_id, status=WorkflowStatus.FAILED.value, error=repr(e))
         raise
@@ -296,3 +363,103 @@ def delete(workflow_id: str) -> None:
     import shutil
 
     shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+def cancel(workflow_id: str) -> None:
+    """Request cancellation (reference: workflow.cancel). The driving
+    executor observes the flag at its next scheduling tick, stops
+    submitting steps, and run()/resume() raise WorkflowCancellationError.
+    Checkpointed steps stay on disk — resume() restarts the remainder."""
+    status = get_status(workflow_id)  # raises on unknown id
+    if status in (WorkflowStatus.SUCCESSFUL, WorkflowStatus.FAILED):
+        raise ValueError(
+            f"workflow {workflow_id!r} already finished ({status.value})"
+        )
+    _write_meta(workflow_id, status=WorkflowStatus.CANCELED.value)
+
+
+def resume_all() -> List[Tuple[str, Future]]:
+    """Resume every RESUMABLE workflow (reference: workflow.resume_all);
+    returns (workflow_id, future) pairs."""
+    out = []
+    for wf, status in list_all():
+        if status == WorkflowStatus.RESUMABLE:
+            out.append((wf, resume_async(wf)))
+    return out
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    """Workflow metadata + per-step checkpoint inventory (reference:
+    workflow.get_metadata)."""
+    path = _meta_path(workflow_id)
+    if not os.path.exists(path):
+        raise ValueError(f"no such workflow {workflow_id!r}")
+    with open(path) as f:
+        meta = json.load(f)
+    sdir = os.path.join(_wf_dir(workflow_id), "steps")
+    steps = sorted(
+        s[:-4] for s in os.listdir(sdir) if s.endswith(".pkl")
+    ) if os.path.isdir(sdir) else []
+    meta["checkpointed_steps"] = steps
+    meta["status"] = get_status(workflow_id).value
+    return meta
+
+
+# --------------------------------------------------------------------------
+# events (reference: python/ray/workflow/event_listener.py + api.py
+# wait_for_event/sleep — an event step completes when the listener's poll
+# returns; once checkpointed, the event is durable and never re-polled)
+# --------------------------------------------------------------------------
+
+
+class EventListener:
+    """Subclass and implement poll_for_event (blocking); return value
+    becomes the event step's result."""
+
+    def poll_for_event(self, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
+    """A DAG step that completes when `listener_cls().poll_for_event(...)`
+    returns. Durable: after the event fires once, its checkpoint satisfies
+    every replay."""
+    import cloudpickle
+
+    import ray_tpu
+
+    if not (isinstance(listener_cls, type) and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener subclass")
+    blob = cloudpickle.dumps(listener_cls)
+
+    @ray_tpu.remote
+    def _poll_event(cls_blob, a, kw):
+        import cloudpickle as _cp
+
+        listener = _cp.loads(cls_blob)()
+        return listener.poll_for_event(*a, **kw)
+
+    return _poll_event.bind(blob, args, kwargs)
+
+
+class _SleepUntil:
+    """Checkpointed timer marker: the EXECUTOR (driver) waits out the
+    deadline — a task busy-waiting it would pin a worker slot for the
+    whole duration (an hour-long sleep would occupy a CPU doing nothing)."""
+
+    def __init__(self, deadline: float):
+        self.deadline = deadline
+
+
+def sleep(duration: float) -> DAGNode:
+    """A durable timer step (reference: workflow.sleep): the DEADLINE is
+    computed and checkpointed when the step first runs, so a crash +
+    resume waits only the remainder. The wait itself happens driver-side
+    in the executor; no worker slot is held."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _sleep_step(d):
+        return _SleepUntil(time.time() + d)
+
+    return _sleep_step.bind(duration)

@@ -36,3 +36,14 @@ def ray_start_cluster():
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     yield cluster
     cluster.shutdown()
+
+
+# Hang forensics: RAY_TPU_TEST_DUMP_AFTER=<seconds> dumps every thread's
+# stack to stderr and exits — for chasing in-suite hangs that don't
+# reproduce standalone.
+import faulthandler  # noqa: E402
+
+faulthandler.enable()
+_dump_after = os.environ.get("RAY_TPU_TEST_DUMP_AFTER")
+if _dump_after:
+    faulthandler.dump_traceback_later(int(_dump_after), exit=True)

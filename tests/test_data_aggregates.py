@@ -1,0 +1,92 @@
+"""Dataset global aggregates + sampling/inspection utilities
+(reference: python/ray/data/dataset.py sum/mean/std, random_sample,
+split_at_indices, take_batch, to_pandas_refs, iter_tf_batches)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_global_aggregates_columnar(cluster):
+    ds = data.range(100)  # rows are {"id": i} or ints depending on source
+    row = ds.take(1)[0]
+    on = "id" if isinstance(row, dict) else None
+    assert ds.sum(on) == sum(range(100))
+    assert ds.min(on) == 0
+    assert ds.max(on) == 99
+    assert ds.mean(on) == pytest.approx(49.5)
+    assert ds.std(on) == pytest.approx(np.std(np.arange(100), ddof=1))
+
+
+def test_aggregates_empty(cluster):
+    ds = data.from_items([])
+    assert ds.sum() is None
+    assert ds.mean() is None
+    assert ds.min() is None
+
+
+def test_random_sample_fraction(cluster):
+    ds = data.from_items(list(range(2000)))
+    n = ds.random_sample(0.3, seed=7).count()
+    assert 400 < n < 800, n
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 2000
+    with pytest.raises(ValueError):
+        ds.random_sample(1.5)
+
+
+def test_randomize_block_order(cluster):
+    ds = data.from_items(list(range(100)), override_num_blocks=10)
+    shuffled = ds.randomize_block_order(seed=3)
+    assert sorted(shuffled.take_all()) == list(range(100))
+
+
+def test_split_at_indices_and_proportions(cluster):
+    ds = data.from_items(list(range(10)))
+    a, b, c = ds.split_at_indices([3, 7])
+    assert a.take_all() == [0, 1, 2]
+    assert b.take_all() == [3, 4, 5, 6]
+    assert c.take_all() == [7, 8, 9]
+    parts = ds.split_proportionately([0.2, 0.3])
+    assert [p.count() for p in parts] == [2, 3, 5]
+    with pytest.raises(ValueError):
+        ds.split_proportionately([0.7, 0.5])
+
+
+def test_take_batch_and_show(cluster, capsys):
+    ds = data.from_items(list(range(50)))
+    batch = ds.take_batch(10)
+    assert len(batch) == 10 or (hasattr(batch, "values") and True)
+    ds.show(3)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+
+
+def test_size_bytes_and_input_files(cluster):
+    ds = data.from_items([{"x": np.zeros(100, np.float64)} for _ in range(4)])
+    assert ds.size_bytes() >= 4 * 100 * 8
+    assert data.from_items([1]).input_files() == []
+
+
+def test_to_pandas_and_numpy_refs(cluster):
+    ds = data.from_items([{"a": i} for i in range(20)])
+    dfs = [ray_tpu.get(r, timeout=60) for r in ds.to_pandas_refs()]
+    assert sum(len(d) for d in dfs) == 20
+    arrs = [ray_tpu.get(r, timeout=60) for r in ds.to_numpy_refs()]
+    total = sum(len(a["a"]) if isinstance(a, dict) else len(a) for a in arrs)
+    assert total == 20
+
+
+def test_iter_tf_batches_numpy_fallback(cluster):
+    ds = data.from_items([{"x": float(i)} for i in range(30)])
+    batches = list(ds.iter_tf_batches(batch_size=16))
+    assert sum(len(b["x"]) for b in batches) == 30

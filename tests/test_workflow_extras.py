@@ -1,0 +1,96 @@
+"""Workflow cancel/resume_all/metadata/continuation/events (reference:
+python/ray/workflow/api.py cancel, resume_all, get_metadata, continuation,
+wait_for_event, sleep + event_listener.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def wf_cluster(tmp_path):
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    workflow.init(str(tmp_path / "wf"))
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_cancel_midrun(wf_cluster):
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(3.0)
+        return x
+
+    dag = add.bind(slow.bind(1), slow.bind(2))
+    fut = workflow.run_async(dag, workflow_id="wf_cancel")
+    time.sleep(0.5)
+    workflow.cancel("wf_cancel")
+    with pytest.raises(workflow.WorkflowCancellationError):
+        fut.result(timeout=60)
+    assert workflow.get_status("wf_cancel") == workflow.WorkflowStatus.CANCELED
+    # checkpoints survive; resume completes the remainder
+    assert workflow.resume("wf_cancel") == 3
+
+
+def test_get_metadata_and_resume_all(wf_cluster):
+    workflow.run(add.bind(2, 3), workflow_id="wf_meta")
+    meta = workflow.get_metadata("wf_meta")
+    assert meta["status"] == "SUCCESSFUL"
+    assert meta["checkpointed_steps"]
+    assert workflow.resume_all() == []  # nothing resumable
+
+
+def test_continuation_tail_call(wf_cluster):
+    @ray_tpu.remote
+    def fib_step(a, b, n):
+        if n <= 0:
+            return a
+        return workflow.continuation(fib_step.bind(b, a + b, n - 1))
+
+    # fib via durable tail-recursion: 0 1 1 2 3 5 8
+    assert workflow.run(fib_step.bind(0, 1, 6), workflow_id="wf_fib") == 8
+    meta = workflow.get_metadata("wf_fib")
+    assert len(meta["checkpointed_steps"]) > 6  # one chain link per splice
+
+
+def test_sleep_durable_deadline(wf_cluster):
+    t0 = time.perf_counter()
+    workflow.run(workflow.sleep(1.0), workflow_id="wf_sleep")
+    assert time.perf_counter() - t0 >= 1.0
+    # replay is instant: the deadline + wait are checkpointed
+    t0 = time.perf_counter()
+    workflow.resume("wf_sleep")
+    assert time.perf_counter() - t0 < 0.8
+
+
+def test_wait_for_event(wf_cluster, tmp_path):
+    flag = str(tmp_path / "event.flag")
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            while not os.path.exists(path):
+                time.sleep(0.1)
+            with open(path) as f:
+                return f.read()
+
+    dag = add.bind(workflow.wait_for_event(FileEvent, flag), " world")
+    fut = workflow.run_async(dag, workflow_id="wf_event")
+    time.sleep(0.5)
+    assert workflow.get_status("wf_event") == workflow.WorkflowStatus.RUNNING
+    with open(flag, "w") as f:
+        f.write("hello")
+    assert fut.result(timeout=60) == "hello world"
+
+
+def test_wait_for_event_type_check(wf_cluster):
+    with pytest.raises(TypeError):
+        workflow.wait_for_event(object)
