@@ -17,6 +17,7 @@ import collections
 import hashlib
 import logging
 import os
+import sys
 import threading
 import time
 import traceback
@@ -333,6 +334,14 @@ class _ActorChannel:
                 await self._fail_returns(spec, f"bad reply {list(reply)}")
                 return
             envs = reply["results"]
+            if len(envs) != len(spec["return_ids"]):
+                settle()
+                await self._fail_returns(
+                    spec,
+                    f"actor returned {len(envs)} results for "
+                    f"{len(spec['return_ids'])} return ids",
+                )
+                return
             settle()  # BEFORE caching: caching wakes the caller (see above)
             for oid, env in zip(spec["return_ids"], envs):
                 self.worker._cache_local_object(oid, env)
@@ -345,6 +354,9 @@ class _ActorChannel:
                 self.worker._release_pending(spec["return_ids"])
         finally:
             settle()
+            # HANG-PROOFING: as in _TaskChannel._finish — any waiter a
+            # missed settle left parked flips to the head-fetch route
+            self.worker._release_pending(spec["return_ids"])
             # deps stay pinned until the actor has consumed (or we failed)
             await self._release_deps(spec)
 
@@ -745,6 +757,15 @@ class _TaskChannel:
             if "results" not in reply:
                 await self._fail_returns(spec, f"bad reply {list(reply)}")
                 return
+            if len(reply["results"]) != len(spec["return_ids"]):
+                # zip() would silently drop the unmatched ids and leave
+                # their local waiters parked forever
+                await self._fail_returns(
+                    spec,
+                    f"worker returned {len(reply['results'])} results for "
+                    f"{len(spec['return_ids'])} return ids",
+                )
+                return
             for oid, env in zip(spec["return_ids"], reply["results"]):
                 self.worker._cache_local_object(oid, env)
                 self.worker._enqueue_put(oid, env)
@@ -764,6 +785,13 @@ class _TaskChannel:
                 # too-late cancel doesn't linger (a requeued retry keeps
                 # it — the re-dispatch check consumes it)
                 self._cancelled_tids.pop(spec["task_id"], None)
+                # HANG-PROOFING: no local waiter may stay parked after a
+                # spec's terminal processing. Every success/failure path
+                # above settles the events — but if any path ever misses
+                # one (the class of bug behind a once-in-ten-runs stuck
+                # get()), flip the waiter to the head-fetch route (the
+                # results were forwarded there) instead of hanging forever
+                self.worker._release_pending(spec["return_ids"])
             lease.last_used = asyncio.get_running_loop().time()
             self._wake.set()  # the dispatcher may be waiting for a free lease
             if not requeued:
@@ -979,6 +1007,10 @@ class Worker:
         # lands locally (get() waits here instead of round-tripping the head)
         self._local_pending: Dict[str, threading.Event] = {}
         self._local_lock = threading.Lock()
+        # refs whose __del__ fired: processed by _drain_dead_refs from
+        # normal contexts (a GC-time __del__ may run while ITS OWN thread
+        # holds the locks above — appending to a deque is lock-free)
+        self._dead_refs: collections.deque = collections.deque()
         # pubsub: channel -> callbacks invoked on pushed messages
         # (reference: src/ray/pubsub subscriber.h:329); one dispatcher
         # thread drains a queue so callbacks run in publish order
@@ -1189,6 +1221,8 @@ class Worker:
         return reply["seq"], reply["data"]
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        if self._dead_refs:
+            self._drain_dead_refs()
         if not self.conn or self.conn.closed:
             # a remote driver whose head connection dropped (head crash +
             # restart-from-snapshot) re-registers at the same address
@@ -1316,6 +1350,9 @@ class Worker:
             while idle_ticks < 12:  # ~100ms of quiet, then stand down
                 await asyncio.sleep(0.008)
                 did = False
+                if self._dead_refs:
+                    self._drain_dead_refs()
+                    did = True
                 now = time.monotonic()
                 for ch in list(self._actor_channels.values()):
                     if ch.flush_stale_stash(now):
@@ -1338,13 +1375,15 @@ class Worker:
                     pending = bool(
                         self._put_batch or self._record_batch or self._ref_batch
                     )
-                if pending or any(
+                if pending or self._dead_refs or any(
                     ch.stashed is not None
                     for ch in self._actor_channels.values()
                 ):
                     self._ensure_sweeper()
 
     async def _flush_batches(self) -> None:
+        if self._dead_refs:
+            self._drain_dead_refs()
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
@@ -1475,6 +1514,8 @@ class Worker:
         send side and in the head's handler dispatch, so a later request()
         from this process observes its effects (the reference gets the same
         property from gRPC in-order delivery per channel)."""
+        if self._dead_refs:
+            self._drain_dead_refs()
         if self.conn is None or self.conn.closed or self.io is None:
             raise exceptions.RayTpuError("ray_tpu is not connected (call ray_tpu.init())")
         self.io.post(_swallow_conn_errors(self.conn.send(msg)))
@@ -1566,11 +1607,36 @@ class Worker:
             self.send({"t": "add_refs", "counts": {object_id: 1}})
 
     def remove_object_ref(self, object_id: str, escaped: bool = True):
-        with self._local_lock:
-            self._local_objects.pop(object_id, None)
-        if self.connected:
-            # batched: ObjectRef.__del__ fires once per call in steady
-            # state, and a per-del io-loop wake costs more than the call
+        """Called from ObjectRef.__del__ — which the GC can run at ANY
+        allocation point, INCLUDING while this very thread already holds
+        _local_lock or _batch_lock (observed: submit_task's Event()
+        allocation collected a dead ref and self-deadlocked on
+        _local_lock). Therefore this method takes NO locks: it parks the
+        id on a lock-free deque that normal (non-__del__) contexts
+        drain. _ensure_sweeper is flag-check + call_soon_threadsafe —
+        itself lock-free — so a quiescent process still gets drained."""
+        self._dead_refs.append((object_id, escaped))
+        if self.connected and self.io is not None:
+            try:
+                self._ensure_sweeper()
+            except Exception:
+                pass
+
+    def _drain_dead_refs(self) -> None:
+        """Process refs whose __del__ parked them (regular calling context:
+        locks are safe here). Mirrors the old inline remove logic."""
+        drained, n = False, 0
+        while True:
+            try:
+                object_id, escaped = self._dead_refs.popleft()
+            except IndexError:
+                break
+            drained = True
+            with self._local_lock:
+                self._local_objects.pop(object_id, None)
+            if not self.connected:
+                continue
+            # batched: a per-del io-loop wake costs more than the call
             with self._batch_lock:
                 if not escaped and object_id in self._put_batch:
                     # the ref died before its result forward flushed AND was
@@ -1578,11 +1644,15 @@ class Worker:
                     # put (+1) and this remove (-1) cancel — drop BOTH and
                     # the head never hears about the object at all.
                     del self._put_batch[object_id]
-                    return
+                    continue
                 self._ref_batch[object_id] = self._ref_batch.get(object_id, 0) + 1
                 n = len(self._ref_batch)
+        if drained and n and self.connected:
             if self.io is not None and threading.current_thread() is self.io.thread:
-                self._schedule_flush(n)
+                try:
+                    self._schedule_flush(n)
+                except Exception:
+                    self._ensure_sweeper()
             else:
                 self._ensure_sweeper()
 
@@ -1752,7 +1822,43 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         for i, ev in pending:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            if not ev.wait(remaining):
+            if os.environ.get("RAY_TPU_GET_HANG_DEBUG"):
+                # forensics mode: periodically report which oid a stuck
+                # get() waits on and the local bookkeeping around it
+                waited = 0.0
+                while not ev.wait(
+                    20.0 if remaining is None else min(20.0, max(remaining - waited, 0.01))
+                ):
+                    waited += 20.0
+                    with self._local_lock:
+                        cur = self._local_pending.get(ref_list[i].id)
+                    # raw stderr: pytest's logging plugin would swallow a
+                    # logger record even under -s
+                    chans = []
+                    for key, ch in list(self._task_channels.items()):
+                        try:
+                            chans.append(
+                                f"{key}: q={ch.queue.qsize()} resolving={sorted(ch._resolving)} "
+                                f"acquiring={ch._acquiring} leases="
+                                + str([
+                                    (l.worker_id, l.inflight, sorted(l.inflight_tids))
+                                    for l in ch.leases
+                                ])
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            chans.append(f"{key}: <{e!r}>")
+                    print(
+                        f"get() stuck {waited:.0f}s on {ref_list[i].id}: "
+                        f"cached={ref_list[i].id in self._local_objects} "
+                        f"pending_event={cur is not None} same_event={cur is ev}\n"
+                        f"  channels: {chans}",
+                        file=sys.__stderr__, flush=True,
+                    )
+                    if remaining is not None and waited >= remaining:
+                        raise exceptions.GetTimeoutError(
+                            f"Get timed out after {timeout}s waiting for {ref_list[i].id}"
+                        )
+            elif not ev.wait(remaining):
                 raise exceptions.GetTimeoutError(
                     f"Get timed out after {timeout}s waiting for {ref_list[i].id}"
                 )

@@ -27,6 +27,8 @@ from .datasource import (  # noqa: F401
 )
 from .expressions import Expr, col  # noqa: F401
 from .grouped_data import GroupedData  # noqa: F401
+from . import aggregate  # noqa: F401
+from .aggregate import AggregateFn  # noqa: F401
 
 range = range_  # noqa: A001 — mirror ray.data.range
 

@@ -1,0 +1,124 @@
+"""Aggregation descriptors (reference: python/ray/data/aggregate.py —
+AggregateFn + the Count/Sum/Min/Max/Mean/Std/AbsMax convenience classes,
+consumed by Dataset.aggregate and GroupedData.aggregate).
+
+Two tiers:
+  - Named classes (Count/Sum/Min/Max/Mean/Std/AbsMax) compile to the
+    exchange kernel's native spec tuples — the two-stage distributed group aggregate stays fully
+    vectorized.
+  - AggregateFn (init/accumulate_row/merge/finalize) is the escape hatch
+    for arbitrary reductions; it rides the group_map path (the fold runs
+    per group on the reduce side).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+def _numpy_aggregate(kind: str, values) -> Any:
+    """One group's native aggregation over a value sequence (the mixed
+    AggregateFn+native fold path; the pure-native path stays on the
+    vectorized exchange kernel)."""
+    import numpy as np
+
+    if kind == "count":
+        return len(values)
+    v = np.asarray(values, dtype=np.float64)
+    if kind == "std":
+        # match the exchange kernel's singleton clamp (std of one value is
+        # 0.0, not NaN) so the answer doesn't depend on which path ran
+        return float(np.std(v, ddof=1)) if v.size > 1 else 0.0
+    return {"sum": np.sum, "min": np.min, "max": np.max, "mean": np.mean}[kind](v)
+
+
+class AggregateFn:
+    """User-defined aggregation (reference: aggregate.py AggregateFn).
+
+    init(key) -> accumulator; accumulate_row(acc, row) -> acc;
+    merge(acc1, acc2) -> acc; finalize(acc) -> result.
+    """
+
+    def __init__(
+        self,
+        init: Callable[[Any], Any],
+        accumulate_row: Callable[[Any, Any], Any],
+        merge: Callable[[Any, Any], Any],
+        finalize: Optional[Callable[[Any], Any]] = None,
+        name: str = "aggregate",
+    ):
+        self.init = init
+        self.accumulate_row = accumulate_row
+        self.merge = merge
+        self.finalize = finalize or (lambda a: a)
+        self.name = name
+
+    def _fold_rows(self, key_value, rows):
+        acc = self.init(key_value)
+        for row in rows:
+            acc = self.accumulate_row(acc, row)
+        return self.finalize(acc)
+
+
+class _NativeAgg:
+    """Base for aggregations the exchange kernel computes vectorized."""
+
+    kind: str = ""
+
+    def __init__(self, on: Optional[str] = None, alias_name: Optional[str] = None):
+        self.on = on
+        self.name = alias_name or (f"{self.kind}({on})" if on else f"{self.kind}()")
+
+    def _spec(self):
+        return (self.on, self.kind, self.name)
+
+
+class Count(_NativeAgg):
+    kind = "count"
+
+
+class Sum(_NativeAgg):
+    kind = "sum"
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(on, alias_name)
+
+
+class Min(_NativeAgg):
+    kind = "min"
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(on, alias_name)
+
+
+class Max(_NativeAgg):
+    kind = "max"
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(on, alias_name)
+
+
+class Mean(_NativeAgg):
+    kind = "mean"
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(on, alias_name)
+
+
+class Std(_NativeAgg):
+    kind = "std"
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(on, alias_name)
+
+
+class AbsMax(AggregateFn):
+    """max(|x|) — no native kernel kind; rides the generic fold."""
+
+    def __init__(self, on: str, alias_name: Optional[str] = None):
+        super().__init__(
+            init=lambda k: 0.0,
+            accumulate_row=lambda a, row: max(a, abs(row[on])),
+            merge=lambda a, b: max(a, b),
+            name=alias_name or f"abs_max({on})",
+        )

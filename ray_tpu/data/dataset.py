@@ -976,16 +976,19 @@ class Dataset:
             return np.asarray([r[on] for r in block])
         return np.asarray(block)
 
+    @staticmethod
+    def _block_partial(v: np.ndarray):
+        """(n, sum, mean, M2, min, max) for one block's values. mean/M2
+        feed the Chan/Welford merge — a naive global sum-of-squares
+        catastrophically cancels when |mean| >> spread."""
+        m = v.mean()
+        return (v.size, v.sum(), m, ((v - m) ** 2).sum(), v.min(), v.max())
+
     def _agg_partials(self, on: Optional[str]):
-        """Yield (n, sum, mean, M2, min, max) per block; empty blocks skip.
-        mean/M2 feed the Chan/Welford merge in std() — a naive global
-        sum-of-squares catastrophically cancels when |mean| >> spread."""
         for block in self._iter_computed_blocks():
             if _block_num_rows(block) == 0:
                 continue
-            v = self._column_values(block, on).astype(np.float64)
-            m = v.mean()
-            yield (v.size, v.sum(), m, ((v - m) ** 2).sum(), v.min(), v.max())
+            yield self._block_partial(self._column_values(block, on).astype(np.float64))
 
     def sum(self, on: Optional[str] = None):
         total, seen = 0.0, False
@@ -1013,10 +1016,12 @@ class Dataset:
             s_total += s
         return s_total / n_total if n_total else None
 
-    def std(self, on: Optional[str] = None, ddof: int = 1):
-        # Chan's parallel variance merge over per-block (n, mean, M2)
+    @staticmethod
+    def _chan_merge(partials):
+        """Combine per-block (n, sum, mean, M2, min, max) partials into a
+        global (n, mean, M2) via Chan's parallel variance algorithm."""
         n_a, mean_a, m2_a = 0, 0.0, 0.0
-        for n, _, mean_b, m2_b, _, _ in self._agg_partials(on):
+        for n, _, mean_b, m2_b, _, _ in partials:
             if n_a == 0:
                 n_a, mean_a, m2_a = n, mean_b, m2_b
                 continue
@@ -1025,9 +1030,89 @@ class Dataset:
             m2_a += m2_b + delta * delta * n_a * n / n_ab
             mean_a += delta * n / n_ab
             n_a = n_ab
+        return n_a, mean_a, m2_a
+
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        n_a, _, m2_a = self._chan_merge(self._agg_partials(on))
         if n_a <= ddof:
             return None
         return float(np.sqrt(m2_a / (n_a - ddof)))
+
+    def aggregate(self, *aggs):
+        """Whole-dataset aggregation (reference: dataset.py aggregate):
+        one global group; returns a result dict keyed by aggregation
+        name. Native descriptors reuse the streaming partial aggregators;
+        AggregateFns fold rows driver-side. The pipeline materializes once
+        so multiple descriptors don't recompute it."""
+        import functools
+
+        from .aggregate import AggregateFn, _NativeAgg
+
+        if not aggs:
+            raise ValueError("aggregate() requires at least one descriptor")
+        bad = [a for a in aggs if not isinstance(a, (AggregateFn, _NativeAgg))]
+        if bad:
+            raise TypeError(f"not aggregation descriptors: {bad}")
+        names = [a.name for a in aggs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate aggregation names: {sorted(names)}")
+        native = [a for a in aggs if isinstance(a, _NativeAgg)]
+        fn_aggs = [a for a in aggs if isinstance(a, AggregateFn)]
+        # ONE streaming pass over the blocks: per-column (n, sum, mean, M2,
+        # min, max) partials feed every native descriptor; AggregateFns
+        # fold per block and merge across blocks (the one place merge()
+        # semantics genuinely run)
+        col_partials: Dict[Optional[str], list] = {a.on: [] for a in native}
+        fn_accs: Dict[int, list] = {id(a): [] for a in fn_aggs}
+        needs_values = {a.on for a in native if a.kind != "count"}
+        for block in self._iter_computed_blocks():
+            if _block_num_rows(block) == 0:
+                continue
+            for on in col_partials:
+                if on not in needs_values:
+                    # count-only column (e.g. Count() with on=None): row
+                    # counts suffice, and dict rows have no float cast
+                    col_partials[on].append(
+                        (_block_num_rows(block), 0.0, 0.0, 0.0, None, None)
+                    )
+                    continue
+                col_partials[on].append(
+                    self._block_partial(self._column_values(block, on).astype(np.float64))
+                )
+            if fn_aggs:
+                rows = list(_block_to_rows(block))
+                for a in fn_aggs:
+                    acc = a.init(None)
+                    for row in rows:
+                        acc = a.accumulate_row(acc, row)
+                    fn_accs[id(a)].append(acc)
+        out: Dict[str, Any] = {}
+        for a in native:
+            parts = col_partials[a.on]
+            if a.kind == "count":
+                out[a.name] = builtins.sum(p[0] for p in parts)
+            elif not parts:
+                out[a.name] = None
+            elif a.kind == "sum":
+                out[a.name] = builtins.sum(p[1] for p in parts)
+            elif a.kind == "min":
+                out[a.name] = builtins.min(p[4] for p in parts)
+            elif a.kind == "max":
+                out[a.name] = builtins.max(p[5] for p in parts)
+            elif a.kind == "mean":
+                out[a.name] = builtins.sum(p[1] for p in parts) / builtins.sum(
+                    p[0] for p in parts
+                )
+            elif a.kind == "std":
+                n_a, _, m2_a = self._chan_merge(parts)
+                out[a.name] = (
+                    float(np.sqrt(m2_a / (n_a - 1))) if n_a > 1 else None
+                )
+        for a in fn_aggs:
+            accs = fn_accs[id(a)]
+            acc = functools.reduce(a.merge, accs) if accs else a.init(None)
+            out[a.name] = a.finalize(acc)
+        return out or None
 
     # ---- sampling / ordering ----
 

@@ -37,9 +37,53 @@ class GroupedData:
     def std(self, on: str):
         return self._run([(on, "std", f"std({on})")])
 
-    def aggregate(self, **named_specs: tuple):
-        """aggregate(total=("x", "sum"), n=(None, "count"))"""
-        specs = [(col, agg, out) for out, (col, agg) in named_specs.items()]
+    def aggregate(self, *aggs, **named_specs: tuple):
+        """Two call shapes (reference: grouped_data.py aggregate):
+          aggregate(Sum("x"), Count())          — aggregate descriptors
+          aggregate(total=("x", "sum"))          — named spec tuples
+        Native descriptors compile to vectorized exchange specs; an
+        AggregateFn folds per group on the reduce side via map_groups."""
+        from .aggregate import AggregateFn, _NativeAgg
+
+        fn_aggs = [a for a in aggs if isinstance(a, AggregateFn)]
+        native = [a for a in aggs if isinstance(a, _NativeAgg)]
+        bad = [a for a in aggs if not isinstance(a, (AggregateFn, _NativeAgg))]
+        if bad:
+            raise TypeError(f"not aggregation descriptors: {bad}")
+        out_names = [a.name for a in (*fn_aggs, *native)] + list(named_specs)
+        if self._key is not None and self._key in out_names:
+            raise ValueError(
+                f"aggregation name {self._key!r} collides with the groupby key"
+            )
+        if len(set(out_names)) != len(out_names):
+            raise ValueError(f"duplicate aggregation names: {sorted(out_names)}")
+        if fn_aggs:
+            # AggregateFns fold per group via map_groups; native descriptors
+            # in the SAME call compute inside that fold too (numpy over the
+            # group's columns) so mixing works — the fully-native call below
+            # keeps the vectorized two-stage exchange path
+            key = self._key
+            native_np = {a.name: (a.on, a.kind) for a in native}
+            # named spec tuples compute in the fold too when mixed with
+            # AggregateFns (they must not silently vanish)
+            native_np.update({out: (col, agg) for out, (col, agg) in named_specs.items()})
+
+            def _fold(group_block):
+                from .aggregate import _numpy_aggregate
+                from .dataset import _block_to_rows
+
+                rows = list(_block_to_rows(group_block))
+                kv = rows[0][key] if (key is not None and rows) else None
+                out = {key: kv} if key is not None else {}
+                for name, (on, kind) in native_np.items():
+                    out[name] = _numpy_aggregate(kind, [r[on] for r in rows] if on else rows)
+                for a in fn_aggs:
+                    out[a.name] = a._fold_rows(kv, rows)
+                return [out]
+
+            return self.map_groups(_fold)
+        specs = [a._spec() for a in native]
+        specs += [(col, agg, out) for out, (col, agg) in named_specs.items()]
         return self._run(specs)
 
     def map_groups(self, fn: Callable):

@@ -39,6 +39,7 @@ from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay_buffer import ReplayBuffer  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner  # noqa: F401
+from .ddpg import DDPG, DDPGConfig, DDPGLearner  # noqa: F401
 from .td3 import TD3, TD3Config, TD3Learner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401
 from . import offline  # noqa: F401,E402

@@ -182,6 +182,7 @@ class TD3Learner(Learner):
 
 class TD3(Algorithm):
     _config_class = TD3Config
+    _learner_class = TD3Learner  # hook: DDPG swaps in its single-critic losses
 
     def _worker_cls(self):
         return _TD3Worker
@@ -204,8 +205,10 @@ class TD3(Algorithm):
         env.close()
         self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
 
+        learner_cls = self._learner_class
+
         def factory():
-            return TD3Learner(
+            return learner_cls(
                 obs_dim=obs_dim,
                 act_dim=act_dim,
                 hidden=tuple(cfg.model.get("hidden", (256, 256))),

@@ -47,3 +47,7 @@ faulthandler.enable()
 _dump_after = os.environ.get("RAY_TPU_TEST_DUMP_AFTER")
 if _dump_after:
     faulthandler.dump_traceback_later(int(_dump_after), exit=True)
+import signal  # noqa: E402
+
+if hasattr(signal, "SIGUSR1"):
+    faulthandler.register(signal.SIGUSR1, all_threads=True)

@@ -90,3 +90,69 @@ def test_iter_tf_batches_numpy_fallback(cluster):
     ds = data.from_items([{"x": float(i)} for i in range(30)])
     batches = list(ds.iter_tf_batches(batch_size=16))
     assert sum(len(b["x"]) for b in batches) == 30
+
+
+def test_aggregate_descriptor_classes(cluster):
+    from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+
+    ds = data.from_items(
+        [{"g": i % 2, "v": float(i)} for i in range(100)]
+    )
+    rows = ds.groupby("g").aggregate(Count(), Sum("v"), Mean("v"), Min("v"), Max("v")).take_all()
+    by_g = {r["g"]: r for r in rows}
+    assert by_g[0]["count()"] == 50 and by_g[1]["count()"] == 50
+    assert by_g[0]["sum(v)"] == sum(float(i) for i in range(0, 100, 2))
+    assert by_g[1]["min(v)"] == 1.0 and by_g[1]["max(v)"] == 99.0
+    # dataset-level aggregate: one global group
+    out = ds.aggregate(Sum("v", alias_name="total"), Count())
+    assert out["total"] == sum(range(100))
+    assert out["count()"] == 100
+    g_std = ds.groupby("g").aggregate(Std("v")).take_all()
+    assert all(r["std(v)"] > 0 for r in g_std)
+
+
+def test_aggregate_fn_custom_fold(cluster):
+    from ray_tpu.data.aggregate import AbsMax, AggregateFn
+
+    ds = data.from_items([{"g": i % 2, "v": float(i - 50)} for i in range(100)])
+    rng = ds.groupby("g").aggregate(
+        AggregateFn(
+            init=lambda k: (float("inf"), float("-inf")),
+            accumulate_row=lambda a, r: (min(a[0], r["v"]), max(a[1], r["v"])),
+            merge=lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+            finalize=lambda a: a[1] - a[0],
+            name="range",
+        )
+    ).take_all()
+    # g=0: v in {-50..48 even} -> 98; g=1: v in {-49..49 odd} -> 98
+    assert [r["range"] for r in rng] == [98.0, 98.0]
+    am = ds.aggregate(AbsMax("v"))
+    assert am["abs_max(v)"] == 50.0
+
+
+def test_aggregate_mixed_and_guards(cluster):
+    from ray_tpu.data.aggregate import AggregateFn, Count, Sum
+
+    ds = data.from_items([{"g": i % 2, "v": float(i)} for i in range(20)])
+    # native + AggregateFn in ONE grouped call: both compute per group
+    rows = ds.groupby("g").aggregate(
+        Sum("v"),
+        Count(),
+        AggregateFn(
+            init=lambda k: 0.0,
+            accumulate_row=lambda a, r: a + r["v"] * r["v"],
+            merge=lambda a, b: a + b,
+            name="sumsq",
+        ),
+    ).take_all()
+    by_g = {r["g"]: r for r in rows}
+    for g in (0, 1):
+        vals = [float(i) for i in range(20) if i % 2 == g]
+        assert by_g[g]["sum(v)"] == sum(vals)
+        assert by_g[g]["count()"] == 10
+        assert by_g[g]["sumsq"] == sum(v * v for v in vals)
+    with pytest.raises(TypeError):
+        ds.groupby("g").aggregate("not-an-agg")
+    # an aggregation named like the groupby key would clobber group identity
+    with pytest.raises(ValueError):
+        ds.groupby("g").aggregate(Sum("v", alias_name="g"))
