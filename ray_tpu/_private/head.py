@@ -322,6 +322,11 @@ class Head:
         self._head_node_id = "node-head"
         self.nodes[self._head_node_id] = NodeRecord(self._head_node_id, dict(head_node_resources))
         self._shutdown = False
+        # fire-and-forget control-plane coroutines (actor starts, actor-task
+        # runs, PG scheduling, dispatches). Tracked so stop() cancels them —
+        # an untracked pending task spews "Task was destroyed but it is
+        # pending!" at interpreter exit and buries real close regressions.
+        self._bg_tasks: Set[asyncio.Task] = set()
         self._max_task_workers: Dict[str, int] = {}
         self._spawning_task_workers: collections.Counter = collections.Counter()
         self._driver_conn: Optional[protocol.Connection] = None
@@ -363,6 +368,14 @@ class Head:
         # submitted jobs: submission_id -> record (entrypoint subprocess)
         self.jobs: Dict[str, dict] = {}
         self._prestart_tasks: List[asyncio.Task] = []
+
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        """create_task with shutdown bookkeeping: stop() cancels whatever is
+        still pending so nothing leaks past the event loop's lifetime."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -621,7 +634,7 @@ class Head:
             )
             self.placement_groups[pid] = rec
             # re-place on whatever capacity this cluster grows
-            asyncio.get_running_loop().create_task(self._schedule_pg(rec))
+            self._spawn_bg(self._schedule_pg(rec))
         logger.info(
             "restored head state from %s: %d kv namespaces, %d actors, %d jobs",
             path, len(state.get("kv", {})), len(state.get("actors", {})),
@@ -860,6 +873,15 @@ class Head:
             self._snapshot_task.cancel()
         for t in list(self._prestart_tasks):
             t.cancel()  # no fresh workers after the kill sweep below
+        # cancel fire-and-forget control-plane work (actor starts/calls,
+        # PG scheduling, dispatches) and let the cancellations settle —
+        # otherwise actor-heavy runs print "Task was destroyed but it is
+        # pending!" at interpreter exit
+        bg = [t for t in (self._bg_tasks | self._push_tasks) if not t.done()]
+        for t in bg:
+            t.cancel()
+        if bg:
+            await asyncio.gather(*bg, return_exceptions=True)
         for job in self.jobs.values():
             if job["status"] == "RUNNING":
                 job["status"] = "STOPPED"
@@ -1196,8 +1218,22 @@ class Head:
 
     async def _h_put_object(self, conn, msg):
         oid = msg["object_id"]
-        if msg.get("stream_of"):
-            self._stream_children.setdefault(msg["stream_of"], []).append(oid)
+        tid = msg.get("stream_of")
+        if tid is not None:
+            kids = self._stream_children.get(tid)
+            if kids is None:
+                # Late yield: it traveled on the worker's client conn while
+                # the completion reply rode the head->worker request conn, so
+                # the stream's terminal object was stored AND freed before
+                # this put arrived. Registering it now would re-create
+                # _stream_children for a dead stream and leak the baseline
+                # ref forever. Store the envelope (a consumer may hold its
+                # own borrow) but drop the baseline +1 immediately.
+                self.objects.put(oid, msg["envelope"])
+                self.objects.add_ref(oid, msg.get("initial_refs", 1))
+                self.objects.remove_ref(oid, 1)
+                return
+            kids.append(oid)
         self.objects.put(oid, msg["envelope"])
         self.objects.add_ref(oid, msg.get("initial_refs", 1))
         # direct-transport results carry the caller's +1 here; if the caller
@@ -1531,11 +1567,13 @@ class Head:
         self.tasks[spec["task_id"]] = rec
         if spec.get("streaming"):
             self._stream_completion[spec["return_ids"][0]] = spec["task_id"]
+            # pre-register the children list so a yield arriving AFTER the
+            # completion object was freed (different conn, no FIFO guarantee)
+            # is distinguishable from a live stream in _h_put_object
+            self._stream_children.setdefault(spec["task_id"], [])
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
-        rec._resolve_task = asyncio.get_running_loop().create_task(
-            self._resolve_and_enqueue(rec)
-        )
+        rec._resolve_task = self._spawn_bg(self._resolve_and_enqueue(rec))
 
     async def _resolve_and_enqueue(self, rec: TaskRecord):
         if rec.cancel_requested:
@@ -1644,7 +1682,7 @@ class Head:
         self.actors[aid] = rec
         for oid in spec.get("deps", []):
             self.objects.pin(oid)
-        asyncio.get_running_loop().create_task(self._start_actor(rec))
+        self._spawn_bg(self._start_actor(rec))
 
     async def _start_actor(self, rec: ActorRecord):
         if rec.state == "dead":
@@ -1729,7 +1767,7 @@ class Head:
         rec.state = "alive"
         backlog, rec.backlog = rec.backlog, []
         for call in backlog:
-            asyncio.get_running_loop().create_task(self._run_actor_task(rec, call))
+            self._spawn_bg(self._run_actor_task(rec, call))
 
     async def _h_submit_actor_task(self, conn, msg):
         spec = msg["spec"]
@@ -1753,7 +1791,7 @@ class Head:
         if rec.state in ("pending", "starting", "restarting"):
             rec.backlog.append(spec)
             return
-        asyncio.get_running_loop().create_task(self._run_actor_task(rec, spec))
+        self._spawn_bg(self._run_actor_task(rec, spec))
 
     async def _run_actor_task(self, rec: ActorRecord, spec: dict):
         from ..exceptions import ActorDiedError
@@ -1904,7 +1942,7 @@ class Head:
             ready_event=asyncio.Event(),
         )
         self.placement_groups[rec.pg_id] = rec
-        asyncio.get_running_loop().create_task(self._schedule_pg(rec))
+        self._spawn_bg(self._schedule_pg(rec))
 
     async def _schedule_pg(self, rec: PlacementGroupRecord):
         while rec.state == "pending" and not self._shutdown:
@@ -2285,16 +2323,22 @@ class Head:
                 # direct-pushed task that already finished — ask the worker
                 # whether it is actually executing this task before killing
                 # it (the probe itself async-cancels when it is)
-                running = True
+                running = "executing"
                 if w.conn is not None and not w.conn.closed:
                     try:
                         running = await w.conn.request(
                             {"t": "cancel_task", "task_id": tid}, timeout=5
                         )
                     except Exception:
-                        running = True  # conn broken: the kill is moot/safe
+                        running = "executing"  # conn broken: the kill is moot/safe
                 if not running:
                     return False
+                if running == "queued":
+                    # dispatched but never started: the worker flagged it
+                    # for drop-before-run — cancel took effect; killing the
+                    # worker would only murder whatever OTHER task is on
+                    # its executor thread
+                    return True
                 await self._kill_worker(w, reason=f"task {tid} force-cancelled")
             elif w.conn is not None and not w.conn.closed:
                 try:
@@ -2514,7 +2558,7 @@ class Head:
             "end_time": None,
             "metadata": msg.get("metadata") or {},
         }
-        asyncio.get_running_loop().create_task(self._watch_job(sid))
+        self._spawn_bg(self._watch_job(sid))
         return sid
 
     async def _watch_job(self, sid: str):
@@ -2562,7 +2606,7 @@ class Head:
         if job["status"] == "RUNNING":
             job["status"] = "STOPPED"
             self._terminate_job_proc(job["proc"])
-            asyncio.get_running_loop().create_task(self._escalate_kill(job["proc"]))
+            self._spawn_bg(self._escalate_kill(job["proc"]))
         return True
 
     async def _escalate_kill(self, proc, grace_s: float = 3.0):
@@ -2723,7 +2767,12 @@ class Head:
                 promoted_any = True
                 self._dispatch_on(head, nid)
             if not dq:
+                # deque gone (promoted out, or emptied purely by dropping
+                # cancelled records): the sig MUST unblock too, else new
+                # same-shape submits keep parking despite free capacity and
+                # only recover at the next health-valve tick
                 del self._parked[sig]
+                self._blocked_sigs.discard(sig)
             if promoted_any:
                 # unblock so new same-shape submits pump normally; a
                 # placement miss simply re-blocks. Whatever stays parked
@@ -2769,7 +2818,7 @@ class Head:
         both the pump and the parked-promotion path."""
         rec.node_id = nid
         rec.mark("scheduled")
-        asyncio.get_running_loop().create_task(self._dispatch_task(rec))
+        self._spawn_bg(self._dispatch_task(rec))
 
     async def _release_dispatch(self, rec: TaskRecord, w: Optional[WorkerRecord]):
         """Give back everything _dispatch_task holds: the node capacity
@@ -3085,7 +3134,7 @@ class Head:
                         rec.restarts_left -= 1
                     rec.state = "restarting"
                     await asyncio.sleep(cfg.actor_restart_delay_ms / 1000.0)
-                    asyncio.get_running_loop().create_task(self._start_actor(rec))
+                    self._spawn_bg(self._start_actor(rec))
                 else:
                     rec.state = "dead"
                     rec.death_reason = f"worker died ({reason})"

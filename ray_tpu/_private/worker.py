@@ -406,21 +406,21 @@ class _ActorChannel:
         forward so the worker raises in the executing thread (a call still
         queued worker-side is remembered and dropped before it runs)."""
         loop = asyncio.get_running_loop()
-        for spec in list(self.deque):
-            if spec is not None and spec.get("task_id") == tid:
-                try:
-                    self.deque.remove(spec)
-                except ValueError:
-                    continue
-                loop.create_task(self._cancel_spec(spec))
-                return True
+        if self._cancel_from_deque(tid, loop):
+            return True
         with self.worker._stash_lock:
             s = self.stashed if (
                 self.stashed is not None and self.stashed.get("task_id") == tid
             ) else None
-        if s is not None and self.claim_stash(s) is not None:
-            loop.create_task(self._cancel_spec(s))
-            return True
+        if s is not None:
+            if self.claim_stash(s) is not None:
+                loop.create_task(self._cancel_spec(s))
+                return True
+            # claim lost: the sweeper flushed the stash to the deque between
+            # our read and the claim — the spec is sitting in the queue now,
+            # so re-scan it or the cancel silently falls through every branch
+            if self._cancel_from_deque(tid, loop):
+                return True
         # only claim tids this channel actually sent: reporting True for a
         # foreign tid would stop Worker.cancel_task before the head sees it
         if (
@@ -432,6 +432,18 @@ class _ActorChannel:
                 self.conn.send({"t": "cancel_task", "task_id": tid})
             ))
             return True
+        return False
+
+    def _cancel_from_deque(self, tid: str, loop) -> bool:
+        """Drop + settle a call still queued caller-side, if present."""
+        for spec in list(self.deque):
+            if spec is not None and spec.get("task_id") == tid:
+                try:
+                    self.deque.remove(spec)
+                except ValueError:
+                    continue
+                loop.create_task(self._cancel_spec(spec))
+                return True
         return False
 
     async def _cancel_spec(self, spec: dict):

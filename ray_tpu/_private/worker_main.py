@@ -50,6 +50,12 @@ class WorkerServer:
         self._task_threads: dict = {}
         self._pending_cancels: "collections.OrderedDict" = collections.OrderedDict()
         self._cancel_lock = threading.Lock()
+        # task_ids dispatched to this worker but not yet (or currently)
+        # executing — lets _cancel answer True for a task queued behind
+        # another on the executor (it WILL be dropped) while still
+        # answering False for a task this worker has never heard of.
+        # Loop-thread only; no lock.
+        self._inflight: set = set()
 
     async def _start_direct_server(self) -> str:
         """Listen for direct caller->worker task pushes (reference:
@@ -211,11 +217,18 @@ class WorkerServer:
             ident = self._task_threads.get(task_id)
             if ident is None:
                 _flag_bounded(self._pending_cancels, task_id)
-                return False
+                # dispatched-but-not-started: the flag guarantees _execute
+                # drops it before user code runs — a successful cancel, but
+                # NOT an executing task: report "queued" so the head's
+                # force path counts it as done WITHOUT killing the worker
+                # (a kill would take down the unrelated task currently on
+                # the executor thread). Unknown tasks report False so the
+                # caller can chase elsewhere.
+                return "queued" if task_id in self._inflight else False
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
             )
-            return True
+            return "executing"
 
     @staticmethod
     def _cancelled_reply(task_id: str, return_ids):
@@ -302,6 +315,13 @@ class WorkerServer:
         return True
 
     async def _run_task(self, msg):
+        self._inflight.add(msg["task_id"])
+        try:
+            return await self._run_task_inner(msg)
+        finally:
+            self._inflight.discard(msg["task_id"])
+
+    async def _run_task_inner(self, msg):
         from ..util import tracing
 
         if "actor_id" in msg and msg.get("actor_id"):

@@ -8,6 +8,7 @@ workflow_executor.py + storage/ (every step output checkpointed).
 from __future__ import annotations
 
 import enum
+import hashlib
 import json
 import os
 import pickle
@@ -110,6 +111,14 @@ def _step_plan(dag: DAGNode) -> List[Tuple[str, DAGNode]]:
 
 
 def _step_path(wf: str, key: str) -> str:
+    # Splice namespaces concatenate (parent_key + "@" + ...), so a long
+    # continuation chain grows the key past the OS filename limit (255
+    # bytes/component). Compress deterministically: digest the old head,
+    # keep the recent tail readable. persist() and the resume loader both
+    # come through here, so the mapping is stable across runs.
+    if len(key) > 200:
+        digest = hashlib.sha1(key[:-120].encode()).hexdigest()[:20]
+        key = digest + "~" + key[-120:]
     return os.path.join(_wf_dir(wf), "steps", key + ".pkl")
 
 
@@ -122,10 +131,37 @@ def _cancel_requested(workflow_id: str) -> bool:
 
 
 def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
+    """Drive one DAG to completion under `prefix`-namespaced step keys,
+    then splice any Continuation chain the root produced."""
+    out, root_key = _run_dag_raw(workflow_id, dag, inputs, prefix)
+    return _splice_chain(workflow_id, out, prefix + root_key + "@")
+
+
+def _splice_chain(workflow_id: str, value: Any, pfx: str) -> Any:
+    """Resolve a tail chain of Continuations ITERATIVELY. Tail-call chains
+    (step returns continuation(dag) whose root step returns another
+    continuation, ...) are unbounded in the reference
+    (workflow/common.py continuation splicing); recursing one Python frame
+    per splice caps the chain at ~sys.getrecursionlimit()."""
+    while isinstance(value, Continuation):
+        value, root_key = _run_dag_raw(workflow_id, value.dag, ((), {}), pfx)
+        pfx = pfx + root_key + "@"
+        if len(pfx) > 200:
+            # keep the working prefix bounded too (not just the filename in
+            # _step_path): a 50k-link chain would otherwise do O(N^2)
+            # string/hash work. Deterministic, so resume replays the same
+            # compressed namespaces.
+            pfx = hashlib.sha1(pfx.encode()).hexdigest()[:20] + "@"
+    return value
+
+
+def _run_dag_raw(workflow_id: str, dag: DAGNode, inputs, prefix: str):
     """Drive one DAG to completion under `prefix`-namespaced step keys.
-    Steps already checkpointed load from disk; a step result that is a
-    Continuation splices its dag in (own key namespace) and yields that
-    dag's result instead."""
+    Steps already checkpointed load from disk; a NON-root step result that
+    is a Continuation splices its dag in (own key namespace) and yields
+    that dag's result instead. The root's result is returned RAW (possibly
+    a Continuation) with the root's step key, so _splice_chain can walk
+    tail chains without recursion."""
     import cloudpickle
 
     import ray_tpu
@@ -143,9 +179,11 @@ def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
             f.write(cloudpickle.dumps(value))
         os.replace(tmp, spath)
 
-    def settle(value: Any) -> Any:
+    def settle(node: DAGNode, value: Any) -> Any:
         """Timer markers wait out their deadline HERE on the driver (the
-        checkpoint keeps the raw marker, so resume waits the remainder)."""
+        checkpoint keeps the raw marker, so resume waits the remainder).
+        Non-root Continuations splice in place — their value is what the
+        continued dag produces; the root's stays raw for _splice_chain."""
         if isinstance(value, _SleepUntil):
             while True:
                 if _cancel_requested(workflow_id):
@@ -154,6 +192,10 @@ def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
                 if rem <= 0:
                     return value.deadline
                 time.sleep(min(1.0, rem))
+        if isinstance(value, Continuation) and node is not dag:
+            value = _splice_chain(
+                workflow_id, value, prefix + key_of[id(node)] + "@"
+            )
         return value
 
     plan = _step_plan(dag)
@@ -163,7 +205,7 @@ def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
         spath = _step_path(workflow_id, prefix + key)
         if os.path.exists(spath):
             with open(spath, "rb") as f:
-                results[id(node)] = settle(pickle.loads(f.read()))
+                results[id(node)] = settle(node, pickle.loads(f.read()))
         else:
             remaining.append(node)
 
@@ -212,15 +254,8 @@ def _run_dag(workflow_id: str, dag: DAGNode, inputs, prefix: str) -> Any:
             node = in_flight.pop(done[0])
             value = ray_tpu.get(done[0])
             persist(key_of[id(node)], value)
-            results[id(node)] = settle(value)
-    out = results[id(dag)]
-    if isinstance(out, Continuation):
-        # splice: the continued dag's steps checkpoint under the parent
-        # step's namespace, so resume() replays the whole chain
-        out = _run_dag(
-            workflow_id, out.dag, ((), {}), prefix + key_of[id(dag)] + "@"
-        )
-    return out
+            results[id(node)] = settle(node, value)
+    return results[id(dag)], key_of[id(dag)]
 
 
 def _execute_workflow(workflow_id: str) -> Any:

@@ -62,6 +62,41 @@ def test_continuation_tail_call(wf_cluster):
     assert len(meta["checkpointed_steps"]) > 6  # one chain link per splice
 
 
+def test_continuation_nonroot_step(wf_cluster):
+    """A NON-root step returning a Continuation splices in place — its
+    downstream consumer sees the continued dag's VALUE, not a Continuation
+    object (reference: workflow continuation splices at any step)."""
+
+    @ray_tpu.remote
+    def double(x):
+        return workflow.continuation(add.bind(x, x))
+
+    # add(double(5), 1): double's continuation must materialize to 10
+    dag = add.bind(double.bind(5), 1)
+    assert workflow.run(dag, workflow_id="wf_nonroot") == 11
+    # resume replays from checkpoints, splicing the stored Continuation again
+    assert workflow.resume("wf_nonroot") == 11
+
+
+def test_continuation_deep_chain_iterative(wf_cluster):
+    """Tail chains splice iteratively: a chain longer than a tiny recursion
+    limit must not blow the stack (one Python frame per splice would)."""
+    import sys
+
+    @ray_tpu.remote
+    def count_down(n):
+        if n <= 0:
+            return "done"
+        return workflow.continuation(count_down.bind(n - 1))
+
+    limit = sys.getrecursionlimit()
+    try:
+        sys.setrecursionlimit(220)  # far below 40 splices * frames-per-splice
+        assert workflow.run(count_down.bind(40), workflow_id="wf_chain") == "done"
+    finally:
+        sys.setrecursionlimit(limit)
+
+
 def test_sleep_durable_deadline(wf_cluster):
     t0 = time.perf_counter()
     workflow.run(workflow.sleep(1.0), workflow_id="wf_sleep")
