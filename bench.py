@@ -1,7 +1,19 @@
 """Benchmark: flagship-model training throughput on the local chip(s).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Three rows, run as separate child processes (the chip claim is exclusive
+per process, so each phase gets a fresh claim):
+  raw     — model/step/sharding stack driven directly (round-3 number)
+  trainer — the SAME config through the real framework: JaxTrainer actor
+            gang, session.report every step, Dataset.iter_device_batches
+            feeding the step (reference parity: BASELINE.json config #1
+            "GPT-2 125M single-host JaxTrainer")
+  hbm     — a ~1.15B-param config sized to fill one v5e's 16G HBM with
+            remat + flash (BASELINE.md 7B north star, scaled to one chip)
+
+Prints ONE JSON line; the trainer row is the headline metric, the others
+ride along as fields:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "raw": {...},
+   "hbm": {...}, "trainer_overhead_vs_raw_pct": N}
 
 vs_baseline is measured MFU / 0.45 — the BASELINE.json north-star target
 (the reference publishes no tokens/sec numbers; see BASELINE.md notes).
@@ -10,6 +22,7 @@ vs_baseline is measured MFU / 0.45 — the BASELINE.json north-star target
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -24,28 +37,120 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
+def _peak_flops_kind(kind: str) -> float:
     for k, v in PEAK_BF16_FLOPS.items():
         if kind.startswith(k):
             return v
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def main():
+def _peak_flops(device) -> float:
+    return _peak_flops_kind(getattr(device, "device_kind", "cpu"))
+
+
+def _tpu_configured() -> bool:
+    """A TPU is plumbed into this box (axon tunnel or real VM) AND the env
+    doesn't pin another platform. Deliberately does NOT touch jax: the
+    chip claim is exclusive per process, and the trainer driver must leave
+    it for the worker actor."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    import glob
+
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or bool(
+        glob.glob("/dev/accel*")
+    )
+
+
+# --------------------------------------------------------------------------
+# shared direct step loop (raw + hbm phases)
+# --------------------------------------------------------------------------
+
+
+def _mesh_and_rules(n_chips: int):
+    """Single chip: trivial dp mesh. Multi chip: shard params/opt-state over
+    the fsdp axis (ZeRO-3) — the batch rules spec is ('dp','fsdp') so the
+    batch shards there too. MeshSpec(dp=n) with fsdp rules would leave the
+    fsdp axis at size 1 and silently replicate everything."""
+    from ray_tpu.parallel import MeshSpec, PRESET_RULES, build_mesh
+
+    if n_chips == 1:
+        return build_mesh(MeshSpec(dp=1)), PRESET_RULES["dp"]
+    return build_mesh(MeshSpec(fsdp=n_chips)), PRESET_RULES["fsdp"]
+
+
+def _run_step_bench(tag, cfg, batch, seq, steps, opt):
+    """Compile + warm + time `steps` chained train steps; returns the stats
+    dict shared by the raw and hbm rows."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from ray_tpu.train.step import make_sharded_init, make_train_step
+
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    mesh, rules = _mesh_and_rules(n_chips)
+    init_fn, shardings = make_sharded_init(cfg, mesh, rules, opt)
+    state = init_fn(jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, rules, opt, shardings)
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq + 1)), jnp.int32
+        ),
+        "mask": jnp.ones((batch, seq + 1), jnp.int32),
+    }
+
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec_per_chip = batch * seq * steps / dt / n_chips
+    flops_per_token = cfg.flops_per_token() + cfg.attention_flops_per_token(seq)
+    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops(dev)
+    kind = getattr(dev, "device_kind", dev.platform)
+    print(
+        f"[bench:{tag}] dev={kind} chips={n_chips} "
+        f"model={cfg.d_model}x{cfg.n_layers} batch={batch} seq={seq} "
+        f"compile={compile_s:.1f}s step={dt / steps * 1000:.1f}ms "
+        f"loss={float(metrics['loss']):.3f} mfu={mfu:.3f}",
+        file=sys.stderr,
+    )
+    return {
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "device": kind,
+        "step_ms": round(dt / steps * 1000, 2),
+    }
+
+
+# --------------------------------------------------------------------------
+# raw mode — direct step loop (identical to the round-3 bench)
+# --------------------------------------------------------------------------
+
+
+def main_raw():
+    import dataclasses
+
+    import jax
+
     from ray_tpu.models import CONFIGS
-    from ray_tpu.parallel import MeshSpec, PRESET_RULES, build_mesh
-    from ray_tpu.train.step import default_optimizer, make_sharded_init, make_train_step
+    from ray_tpu.train.step import default_optimizer
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
-    n_chips = len(jax.devices())
-
-    import dataclasses
 
     if on_tpu:
         # Pallas flash attention (head-major layout, fused single-block
@@ -64,155 +169,347 @@ def main():
         cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
         batch, seq, steps = 8, 128, 5
 
-    mesh = build_mesh(MeshSpec(dp=n_chips))
-    rules = PRESET_RULES["dp"] if n_chips == 1 else PRESET_RULES["fsdp"]
+    row = _run_step_bench(
+        "raw", cfg, batch, seq, steps, default_optimizer(lr=1e-3, warmup=10)
+    )
+    row["metric"] = (
+        "gpt2_125m_train_tokens_per_sec_per_chip"
+        if on_tpu
+        else "tiny_train_tokens_per_sec_per_chip_cpu"
+    )
+    row["vs_baseline"] = round(row["mfu"] / 0.45, 4)
+    print(json.dumps(row))
+
+
+# --------------------------------------------------------------------------
+# hbm mode — HBM-limit single-chip config (~1.15B params, fp32 adam v)
+# --------------------------------------------------------------------------
+
+
+def main_hbm():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.train.step import default_optimizer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
+    n_chips = len(jax.devices())
+
+    if on_tpu:
+        cfg = dataclasses.replace(
+            CONFIGS["gpt_1b"],
+            attention="flash",
+            remat_policy="flash_qkv",
+            scan_layers=False,
+            loss_chunk=128,
+        )
+        # 6/chip is the largest per-chip batch that fits 16G (15.9G static
+        # allocation at 8); multi-chip scales it so dim 0 stays divisible
+        batch, seq, steps = 6 * n_chips, 1024, 8
+    else:
+        cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
+        batch, seq, steps = 8, 128, 3
+
+    # bf16 momentum: the ~1.15B fp32 params + fp32 adam v alone are ~9G;
+    # halving mu is what leaves room for grads + activations on 16G
+    opt = default_optimizer(lr=1e-4, warmup=10, mu_dtype=jnp.bfloat16)
+    row = _run_step_bench("hbm", cfg, batch, seq, steps, opt)
+    row["metric"] = (
+        "gpt_1b_hbm_limit_tokens_per_sec_per_chip" if on_tpu else "tiny_hbm_smoke_cpu"
+    )
+    row["vs_baseline"] = round(row["mfu"] / 0.40, 4)
+    row["params_b"] = round(cfg.num_params() / 1e9, 3)
+    print(json.dumps(row))
+
+
+# --------------------------------------------------------------------------
+# trainer mode — the framework in the measured loop
+# --------------------------------------------------------------------------
+
+
+def _trainer_train_fn(config):
+    """Runs INSIDE the TrainWorker actor (full-site interpreter: the PJRT
+    plugin registers there, and this process — not the driver — claims the
+    chip). Pulls device batches from the Dataset shard, reports every step
+    through session.report, and reports the measured throughput at the end."""
+    import dataclasses
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.parallel import MeshSpec, PRESET_RULES, build_mesh
+    from ray_tpu.train import session
+    from ray_tpu.train.step import default_optimizer, make_sharded_init, make_train_step
+
+    dev = jax.devices()[0]
+    cfg = CONFIGS[config["model"]]
+    if config["tpu"]:
+        cfg = dataclasses.replace(
+            cfg, attention="flash", remat_policy="flash_min", scan_layers=False
+        )
+    batch, seq = config["batch"], config["seq"]
+    steps, warmup = config["steps"], config["warmup"]
+
+    mesh = build_mesh(MeshSpec(dp=len(jax.devices())))
+    rules = PRESET_RULES["dp"]
     opt = default_optimizer(lr=1e-3, warmup=10)
     init_fn, shardings = make_sharded_init(cfg, mesh, rules, opt)
     state = init_fn(jax.random.PRNGKey(0))
     step = make_train_step(cfg, mesh, rules, opt, shardings)
 
-    rng = np.random.default_rng(0)
-    batch_data = {
-        "tokens": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, size=(batch, seq + 1)), jnp.int32
+    ds = session.get_dataset_shard("train")
+    it = ds.iter_device_batches(batch_size=batch, mesh=mesh, rules=rules, prefetch=2)
+
+    t_start = _time.perf_counter()
+    n_timed = 0
+    t0 = None
+    compile_s = None
+    for i, b in enumerate(it):
+        if i >= warmup + steps:
+            break
+        state, metrics = step(state, b)
+        if i < warmup:
+            # compile + cache-warm steps: sync so the timed window below
+            # contains ONLY steady-state step+feed work
+            jax.block_until_ready(metrics["loss"])
+            if i == 0:
+                compile_s = _time.perf_counter() - t_start
+            if i == warmup - 1:
+                t0 = _time.perf_counter()
+            continue
+        n_timed += 1
+        # per-step report through the real session plumbing — but nothing
+        # here touches device values (a float(loss) would sync the pipe)
+        session.report({"step": i})
+    jax.block_until_ready(metrics["loss"])
+    dt = _time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * n_timed / dt
+    session.report(
+        {
+            "final": True,
+            "tokens_per_sec": tokens_per_sec,
+            "steps_timed": n_timed,
+            "step_ms": dt / max(1, n_timed) * 1000.0,
+            "compile_s": compile_s,
+            "loss": float(metrics["loss"]),
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "n_devices": len(jax.devices()),
+        }
+    )
+    return "done"
+
+
+def main_trainer():
+    """Driver: builds the token Dataset, runs JaxTrainer over one TPU worker
+    actor, and computes MFU from the worker's reported throughput. The
+    driver itself never initializes a jax backend."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rdata
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    on_tpu = _tpu_configured()
+    if on_tpu:
+        model, batch, seq, steps, warmup = "gpt2_125m", 16, 1024, 30, 3
+    else:
+        model, batch, seq, steps, warmup = "tiny", 8, 128, 6, 2
+    vocab = CONFIGS[model].vocab_size
+
+    ray_tpu.init(num_cpus=4, num_tpus=1 if on_tpu else None)
+
+    n_rows = (steps + warmup + 6) * batch
+
+    def gen_tokens(blk):
+        n = len(blk["id"])
+        rng = np.random.default_rng(int(blk["id"][0]) + 1)
+        return {
+            "tokens": rng.integers(0, vocab, size=(n, seq + 1)).astype(np.int32),
+            "mask": np.ones((n, seq + 1), np.int32),
+        }
+
+    ds = rdata.range(n_rows, override_num_blocks=8).map_batches(
+        gen_tokens, batch_size=batch
+    )
+
+    trainer = JaxTrainer(
+        _trainer_train_fn,
+        train_loop_config={
+            "model": model, "tpu": on_tpu, "batch": batch, "seq": seq,
+            "steps": steps, "warmup": warmup,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=1,
+            resources_per_worker={"CPU": 1, "TPU": 1} if on_tpu else {"CPU": 1},
         ),
-        "mask": jnp.ones((batch, seq + 1), jnp.int32),
-    }
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    ray_tpu.shutdown()
+    if result.error is not None:
+        raise SystemExit(f"trainer bench failed: {result.error!r}")
 
-    # warmup (compile)
-    t0 = time.perf_counter()
-    state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
-    compile_s = time.perf_counter() - t0
-    state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
+    final = next(
+        (m for m in reversed(result.metrics_history) if m.get("final")), None
+    )
+    if final is None:
+        raise SystemExit("trainer bench: no final report")
+    per_step_reports = sum(1 for m in result.metrics_history if "step" in m)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * steps / dt
-    tokens_per_sec_per_chip = tokens_per_sec / n_chips
-
+    cfg = CONFIGS[model]
     flops_per_token = cfg.flops_per_token() + cfg.attention_flops_per_token(seq)
-    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops(dev)
-    vs_baseline = mfu / 0.45
+    tokens_per_sec_per_chip = final["tokens_per_sec"] / final["n_devices"]
+    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops_kind(
+        final["device_kind"]
+    )
 
     print(
-        f"[bench] dev={getattr(dev, 'device_kind', dev.platform)} chips={n_chips} "
-        f"model={cfg.d_model}x{cfg.n_layers} batch={batch} seq={seq} "
-        f"compile={compile_s:.1f}s step={dt / steps * 1000:.1f}ms "
-        f"loss={float(metrics['loss']):.3f} mfu={mfu:.3f}",
+        f"[bench:trainer] dev={final['device_kind']} model={model} "
+        f"batch={batch} seq={seq} compile={final['compile_s']:.1f}s "
+        f"step={final['step_ms']:.1f}ms loss={final['loss']:.3f} "
+        f"mfu={mfu:.3f} reports={per_step_reports}",
         file=sys.stderr,
     )
     print(
         json.dumps(
             {
-                "metric": "gpt2_125m_train_tokens_per_sec_per_chip"
+                "metric": "gpt2_125m_jaxtrainer_tokens_per_sec_per_chip"
                 if on_tpu
-                else "tiny_train_tokens_per_sec_per_chip_cpu",
+                else "tiny_jaxtrainer_tokens_per_sec_per_chip_cpu",
                 "value": round(tokens_per_sec_per_chip, 1),
                 "unit": "tokens/s/chip",
-                "vs_baseline": round(vs_baseline, 4),
+                "vs_baseline": round(mfu / 0.45, 4),
                 "mfu": round(mfu, 4),
-                "device": getattr(dev, "device_kind", dev.platform),
-                "step_ms": round(dt / steps * 1000, 2),
+                "device": final["device_kind"],
+                "step_ms": round(final["step_ms"], 2),
+                "session_reports": per_step_reports,
             }
         )
     )
 
 
-def _supervise() -> int:
-    """Run the real bench in a watched child. When the TPU tunnel is down,
-    the site hook's plugin registration blocks `import jax` forever — the
-    supervisor contains that hang, retries with a FRESH child (the tunnel
-    can recover between attempts), and only after every attempt fails swaps
-    in a CPU fallback (marked in the JSON). Healthy runs pay nothing extra:
-    the first child does all the work exactly once and its output is
-    forwarded verbatim."""
-    import os
+# --------------------------------------------------------------------------
+# supervisor
+# --------------------------------------------------------------------------
+
+
+def _run_child(cmd, child_env, timeout):
+    """Returns (rc|None, stdout, stderr); rc None = hung/timed out.
+
+    Own session + group-kill on timeout: a wedged child may have forked
+    helpers (tunnel processes) that inherit the pipes — killing only the
+    child would leave communicate() blocked short of EOF forever."""
+    import signal
     import subprocess
-    import time as _time
 
-    env = dict(os.environ, RAY_TPU_BENCH_CHILD="1")
-    # healthy TPU runs finish in ~90-130s (compile included); prolonged
-    # silence means the backend is wedged on a dead tunnel (observed: the
-    # device-claim leg hangs AFTER `import jax` succeeds). Err generous: a
-    # too-small value silently swaps in the CPU-fallback number.
-    tpu_timeout = float(os.environ.get("RAY_TPU_BENCH_TPU_TIMEOUT_S", "300"))
-    attempts = int(os.environ.get("RAY_TPU_BENCH_TPU_ATTEMPTS", "3"))
-    backoffs = [15.0, 30.0]  # between attempts; tunnel reacquisition is slow
-
-    def run_child(cmd, child_env, timeout):
-        """Returns (rc|None, stdout, stderr); rc None = hung/timed out.
-
-        Own session + group-kill on timeout: a wedged child may have forked
-        helpers (tunnel processes) that inherit the pipes — killing only the
-        child would leave communicate() blocked short of EOF forever."""
-        import signal
-
-        p = subprocess.Popen(
-            cmd, env=child_env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, start_new_session=True,
-        )
+    p = subprocess.Popen(
+        cmd, env=child_env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out or "", err or ""
+    except subprocess.TimeoutExpired:
         try:
-            out, err = p.communicate(timeout=timeout)
-            return p.returncode, out or "", err or ""
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except OSError:
-                p.kill()
-            try:
-                out, err = p.communicate(timeout=10)
-            except Exception:
-                out, err = "", ""
-            return None, out or "", err or ""
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            p.kill()
+        try:
+            out, err = p.communicate(timeout=10)
+        except Exception:
+            out, err = "", ""
+        return None, out or "", err or ""
 
+
+def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool):
+    """Run one bench phase in child processes until a JSON line lands.
+    Returns the parsed row (dict) or None. When the TPU tunnel is down the
+    site hook's plugin registration can block `import jax` forever — the
+    child-with-timeout contains that hang, and the tunnel can recover
+    between attempts."""
     me = os.path.abspath(__file__)
+    backoffs = [15.0, 30.0]
+    env = dict(os.environ, RAY_TPU_BENCH_CHILD=mode)
     for i in range(attempts):
-        t0 = _time.perf_counter()
-        rc, out, err = run_child([sys.executable, me], env, tpu_timeout)
-        dt = _time.perf_counter() - t0
-        if rc == 0 and out.strip():
-            if i:
-                print(f"[bench] TPU attempt {i + 1}/{attempts} succeeded "
-                      f"after earlier failures", file=sys.stderr)
+        t0 = time.perf_counter()
+        rc, out, err = _run_child([sys.executable, me], env, timeout)
+        dt = time.perf_counter() - t0
+        row = _last_json(out)
+        if rc == 0 and row is not None:
             sys.stderr.write(err)
-            sys.stdout.write(out)
-            return 0
+            return row
         why = "hung (timeout)" if rc is None else f"rc={rc}"
         tail = "\n".join(err.strip().splitlines()[-6:])
-        print(f"[bench] TPU attempt {i + 1}/{attempts} failed ({why}, "
+        print(f"[bench] {mode} attempt {i + 1}/{attempts} failed ({why}, "
               f"{dt:.0f}s){': ' + tail if tail else ''}", file=sys.stderr)
         if i < attempts - 1:
-            _time.sleep(backoffs[min(i, len(backoffs) - 1)])
-    # fall back even when the child RAN and failed (not just hangs): a dead
-    # tunnel can also surface as a fast nonzero exit (backend-unregistered
-    # raise), and an artifact with an explicit `_cpu` metric + the failure
-    # tail above beats no artifact at all. The metric name keeps a real TPU
-    # bench bug from masquerading as a TPU result.
-    print(f"[bench] TPU backend failed after {attempts} attempts; "
-          "CPU fallback", file=sys.stderr)
-    env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
+            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+    if not cpu_fallback:
+        return None
+    print(f"[bench] {mode}: TPU attempts exhausted; CPU fallback", file=sys.stderr)
     from ray_tpu._private.spawn import child_pythonpath
 
+    env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
     env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
-    rc, out, err = run_child(
-        [sys.executable, "-S", me], env, 600
-    )
+    rc, out, err = _run_child([sys.executable, "-S", me], env, 600)
     sys.stderr.write(err)
-    sys.stdout.write(out)
-    return rc if rc is not None else 1
+    return _last_json(out)
+
+
+def _last_json(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _supervise() -> int:
+    raw = _phase("raw", float(os.environ.get("RAY_TPU_BENCH_TPU_TIMEOUT_S", "300")),
+                 3, cpu_fallback=True)
+    trainer = _phase("trainer", 600, 2, cpu_fallback=True)
+    hbm = _phase("hbm", 600, 2, cpu_fallback=False)
+
+    if trainer is not None:
+        primary = dict(trainer)
+        if raw is not None:
+            primary["raw"] = raw
+            # only comparable when both phases ran on the same device — a
+            # CPU fallback on one side would publish a nonsense "overhead"
+            if raw.get("mfu") and raw.get("device") == trainer.get("device"):
+                primary["trainer_overhead_vs_raw_pct"] = round(
+                    (raw["mfu"] - trainer.get("mfu", 0.0)) / raw["mfu"] * 100, 2
+                )
+    elif raw is not None:
+        primary = dict(raw)
+        primary["trainer_row_missing"] = True
+    else:
+        print("[bench] no phase produced a result", file=sys.stderr)
+        return 1
+    if hbm is not None:
+        primary["hbm"] = hbm
+    print(json.dumps(primary))
+    return 0
 
 
 if __name__ == "__main__":
-    import os
-
-    if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
-        main()
+    mode = os.environ.get("RAY_TPU_BENCH_CHILD")
+    if mode == "raw" or mode == "1":  # "1" = old envvar spelling
+        main_raw()
+    elif mode == "trainer":
+        main_trainer()
+    elif mode == "hbm":
+        main_hbm()
     else:
         sys.exit(_supervise())

@@ -133,6 +133,14 @@ CONFIGS: Dict[str, TransformerConfig] = {
         vocab_size=50304, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
         d_head=64, d_ff=3072, max_seq_len=1024,
     ),
+    # ~1.15B params — the single-chip HBM-limit config: fp32 params/adam-v
+    # + bf16 momentum fill most of a v5e's 16G; flash attention +
+    # flash_qkv remat (mlp gate/up recomputed) + chunked loss keep
+    # activations/logits in budget. Measured 0.55 MFU at batch 6 on v5e.
+    "gpt_1b": TransformerConfig(
+        vocab_size=50304, d_model=2048, n_layers=14, n_heads=16, n_kv_heads=16,
+        d_head=128, d_ff=8192, max_seq_len=1024, loss_chunk=256,
+    ),
     # Llama-2 7B — the BASELINE.json north-star config
     "llama2_7b": TransformerConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
@@ -433,6 +441,14 @@ def make_forward(
             "flash_min": cp.save_only_these_names(
                 "flash_out", "flash_lse", "rope_q", "rope_k", "attn_v",
                 "mlp_gate", "mlp_up",
+            ),
+            # flash_min minus the mlp gate/up stacks — backward re-derives
+            # them (one matmul each from the saved layer input). At
+            # d_ff=8192 those two stacks are the LARGEST saved residuals
+            # (2 * B*S*d_ff bf16 per layer); trading ~8% more backward
+            # flops for that memory is what fits the ~1B HBM-limit config
+            "flash_qkv": cp.save_only_these_names(
+                "flash_out", "flash_lse", "rope_q", "rope_k", "attn_v",
             ),
         }
         policy = policies[cfg.remat_policy]

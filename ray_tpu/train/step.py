@@ -93,13 +93,34 @@ def make_train_step(
     rules: ShardingRules,
     optimizer: optax.GradientTransformation,
     state_shardings: TrainState,
+    compute_dtype_grads: bool = False,
 ):
     """Returns train_step(state, batch) -> (state, metrics), jit'ed with
-    donated state (in-place HBM update) and sharded in/out."""
+    donated state (in-place HBM update) and sharded in/out.
+
+    compute_dtype_grads=True differentiates wrt the params AFTER their cast
+    to cfg.dtype, so the gradient tree materializes in bf16 instead of
+    fp32 — classic mixed precision (fp32 master weights, low-precision
+    grads). Optimizer state stays fp32 (or mu_dtype). Note the bf16 param
+    copy it introduces is live across the whole step while fp32 grad
+    leaves die progressively into the update, so the PEAK-memory effect is
+    config-dependent (measured ~neutral on the gpt_1b HBM-limit bench —
+    the remat policy, not this, was the fitting lever there)."""
     loss_fn = make_loss_fn(cfg, rules, mesh)
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if compute_dtype_grads:
+            # the model casts fp32 leaves to cfg.dtype at use anyway; doing
+            # the cast OUTSIDE the grad means d(loss)/d(bf16 leaf) = bf16
+            p_lo = jax.tree.map(
+                lambda p: p.astype(cfg.dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating)
+                else p,
+                state.params,
+            )
+            loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(p_lo)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -115,9 +136,19 @@ def make_train_step(
     )
 
 
-def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100):
+def default_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.1,
+    warmup: int = 100,
+    mu_dtype: Optional[Any] = None,
+):
+    """AdamW with warmup-cosine. mu_dtype=jnp.bfloat16 halves the momentum
+    buffer — the lever that fits a ~1B-param model (fp32 params + adam
+    state) in one v5e's 16G HBM."""
     sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, 10000, lr * 0.1)
     return optax.chain(
         optax.clip_by_global_norm(1.0),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(
+            sched, b1=0.9, b2=0.95, weight_decay=weight_decay, mu_dtype=mu_dtype
+        ),
     )
