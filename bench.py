@@ -1,6 +1,6 @@
 """Benchmark: flagship-model training throughput on the local chip(s).
 
-Three rows, run as separate child processes (the chip claim is exclusive
+Four rows, run as separate child processes (the chip claim is exclusive
 per process, so each phase gets a fresh claim):
   raw     — model/step/sharding stack driven directly (round-3 number)
   trainer — the SAME config through the real framework: JaxTrainer actor
@@ -9,11 +9,13 @@ per process, so each phase gets a fresh claim):
             "GPT-2 125M single-host JaxTrainer")
   hbm     — a ~1.15B-param config sized to fill one v5e's 16G HBM with
             remat + flash (BASELINE.md 7B north star, scaled to one chip)
+  rl      — PPO learner samples/sec/chip + end-to-end rollout pipeline +
+            weight-broadcast latency (BASELINE.json metric #2)
 
 Prints ONE JSON line; the trainer row is the headline metric, the others
 ride along as fields:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "raw": {...},
-   "hbm": {...}, "trainer_overhead_vs_raw_pct": N}
+   "hbm": {...}, "rl": {...}, "trainer_overhead_vs_raw_pct": N}
 
 vs_baseline is measured MFU / 0.45 — the BASELINE.json north-star target
 (the reference publishes no tokens/sec numbers; see BASELINE.md notes).
@@ -46,6 +48,10 @@ def _peak_flops_kind(kind: str) -> float:
 
 def _peak_flops(device) -> float:
     return _peak_flops_kind(getattr(device, "device_kind", "cpu"))
+
+
+def _on_tpu(device) -> bool:
+    return device.platform == "tpu" or "TPU" in getattr(device, "device_kind", "")
 
 
 def _tpu_configured() -> bool:
@@ -150,7 +156,7 @@ def main_raw():
     from ray_tpu.train.step import default_optimizer
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
+    on_tpu = _on_tpu(dev)
 
     if on_tpu:
         # Pallas flash attention (head-major layout, fused single-block
@@ -196,7 +202,7 @@ def main_hbm():
     from ray_tpu.train.step import default_optimizer
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu" or "TPU" in getattr(dev, "device_kind", "")
+    on_tpu = _on_tpu(dev)
     n_chips = len(jax.devices())
 
     if on_tpu:
@@ -397,6 +403,137 @@ def main_trainer():
 
 
 # --------------------------------------------------------------------------
+# rl mode — the second north star: PPO learner samples/sec/chip
+# --------------------------------------------------------------------------
+
+
+def main_rl():
+    """Three RL numbers (BASELINE.json metric #2; reference intent:
+    rllib/core/learner/learner_group.py:61):
+      - learner-only: PPOLearner.update on the chip over a large synthetic
+        batch — samples/sec/chip through the jitted epochs-x-minibatches
+        program, H2D included (it is part of real learner feed cost)
+      - pipeline: PPO end-to-end on CartPole — CPU rollout actors feeding
+        the learner through Algorithm.training_step
+      - weight-broadcast latency learner -> rollout workers
+    The learner runs IN THIS child process (it claims the chip); rollout
+    actors are -S CPU workers."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.rl.learner import PPOLearner
+    from ray_tpu.rl.sample_batch import (
+        ACTIONS, ADVANTAGES, LOGP, OBS, TARGETS, VALUES, SampleBatch,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = _on_tpu(dev)
+    kind = getattr(dev, "device_kind", dev.platform)
+
+    obs_dim, n_act = 64, 8
+    if on_tpu:
+        B, mb, iters = 65536, 8192, 5
+    else:
+        B, mb, iters = 8192, 1024, 3
+    learner = PPOLearner(
+        obs_dim, n_act, hidden=(256, 256), minibatch_size=mb, num_epochs=4
+    )
+    rng = np.random.default_rng(0)
+    batch = SampleBatch(
+        {
+            OBS: rng.normal(size=(B, obs_dim)).astype(np.float32),
+            ACTIONS: rng.integers(0, n_act, B).astype(np.int64),
+            LOGP: np.full(B, -np.log(n_act), np.float32),
+            ADVANTAGES: rng.normal(size=B).astype(np.float32),
+            TARGETS: rng.normal(size=B).astype(np.float32),
+            VALUES: rng.normal(size=B).astype(np.float32),
+        }
+    )
+    learner.update(batch)  # compile
+    # update() trains on the mesh-aligned truncation, not B — credit only
+    # what was actually processed (guards a future B/mb retune)
+    used = learner._built_used
+    assert used == B, (used, B)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        learner.update(batch)
+    dt = time.perf_counter() - t0
+    feed_sps = used * iters / dt  # includes fresh H2D per update
+
+    # device-resident batch: the learner PROGRAM's throughput (epochs x
+    # minibatches on-chip). On this rig H2D rides a debug tunnel ~200x
+    # slower than a TPU-VM's PCIe, so the feed-included number above
+    # under-reports the chip by orders of magnitude; real deployments see
+    # roughly this one.
+    import jax.numpy as jnp
+
+    cols = {
+        k: jnp.asarray(batch[k][:used])
+        for k in (OBS, ACTIONS, LOGP, ADVANTAGES, TARGETS, VALUES)
+    }
+    state, m = learner._update_fn(learner.state, cols)
+    jax.block_until_ready(m["total_loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = learner._update_fn(state, cols)
+    jax.block_until_ready(m["total_loss"])
+    dt = time.perf_counter() - t0
+    learner.state = state
+    learner_sps = used * iters / dt
+
+    # -- end-to-end PPO pipeline on CartPole + weight broadcast --
+    import ray_tpu
+    from ray_tpu.rl.ppo import PPOConfig
+
+    ray_tpu.init(num_cpus=4)
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, rollout_fragment_length=250)
+        .training(train_batch_size=2000, minibatch_size=256, num_epochs=4)
+        .build()
+    )
+    algo.train()  # warm: rollout-actor spawn + learner compile at this size
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(2):
+        res = algo.train()
+        n += res["num_env_steps_sampled_this_iter"]
+    pipeline_sps = n / (time.perf_counter() - t0)
+
+    w = algo.learner_group.get_weights()
+    t0 = time.perf_counter()
+    algo.workers.set_weights(w)
+    broadcast_ms = (time.perf_counter() - t0) * 1000.0
+    algo.stop()
+    ray_tpu.shutdown()
+
+    print(
+        f"[bench:rl] dev={kind} learner={learner_sps:,.0f} samples/s "
+        f"(feed-included {feed_sps:,.0f}; B={B} epochs=4) "
+        f"pipeline={pipeline_sps:,.0f} samples/s broadcast={broadcast_ms:.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_learner_samples_per_sec_per_chip"
+                if on_tpu
+                else "ppo_learner_samples_per_sec_cpu",
+                "value": round(learner_sps, 1),
+                "unit": "samples/s/chip",
+                "device": kind,
+                "feed_included_samples_per_sec": round(feed_sps, 1),
+                "pipeline_samples_per_sec": round(pipeline_sps, 1),
+                "weight_broadcast_ms": round(broadcast_ms, 2),
+                "update_ms": round(dt / iters * 1000, 2),
+                "batch_size": B,
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------
 # supervisor
 # --------------------------------------------------------------------------
 
@@ -480,6 +617,7 @@ def _supervise() -> int:
                  3, cpu_fallback=True)
     trainer = _phase("trainer", 600, 2, cpu_fallback=True)
     hbm = _phase("hbm", 600, 2, cpu_fallback=False)
+    rl = _phase("rl", 600, 2, cpu_fallback=False)
 
     if trainer is not None:
         primary = dict(trainer)
@@ -499,6 +637,8 @@ def _supervise() -> int:
         return 1
     if hbm is not None:
         primary["hbm"] = hbm
+    if rl is not None:
+        primary["rl"] = rl
     print(json.dumps(primary))
     return 0
 
@@ -511,5 +651,7 @@ if __name__ == "__main__":
         main_trainer()
     elif mode == "hbm":
         main_hbm()
+    elif mode == "rl":
+        main_rl()
     else:
         sys.exit(_supervise())
