@@ -42,6 +42,15 @@ from .sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .ddpg import DDPG, DDPGConfig, DDPGLearner  # noqa: F401
 from .td3 import TD3, TD3Config, TD3Learner  # noqa: F401
 from .sample_batch import SampleBatch, compute_gae, concat_samples  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentBatch,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentRolloutWorker,
+    make_multi_agent,
+)
+from .qmix import QMIX, QMIXConfig  # noqa: F401
 from . import offline  # noqa: F401,E402
 
 from .._private.usage import record_library_usage as _rlu  # noqa: E402

@@ -154,7 +154,10 @@ class Algorithm(Trainable):
         result = self.training_step()
         result.setdefault("timesteps_total", self._timesteps_total)
         result["time_this_iter_s"] = time.perf_counter() - t0
-        result.update(self.workers.episode_metrics())
+        # multi-agent algorithms track episode stats in training_step and
+        # have no WorkerSet
+        if getattr(self, "workers", None) is not None:
+            result.update(self.workers.episode_metrics())
         return result
 
     def train(self) -> Dict[str, Any]:

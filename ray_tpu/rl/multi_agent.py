@@ -1,0 +1,491 @@
+"""Multi-agent RL: dict-keyed envs, policy mapping, per-policy training.
+
+Reference parity:
+  - MultiAgentEnv protocol: rllib/env/multi_agent_env.py:30 (reset/step
+    over per-agent dicts, "__all__" termination key, possibly-disjoint
+    agent sets per step).
+  - make_multi_agent: rllib/env/multi_agent_env.py:399 (wrap N copies of a
+    single-agent env into one multi-agent env).
+  - MultiAgentBatch: rllib/policy/sample_batch.py MultiAgentBatch (dict
+    policy_id -> SampleBatch + env-step accounting).
+  - Policy mapping: rllib/policy/policy_map.py:20 + the
+    policy_mapping_fn config of algorithm_config.py — agents are routed to
+    named policies; policies train ONLY on their own agents' experience.
+
+TPU-first redesign notes: policies stay small CPU-side pytrees for
+rollouts; training batches are merged per policy and each policy's PPO
+update is the same single jitted epochs-x-minibatches program the
+single-agent learner compiles (learner.py) — one dispatch per policy per
+iteration, not per agent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .algorithm import Algorithm
+from .learner import PPOLearner
+from .ppo import PPOConfig
+from .policy import Policy
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGP,
+    OBS,
+    REWARDS,
+    TARGETS,
+    VALUES,
+    SampleBatch,
+    compute_gae,
+    concat_samples,
+)
+
+AgentID = Any
+PolicyID = str
+
+
+class MultiAgentEnv:
+    """Dict-keyed environment (reference: multi_agent_env.py:30).
+
+    Subclasses implement reset() -> (obs_dict, info_dict) and
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos),
+    all keyed by agent id; terminateds/truncateds carry the special
+    "__all__" key ending the episode for everyone. Agents may appear and
+    disappear between steps — an agent acts exactly when its id is in the
+    latest obs dict."""
+
+    # uniform spaces (per-agent overrides via observation_spaces dicts)
+    observation_space: Any = None
+    action_space: Any = None
+    possible_agents: List[AgentID] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[AgentID, Any]):
+        raise NotImplementedError
+
+    def get_state(self) -> np.ndarray:
+        """Global state for centralized critics/mixers (QMIX). Default:
+        concatenation of every possible agent's last observation is NOT
+        derivable here, so subclasses with centralized training override
+        this; envs used only with independent learners can ignore it."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def make_multi_agent(env_spec: Union[str, Callable[[], Any]], num_agents: int):
+    """N independent copies of a single-agent env as one MultiAgentEnv
+    (reference: multi_agent_env.py:399 make_multi_agent). Agent i's episode
+    ends independently; "__all__" fires when every copy is done."""
+
+    def _make():
+        from .rollout_worker import _make_env
+
+        return _make_env(env_spec)
+
+    class _MultiEnv(MultiAgentEnv):
+        def __init__(self):
+            self.envs = {i: _make() for i in range(num_agents)}
+            self.possible_agents = list(self.envs)
+            probe = self.envs[0]
+            self.observation_space = probe.observation_space
+            self.action_space = probe.action_space
+            self._done: Dict[AgentID, bool] = {}
+
+        def reset(self, *, seed: Optional[int] = None):
+            obs, infos = {}, {}
+            for i, env in self.envs.items():
+                o, info = env.reset(seed=None if seed is None else seed + i)
+                obs[i] = np.asarray(o, np.float32)
+                infos[i] = info
+            self._done = {i: False for i in self.envs}
+            return obs, infos
+
+        def step(self, action_dict):
+            obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+            for i, a in action_dict.items():
+                if self._done.get(i, True):
+                    continue
+                o, r, te, tr, info = self.envs[i].step(a)
+                rews[i] = float(r)
+                terms[i] = bool(te)
+                truncs[i] = bool(tr)
+                infos[i] = info
+                # the FINAL observation rides the obs dict even when the
+                # copy ended (RLlib convention) — truncation bootstrapping
+                # needs V(s_final); consumers use terms/truncs, not obs
+                # presence, to decide whether the agent acts again
+                obs[i] = np.asarray(o, np.float32)
+                if te or tr:
+                    self._done[i] = True
+            all_done = all(self._done.values())
+            terms["__all__"] = all_done
+            truncs["__all__"] = False
+            return obs, rews, terms, truncs, infos
+
+    return _MultiEnv
+
+
+class MultiAgentBatch:
+    """Per-policy sample batches + env-step count (reference:
+    sample_batch.py MultiAgentBatch)."""
+
+    def __init__(self, policy_batches: Dict[PolicyID, SampleBatch], env_steps: int):
+        self.policy_batches = policy_batches
+        self._env_steps = int(env_steps)
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())
+
+    def __len__(self) -> int:
+        return self._env_steps
+
+
+def concat_multi_agent(batches: List[MultiAgentBatch]) -> MultiAgentBatch:
+    out: Dict[PolicyID, List[SampleBatch]] = {}
+    steps = 0
+    for mb in batches:
+        steps += mb.env_steps()
+        for pid, b in mb.policy_batches.items():
+            out.setdefault(pid, []).append(b)
+    return MultiAgentBatch(
+        {pid: concat_samples(bs) for pid, bs in out.items()}, steps
+    )
+
+
+class _AgentTrajectory:
+    """Per-agent episode columns, GAE'd on close with that agent's policy."""
+
+    __slots__ = ("obs", "actions", "rewards", "values", "logp")
+
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List[int] = []
+        self.rewards: List[float] = []
+        self.values: List[float] = []
+        self.logp: List[float] = []
+
+    def close(
+        self, bootstrap: float, gamma: float, lam: float, terminal: bool = True
+    ) -> SampleBatch:
+        T = len(self.actions)
+        rew = np.asarray(self.rewards, np.float32).reshape(T, 1)
+        val = np.asarray(self.values, np.float32).reshape(T, 1)
+        dones = np.zeros((T, 1), np.float32)
+        # compute_gae multiplies the bootstrap by (1 - dones[-1]): only a
+        # genuine termination may mark the last step done, else the
+        # truncation/fragment-edge bootstrap would be silently zeroed
+        if terminal:
+            dones[-1, 0] = 1.0
+        gae = compute_gae(
+            rew, val, dones, np.asarray([bootstrap], np.float32), gamma, lam
+        )
+        return SampleBatch(
+            {
+                OBS: np.stack(self.obs).astype(np.float32),
+                ACTIONS: np.asarray(self.actions, np.int64),
+                REWARDS: rew[:, 0],
+                DONES: dones[:, 0],
+                VALUES: val[:, 0],
+                LOGP: np.asarray(self.logp, np.float32),
+                ADVANTAGES: gae[ADVANTAGES][:, 0],
+                TARGETS: gae[TARGETS][:, 0],
+            }
+        )
+
+
+class MultiAgentRolloutWorker:
+    """One sampling actor over a MultiAgentEnv: routes each agent's obs to
+    its mapped policy, collects per-AGENT trajectories, and emits a
+    per-POLICY MultiAgentBatch with GAE attached (reference:
+    rollout_worker.py sample() + policy_map routing)."""
+
+    def __init__(
+        self,
+        env_maker: Callable[[], MultiAgentEnv],
+        policy_specs: Dict[PolicyID, Tuple[int, int]],  # pid -> (obs_dim, n_act)
+        policy_mapping_fn: Callable[[AgentID], PolicyID],
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lam: float = 0.95,
+        seed: int = 0,
+        policy_hidden=(64, 64),
+    ):
+        self.env = env_maker()
+        self.map_fn = policy_mapping_fn
+        self.T = rollout_fragment_length
+        self.gamma, self.lam = gamma, lam
+        self.policies: Dict[PolicyID, Policy] = {
+            pid: Policy(od, na, policy_hidden, seed=seed + i)
+            for i, (pid, (od, na)) in enumerate(sorted(policy_specs.items()))
+        }
+        self._obs, _ = self.env.reset(seed=seed)
+        self._traj: Dict[AgentID, _AgentTrajectory] = {}
+        self._episode_returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def ready(self) -> bool:
+        return True
+
+    def get_weights(self) -> Dict[PolicyID, Any]:
+        return {pid: p.get_weights() for pid, p in self.policies.items()}
+
+    def set_weights(self, weights: Dict[PolicyID, Any]) -> None:
+        for pid, w in weights.items():
+            self.policies[pid].set_weights(w)
+
+    def _policy_of(self, aid: AgentID) -> PolicyID:
+        return self.map_fn(aid)
+
+    def sample(self) -> MultiAgentBatch:
+        """Collect >= T env steps (finishing episodes at the fragment edge
+        by bootstrap-truncating every live trajectory)."""
+        done_batches: Dict[PolicyID, List[SampleBatch]] = {}
+        steps = 0
+        while steps < self.T:
+            acting = sorted(self._obs.keys())
+            if not acting:  # defensive: empty obs dict outside episode end
+                self._obs, _ = self.env.reset()
+                continue
+            # route by policy: ONE batched forward per policy per step
+            by_pid: Dict[PolicyID, List[AgentID]] = {}
+            for aid in acting:
+                by_pid.setdefault(self._policy_of(aid), []).append(aid)
+            actions: Dict[AgentID, int] = {}
+            meta: Dict[AgentID, Tuple[float, float]] = {}
+            for pid, aids in by_pid.items():
+                obs_mat = np.stack([self._obs[a] for a in aids])
+                acts, logps, vals = self.policies[pid].compute_actions(obs_mat)
+                for a, act, lp, v in zip(aids, acts, logps, vals):
+                    actions[a] = int(act)
+                    meta[a] = (float(lp), float(v))
+            nobs, rews, terms, truncs, _ = self.env.step(actions)
+            steps += 1
+            ep_end = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            ended_agents = set()
+            for aid in acting:
+                tr = self._traj.setdefault(aid, _AgentTrajectory())
+                tr.obs.append(self._obs[aid])
+                tr.actions.append(actions[aid])
+                r = float(rews.get(aid, 0.0))
+                tr.rewards.append(r)
+                self._ep_ret += r
+                lp, v = meta[aid]
+                tr.logp.append(lp)
+                tr.values.append(v)
+                a_term = bool(terms.get(aid))
+                a_trunc = bool(truncs.get(aid))
+                # an episode ending only via "__all__" (RLlib convention)
+                # must still close every live trajectory, or it would bleed
+                # across the reset into the next episode
+                if a_term or a_trunc or ep_end or aid not in nobs:
+                    terminal = a_term or (
+                        bool(terms.get("__all__")) and not a_trunc
+                    )
+                    boot = 0.0
+                    if not terminal and aid in nobs:
+                        boot = float(
+                            self.policies[self._policy_of(aid)].compute_values(
+                                nobs[aid][None]
+                            )[0]
+                        )
+                    done_batches.setdefault(self._policy_of(aid), []).append(
+                        tr.close(boot, self.gamma, self.lam, terminal=terminal)
+                    )
+                    self._traj.pop(aid, None)
+                    ended_agents.add(aid)
+            if ep_end:
+                self._episode_returns.append(self._ep_ret)
+                self._ep_ret = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                # final observations of ended agents stay OUT of the acting
+                # set (the RLlib obs dict may carry them for bootstrapping)
+                self._obs = {a: o for a, o in nobs.items() if a not in ended_agents}
+        # fragment edge: bootstrap-close every live trajectory (the episode
+        # continues next sample(), but PPO trains on completed GAE segments)
+        for aid, tr in list(self._traj.items()):
+            if not tr.actions:
+                continue
+            pid = self._policy_of(aid)
+            boot = 0.0
+            if aid in self._obs:
+                boot = float(self.policies[pid].compute_values(self._obs[aid][None])[0])
+            done_batches.setdefault(pid, []).append(
+                tr.close(boot, self.gamma, self.lam, terminal=False)
+            )
+            self._traj.pop(aid, None)
+        return MultiAgentBatch(
+            {pid: concat_samples(bs) for pid, bs in done_batches.items()}, steps
+        )
+
+    def episode_returns(self) -> List[float]:
+        out, self._episode_returns = self._episode_returns, []
+        return out
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    """PPOConfig + the multi-agent routing block — inherits the PPO
+    hyperparameter defaults (clip_eps/vf_coeff/entropy_coeff/
+    max_grad_norm) so single- and multi-agent PPO stay in lockstep."""
+
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MultiAgentPPO
+        self.policies: Optional[Dict[PolicyID, Tuple[int, int]]] = None
+        self.policy_mapping_fn: Callable[[AgentID], PolicyID] = (
+            lambda aid: "default_policy"
+        )
+
+    def multi_agent(
+        self,
+        *,
+        policies: Optional[Dict[PolicyID, Tuple[int, int]]] = None,
+        policy_mapping_fn: Optional[Callable[[AgentID], PolicyID]] = None,
+    ) -> "MultiAgentPPOConfig":
+        """Reference: AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...). policies maps policy id -> (obs_dim,
+        num_actions); None infers ONE shared policy from the env spaces."""
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    """Independent/shared-parameter PPO over a MultiAgentEnv: one
+    PPOLearner per policy, each updated on its own merged batch
+    (reference: the multi-agent training path of ppo.py training_step +
+    policy_map.py)."""
+
+    _config_class = MultiAgentPPOConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        import ray_tpu
+
+        cfg = self.algo_config
+        env_maker = cfg.env if callable(cfg.env) else None
+        if env_maker is None:
+            raise ValueError("MultiAgentPPO needs a callable env maker")
+        if cfg.policies is None:
+            probe = env_maker()
+            obs_dim = int(np.prod(probe.observation_space.shape))
+            n_act = int(probe.action_space.n)
+            probe.close()
+            cfg.policies = {"default_policy": (obs_dim, n_act)}
+        self._policy_ids = sorted(cfg.policies)
+
+        worker_kwargs = dict(
+            env_maker=env_maker,
+            policy_specs=cfg.policies,
+            policy_mapping_fn=cfg.policy_mapping_fn,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            gamma=cfg.gamma,
+            lam=cfg.lambda_,
+            policy_hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        if cfg.num_rollout_workers == 0:
+            self._local_worker = MultiAgentRolloutWorker(seed=cfg.seed, **worker_kwargs)
+            self._remote_workers = []
+        else:
+            self._local_worker = None
+            cls = ray_tpu.remote(MultiAgentRolloutWorker)
+            self._remote_workers = [
+                cls.options(num_cpus=cfg.num_cpus_per_worker).remote(
+                    seed=cfg.seed + 1000 * (i + 1), **worker_kwargs
+                )
+                for i in range(cfg.num_rollout_workers)
+            ]
+            ray_tpu.get([w.ready.remote() for w in self._remote_workers])
+
+        self.learners: Dict[PolicyID, PPOLearner] = {
+            pid: PPOLearner(
+                obs_dim=od,
+                num_actions=na,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                lr=cfg.lr,
+                clip_eps=cfg.clip_eps,
+                vf_coeff=cfg.vf_coeff,
+                entropy_coeff=cfg.entropy_coeff,
+                num_epochs=cfg.num_epochs,
+                minibatch_size=cfg.minibatch_size,
+                max_grad_norm=cfg.max_grad_norm,
+                seed=cfg.seed + i,
+                mesh=cfg.mesh,
+            )
+            for i, (pid, (od, na)) in enumerate(sorted(cfg.policies.items()))
+        }
+        self._sync_weights()
+        self._recent_returns: List[float] = []
+
+    def _sync_weights(self):
+        import ray_tpu
+
+        weights = {pid: ln.get_weights() for pid, ln in self.learners.items()}
+        if self._local_worker is not None:
+            self._local_worker.set_weights(weights)
+        else:
+            ray_tpu.get(
+                [w.set_weights.remote(weights) for w in self._remote_workers]
+            )
+
+    def _sample(self) -> Tuple[MultiAgentBatch, List[float]]:
+        import ray_tpu
+
+        if self._local_worker is not None:
+            b = self._local_worker.sample()
+            return b, self._local_worker.episode_returns()
+        batches = ray_tpu.get([w.sample.remote() for w in self._remote_workers])
+        rets = [
+            r
+            for rs in ray_tpu.get(
+                [w.episode_returns.remote() for w in self._remote_workers]
+            )
+            for r in rs
+        ]
+        return concat_multi_agent(batches), rets
+
+    def training_step(self) -> Dict[str, Any]:
+        collected: List[MultiAgentBatch] = []
+        steps = 0
+        returns: List[float] = []
+        while steps < self.algo_config.train_batch_size:
+            b, rets = self._sample()
+            collected.append(b)
+            returns.extend(rets)
+            steps += b.env_steps()
+        batch = concat_multi_agent(collected)
+        self._timesteps_total += batch.env_steps()
+        metrics: Dict[str, Any] = {}
+        for pid, pb in batch.policy_batches.items():
+            m = self.learners[pid].update(pb)
+            metrics[pid] = m
+        self._sync_weights()
+        if returns:
+            self._recent_returns.extend(returns)
+            self._recent_returns = self._recent_returns[-100:]
+        metrics["episode_reward_mean"] = (
+            float(np.mean(self._recent_returns[-20:])) if self._recent_returns else 0.0
+        )
+        metrics["num_env_steps_sampled_this_iter"] = batch.env_steps()
+        metrics["agent_steps_this_iter"] = batch.agent_steps()
+        return metrics
+
+    def stop(self):
+        import ray_tpu
+
+        for w in self._remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,227 @@
+"""Multi-agent RL: MultiAgentEnv protocol, policy mapping, shared and
+independent MultiAgentPPO training, and QMIX on the two-step coordination
+game (reference: rllib/env/multi_agent_env.py:30,
+rllib/algorithms/qmix/qmix.py:236 — the two-step game is the QMIX paper's
+monotonic-mixing litmus: greedy return 8 needs coordinated exploration
+through the low-reward branch, which VDN-style additive mixing misses)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (
+    QMIX,
+    QMIXConfig,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    make_multi_agent,
+)
+
+
+class _Space:
+    def __init__(self, shape=None, n=None):
+        self.shape = shape
+        self.n = n
+
+
+class ContextMatchEnv(MultiAgentEnv):
+    """Two agents see a shared one-hot context; each earns +1 for picking
+    the action matching the context. Fully cooperative, factored — both
+    shared-parameter and independent PPO should solve it."""
+
+    N_CTX = 4
+    EP_LEN = 8
+
+    def __init__(self, seed=0):
+        self.possible_agents = ["a0", "a1"]
+        self.observation_space = _Space(shape=(self.N_CTX,))
+        self.action_space = _Space(n=self.N_CTX)
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+
+    def _ctx(self):
+        o = np.zeros(self.N_CTX, np.float32)
+        o[self._rng.integers(self.N_CTX)] = 1.0
+        return o
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        self._obs = self._ctx()
+        return {a: self._obs.copy() for a in self.possible_agents}, {}
+
+    def step(self, action_dict):
+        target = int(self._obs.argmax())
+        rews = {a: float(action_dict[a] == target) for a in self.possible_agents}
+        self._t += 1
+        done = self._t >= self.EP_LEN
+        self._obs = self._ctx()
+        obs = {} if done else {a: self._obs.copy() for a in self.possible_agents}
+        return obs, rews, {"__all__": done}, {"__all__": False}, {}
+
+
+class TwoStepGame(MultiAgentEnv):
+    """The QMIX paper's two-step game. Step 1: agent 0's action picks the
+    branch (0 -> state 2A, 1 -> state 2B). Step 2: 2A pays 7 regardless;
+    2B pays [[0,1],[1,8]] on the joint action. Optimal = branch B + both
+    play 1 -> 8."""
+
+    PAYOFF_B = np.array([[0.0, 1.0], [1.0, 8.0]], np.float32)
+
+    def __init__(self):
+        self.possible_agents = [0, 1]
+        self.observation_space = _Space(shape=(3,))
+        self.action_space = _Space(n=2)
+        self._stage = 0
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        o[self._stage] = 1.0
+        return {a: o.copy() for a in self.possible_agents}
+
+    def get_state(self):
+        s = np.zeros(3, np.float32)
+        s[self._stage] = 1.0
+        return s
+
+    def reset(self, *, seed=None):
+        self._stage = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        if self._stage == 0:
+            self._stage = 1 if action_dict[0] == 0 else 2
+            return self._obs(), {0: 0.0, 1: 0.0}, {"__all__": False}, {"__all__": False}, {}
+        if self._stage == 1:
+            r = 7.0
+        else:
+            r = float(self.PAYOFF_B[action_dict[0], action_dict[1]])
+        self._stage = 0
+        return (
+            {},
+            {0: r / 2, 1: r / 2},
+            {"__all__": True},
+            {"__all__": False},
+            {},
+        )
+
+
+def test_multi_agent_env_protocol():
+    env = ContextMatchEnv()
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"a0", "a1"}
+    obs, rews, terms, truncs, _ = env.step({"a0": 0, "a1": 1})
+    assert set(rews) == {"a0", "a1"}
+    assert "__all__" in terms and "__all__" in truncs
+
+
+def test_make_multi_agent_wraps_single_agent():
+    pytest.importorskip("gymnasium")
+    cls = make_multi_agent("CartPole-v1", num_agents=2)
+    env = cls()
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {0, 1}
+    obs, rews, terms, truncs, _ = env.step({0: 0, 1: 1})
+    assert set(rews) <= {0, 1}
+    env.close()
+
+
+def _run_mappo(policies, mapping_fn, iters=25):
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(ContextMatchEnv)
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=128)
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=4, lr=3e-3)
+        .debugging(seed=1)
+    )
+    cfg.multi_agent(policies=policies, policy_mapping_fn=mapping_fn)
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(iters):
+        res = algo.train()
+        best = max(best, res["episode_reward_mean"])
+    algo.stop()
+    return best, res
+
+
+def test_mappo_shared_policy_learns():
+    """All agents -> one shared policy (parameter sharing)."""
+    best, res = _run_mappo(None, lambda aid: "default_policy")
+    # optimum: 2 agents x 8 steps x 1.0 = 16 team reward per episode
+    assert best > 12.0, f"shared-policy MAPPO failed to learn: best {best}"
+    assert set(res) >= {"default_policy", "episode_reward_mean"}
+
+
+def test_mappo_independent_policies_learn():
+    """Each agent its own policy via the mapping fn."""
+    policies = {"p_a0": (4, 4), "p_a1": (4, 4)}
+    best, res = _run_mappo(policies, lambda aid: f"p_{aid}")
+    assert best > 12.0, f"independent MAPPO failed to learn: best {best}"
+    assert "p_a0" in res and "p_a1" in res
+
+
+def test_mappo_remote_workers_smoke():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        cfg = (
+            MultiAgentPPOConfig()
+            .environment(ContextMatchEnv)
+            .rollouts(num_rollout_workers=1, rollout_fragment_length=64)
+            .training(train_batch_size=128, minibatch_size=64, num_epochs=2)
+        )
+        algo = cfg.build()
+        res = algo.train()
+        assert res["num_env_steps_sampled_this_iter"] >= 128
+        # agent steps = 2 agents x env steps
+        assert res["agent_steps_this_iter"] == 2 * res["num_env_steps_sampled_this_iter"]
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_qmix_learns_two_step_game():
+    cfg = (
+        QMIXConfig()
+        .environment(TwoStepGame)
+        .training(
+            train_batch_size=256,
+            minibatch_size=64,
+            lr=5e-3,
+        )
+        .debugging(seed=3)
+    )
+    cfg.epsilon_decay_steps = 3000
+    cfg.target_update_freq = 100
+    algo = cfg.build()
+    for _ in range(30):
+        res = algo.train()
+    # greedy policy must take branch B and coordinate on (1, 1) -> 8
+    env = TwoStepGame()
+    obs, _ = env.reset()
+    obs_all = np.stack([obs[a] for a in env.possible_agents])
+    a1 = algo.greedy_actions(obs_all)
+    obs, _, _, _, _ = env.step({0: int(a1[0]), 1: int(a1[1])})
+    obs_all = np.stack([obs[a] for a in env.possible_agents])
+    a2 = algo.greedy_actions(obs_all)
+    _, rews, terms, _, _ = env.step({0: int(a2[0]), 1: int(a2[1])})
+    ret = sum(rews.values())
+    assert terms["__all__"]
+    assert ret > 7.5, (
+        f"QMIX greedy return {ret} (actions {a1} then {a2}) — monotonic "
+        f"mixing should find the coordinated 8, not the safe 7"
+    )
+    algo.stop()
+
+
+def test_qmix_mixer_monotonic():
+    """dQ_tot/dQ_i >= 0 by construction (abs on hypernet weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.qmix import init_qmix_params, mix
+
+    params = init_qmix_params(jax.random.PRNGKey(0), 3, 2, 2, 3)
+    state = jnp.asarray(np.random.default_rng(0).normal(size=(5, 3)), jnp.float32)
+    qs = jnp.asarray(np.random.default_rng(1).normal(size=(5, 2)), jnp.float32)
+    grads = jax.vmap(jax.grad(lambda q, s: mix(params, q[None], s[None])[0]))(qs, state)
+    assert (np.asarray(grads) >= -1e-6).all()
