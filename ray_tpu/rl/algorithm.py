@@ -72,9 +72,14 @@ class WorkerSet:
             return self._local.sample()
         import ray_tpu
 
-        return concat_samples(
-            ray_tpu.get([w.sample.remote() for w in self._remote_workers])
-        )
+        batches = ray_tpu.get([w.sample.remote() for w in self._remote_workers])
+        if batches and hasattr(batches[0], "policy_batches"):
+            # multi-agent workers return MultiAgentBatch (lazy import: the
+            # multi_agent module imports this one)
+            from .multi_agent import concat_multi_agent
+
+            return concat_multi_agent(batches)
+        return concat_samples(batches)
 
     def set_weights(self, weights) -> None:
         if self._local is not None:

@@ -231,7 +231,10 @@ class MultiAgentRolloutWorker:
         self._obs, _ = self.env.reset(seed=seed)
         self._traj: Dict[AgentID, _AgentTrajectory] = {}
         self._episode_returns: List[float] = []
+        self._episode_lens: List[int] = []
         self._ep_ret = 0.0
+        self._ep_len = 0
+        self._episodes_since_drain = 0
 
     def ready(self) -> bool:
         return True
@@ -270,7 +273,19 @@ class MultiAgentRolloutWorker:
                     meta[a] = (float(lp), float(v))
             nobs, rews, terms, truncs, _ = self.env.step(actions)
             steps += 1
+            self._ep_len += 1
             ep_end = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            acting_set = set(acting)
+            # the env may pay an agent that did NOT act this step (RLlib
+            # allows reward dicts over any agent): fold it into that
+            # agent's LAST transition rather than dropping it
+            for aid, r in rews.items():
+                if aid == "__all__" or aid in acting_set:
+                    continue
+                self._ep_ret += float(r)
+                tr = self._traj.get(aid)
+                if tr is not None and tr.rewards:
+                    tr.rewards[-1] += float(r)
             ended_agents = set()
             for aid in acting:
                 tr = self._traj.setdefault(aid, _AgentTrajectory())
@@ -286,8 +301,10 @@ class MultiAgentRolloutWorker:
                 a_trunc = bool(truncs.get(aid))
                 # an episode ending only via "__all__" (RLlib convention)
                 # must still close every live trajectory, or it would bleed
-                # across the reset into the next episode
-                if a_term or a_trunc or ep_end or aid not in nobs:
+                # across the reset into the next episode. An agent merely
+                # ABSENT from the next obs dict (turn-based env) keeps its
+                # trajectory open — it may act again later this episode.
+                if a_term or a_trunc or ep_end:
                     terminal = a_term or (
                         bool(terms.get("__all__")) and not a_trunc
                     )
@@ -304,8 +321,22 @@ class MultiAgentRolloutWorker:
                     self._traj.pop(aid, None)
                     ended_agents.add(aid)
             if ep_end:
+                # close any agent whose trajectory is still open (it did
+                # not act this step but its episode just ended)
+                for aid, tr in list(self._traj.items()):
+                    if tr.actions:
+                        done_batches.setdefault(self._policy_of(aid), []).append(
+                            tr.close(
+                                0.0, self.gamma, self.lam,
+                                terminal=bool(terms.get("__all__")),
+                            )
+                        )
+                    self._traj.pop(aid, None)
                 self._episode_returns.append(self._ep_ret)
+                self._episode_lens.append(self._ep_len)
+                self._episodes_since_drain += 1
                 self._ep_ret = 0.0
+                self._ep_len = 0
                 self._obs, _ = self.env.reset()
             else:
                 # final observations of ended agents stay OUT of the acting
@@ -328,9 +359,21 @@ class MultiAgentRolloutWorker:
             {pid: concat_samples(bs) for pid, bs in done_batches.items()}, steps
         )
 
-    def episode_returns(self) -> List[float]:
-        out, self._episode_returns = self._episode_returns, []
+    def episode_metrics(self, window: int = 100) -> Dict[str, Any]:
+        """Same contract as EnvLoopWorker.episode_metrics, so WorkerSet
+        aggregates multi-agent workers identically."""
+        rets = self._episode_returns[-window:]
+        lens = self._episode_lens[-window:]
+        out = {
+            "episodes_this_iter": self._episodes_since_drain,
+            "episode_reward_mean": float(np.mean(rets)) if rets else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        }
+        self._episodes_since_drain = 0
         return out
+
+    def stop(self) -> None:
+        self.env.close()
 
 
 class MultiAgentPPOConfig(PPOConfig):
@@ -362,31 +405,57 @@ class MultiAgentPPOConfig(PPOConfig):
         return self
 
 
+class _MultiPolicyLearnerGroup:
+    """LearnerGroup-shaped adapter over per-policy learners, so every base
+    Algorithm/Trainable path (save_checkpoint/load_checkpoint/weight sync)
+    works unchanged on multi-agent algorithms (reference:
+    learner_group.py's MultiRLModule handling)."""
+
+    def __init__(self, learners: Dict[PolicyID, PPOLearner]):
+        self.learners = learners
+
+    def update(self, batch: MultiAgentBatch) -> Dict[str, Any]:
+        return {
+            pid: self.learners[pid].update(pb)
+            for pid, pb in batch.policy_batches.items()
+        }
+
+    def get_weights(self) -> Dict[PolicyID, Any]:
+        return {pid: ln.get_weights() for pid, ln in self.learners.items()}
+
+    def set_weights(self, weights: Dict[PolicyID, Any]) -> None:
+        for pid, w in weights.items():
+            self.learners[pid].set_weights(w)
+
+
 class MultiAgentPPO(Algorithm):
     """Independent/shared-parameter PPO over a MultiAgentEnv: one
     PPOLearner per policy, each updated on its own merged batch
     (reference: the multi-agent training path of ppo.py training_step +
-    policy_map.py)."""
+    policy_map.py). Rides the base WorkerSet/Trainable plumbing — the
+    sampling actor and learner group are the only multi-agent parts."""
 
     _config_class = MultiAgentPPOConfig
 
     def setup(self, config: Dict[str, Any]) -> None:
-        import ray_tpu
-
         cfg = self.algo_config
-        env_maker = cfg.env if callable(cfg.env) else None
-        if env_maker is None:
+        if not callable(cfg.env):
             raise ValueError("MultiAgentPPO needs a callable env maker")
         if cfg.policies is None:
-            probe = env_maker()
+            probe = cfg.env()
             obs_dim = int(np.prod(probe.observation_space.shape))
             n_act = int(probe.action_space.n)
             probe.close()
             cfg.policies = {"default_policy": (obs_dim, n_act)}
-        self._policy_ids = sorted(cfg.policies)
+        super().setup(config)
 
-        worker_kwargs = dict(
-            env_maker=env_maker,
+    def _worker_cls(self):
+        return MultiAgentRolloutWorker
+
+    def _worker_kwargs(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        return dict(
+            env_maker=cfg.env,
             policy_specs=cfg.policies,
             policy_mapping_fn=cfg.policy_mapping_fn,
             rollout_fragment_length=cfg.rollout_fragment_length,
@@ -394,98 +463,67 @@ class MultiAgentPPO(Algorithm):
             lam=cfg.lambda_,
             policy_hidden=tuple(cfg.model.get("hidden", (64, 64))),
         )
-        if cfg.num_rollout_workers == 0:
-            self._local_worker = MultiAgentRolloutWorker(seed=cfg.seed, **worker_kwargs)
-            self._remote_workers = []
-        else:
-            self._local_worker = None
-            cls = ray_tpu.remote(MultiAgentRolloutWorker)
-            self._remote_workers = [
-                cls.options(num_cpus=cfg.num_cpus_per_worker).remote(
-                    seed=cfg.seed + 1000 * (i + 1), **worker_kwargs
+
+    def _build_learner(self) -> _MultiPolicyLearnerGroup:
+        cfg = self.algo_config
+        return _MultiPolicyLearnerGroup(
+            {
+                pid: PPOLearner(
+                    obs_dim=od,
+                    num_actions=na,
+                    hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                    lr=cfg.lr,
+                    clip_eps=cfg.clip_eps,
+                    vf_coeff=cfg.vf_coeff,
+                    entropy_coeff=cfg.entropy_coeff,
+                    num_epochs=cfg.num_epochs,
+                    minibatch_size=cfg.minibatch_size,
+                    max_grad_norm=cfg.max_grad_norm,
+                    seed=cfg.seed + i,
+                    mesh=cfg.mesh,
                 )
-                for i in range(cfg.num_rollout_workers)
-            ]
-            ray_tpu.get([w.ready.remote() for w in self._remote_workers])
+                for i, (pid, (od, na)) in enumerate(sorted(cfg.policies.items()))
+            }
+        )
 
-        self.learners: Dict[PolicyID, PPOLearner] = {
-            pid: PPOLearner(
-                obs_dim=od,
-                num_actions=na,
-                hidden=tuple(cfg.model.get("hidden", (64, 64))),
-                lr=cfg.lr,
-                clip_eps=cfg.clip_eps,
-                vf_coeff=cfg.vf_coeff,
-                entropy_coeff=cfg.entropy_coeff,
-                num_epochs=cfg.num_epochs,
-                minibatch_size=cfg.minibatch_size,
-                max_grad_norm=cfg.max_grad_norm,
-                seed=cfg.seed + i,
-                mesh=cfg.mesh,
-            )
-            for i, (pid, (od, na)) in enumerate(sorted(cfg.policies.items()))
-        }
-        self._sync_weights()
-        self._recent_returns: List[float] = []
-
-    def _sync_weights(self):
-        import ray_tpu
-
-        weights = {pid: ln.get_weights() for pid, ln in self.learners.items()}
-        if self._local_worker is not None:
-            self._local_worker.set_weights(weights)
-        else:
-            ray_tpu.get(
-                [w.set_weights.remote(weights) for w in self._remote_workers]
-            )
-
-    def _sample(self) -> Tuple[MultiAgentBatch, List[float]]:
-        import ray_tpu
-
-        if self._local_worker is not None:
-            b = self._local_worker.sample()
-            return b, self._local_worker.episode_returns()
-        batches = ray_tpu.get([w.sample.remote() for w in self._remote_workers])
-        rets = [
-            r
-            for rs in ray_tpu.get(
-                [w.episode_returns.remote() for w in self._remote_workers]
-            )
-            for r in rs
-        ]
-        return concat_multi_agent(batches), rets
+    def _fit_policy_batch(self, b: SampleBatch) -> SampleBatch:
+        """Fix each policy's batch at ONE size across iterations: per-policy
+        agent-step counts are ragged (episodes finish at different times),
+        and PPOLearner.update re-jits for every new size — and would train
+        on clamped-duplicate rows for batches under minibatch_size. Cyclic
+        padding duplicates early rows when short (standard practice);
+        overflow is dropped."""
+        cfg = self.algo_config
+        mb = cfg.minibatch_size
+        n_pol = max(1, len(cfg.policies))
+        target = max(mb, (cfg.train_batch_size // n_pol) // mb * mb)
+        n = len(b)
+        if n == target:
+            return b
+        if n > target:
+            return b.slice(0, target)
+        idx = np.arange(target) % n
+        return SampleBatch({k: v[idx] for k, v in b.items()})
 
     def training_step(self) -> Dict[str, Any]:
         collected: List[MultiAgentBatch] = []
         steps = 0
-        returns: List[float] = []
         while steps < self.algo_config.train_batch_size:
-            b, rets = self._sample()
+            b = self.workers.sample()
             collected.append(b)
-            returns.extend(rets)
             steps += b.env_steps()
         batch = concat_multi_agent(collected)
         self._timesteps_total += batch.env_steps()
-        metrics: Dict[str, Any] = {}
-        for pid, pb in batch.policy_batches.items():
-            m = self.learners[pid].update(pb)
-            metrics[pid] = m
-        self._sync_weights()
-        if returns:
-            self._recent_returns.extend(returns)
-            self._recent_returns = self._recent_returns[-100:]
-        metrics["episode_reward_mean"] = (
-            float(np.mean(self._recent_returns[-20:])) if self._recent_returns else 0.0
+        fitted = MultiAgentBatch(
+            {
+                pid: self._fit_policy_batch(pb)
+                for pid, pb in batch.policy_batches.items()
+                if len(pb)
+            },
+            batch.env_steps(),
         )
+        metrics: Dict[str, Any] = self.learner_group.update(fitted)
+        self.workers.set_weights(self.learner_group.get_weights())
         metrics["num_env_steps_sampled_this_iter"] = batch.env_steps()
         metrics["agent_steps_this_iter"] = batch.agent_steps()
         return metrics
-
-    def stop(self):
-        import ray_tpu
-
-        for w in self._remote_workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass

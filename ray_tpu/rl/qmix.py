@@ -307,5 +307,29 @@ class QMIX(Algorithm):
     def greedy_actions(self, obs_all: np.ndarray) -> np.ndarray:
         return self._act(obs_all, eps=0.0)
 
-    def stop(self):
+    # -- Trainable contract (the base Algorithm versions dereference
+    #    learner_group/workers, which QMIX's inline design has neither of) --
+
+    def save_checkpoint(self) -> Any:
+        return {
+            "params": jax.device_get(self.state.params),
+            "opt_state": jax.device_get(self.state.opt_state),
+            "env_steps": self._env_steps,
+            "grad_steps": self._grad_steps,
+            # replay buffer deliberately not persisted (reference default)
+        }
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.state = TrainState(
+            params=jax.device_put(checkpoint["params"]),
+            opt_state=jax.device_put(checkpoint["opt_state"]),
+            rng=self.state.rng,
+        )
+        self._env_steps = checkpoint.get("env_steps", 0)
+        self._grad_steps = checkpoint.get("grad_steps", 0)
+        self._timesteps_total = self._env_steps
+
+    def cleanup(self) -> None:
         self.env.close()
+
+    stop = cleanup

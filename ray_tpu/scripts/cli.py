@@ -250,7 +250,12 @@ def cmd_start(args) -> None:
     from ray_tpu._private.node import default_resources
 
     node_id = args.node_id or f"node-{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
-    res = default_resources(args.num_cpus, args.num_tpus)
+    custom = None
+    if getattr(args, "resources", None):
+        import json
+
+        custom = {k: float(v) for k, v in json.loads(args.resources).items()}
+    res = default_resources(args.num_cpus, args.num_tpus, custom)
     res.pop("node:__internal_head__", None)
     agent = Agent(args.address, node_id, res)
     print(f"joining {args.address} as {node_id} with {res}", flush=True)
@@ -258,6 +263,45 @@ def cmd_start(args) -> None:
         asyncio.run(agent.run())
     except (KeyboardInterrupt, ConnectionError):
         pass
+
+
+def cmd_up(args) -> None:
+    """`ray_tpu up cluster.yaml` (reference: scripts.py:1235 `ray up` ->
+    commands.py:186 create_or_update_cluster)."""
+    from ray_tpu.autoscaler.launcher import create_or_update_cluster
+
+    state = create_or_update_cluster(args.config, wait_timeout=args.timeout)
+    print(f"cluster up: head --address={state['head_address']}")
+    for nid, h in sorted(state["nodes"].items()):
+        print(f"  node {nid} [{h['node_type']}] ({h['kind']})")
+    print(f"attach with: ray_tpu.init(address={state['head_address']!r})")
+
+
+def cmd_down(args) -> None:
+    """`ray_tpu down cluster.yaml|name` (reference: commands.py:394)."""
+    from ray_tpu.autoscaler.launcher import teardown_cluster
+
+    teardown_cluster(args.config)
+    print("cluster down")
+
+
+def cmd_attach(args) -> None:
+    """`ray_tpu attach cluster.yaml|name`: spawn a shell wired to the
+    cluster (RAY_TPU_ADDRESS set, so init(address='auto') lands on it).
+    Reference: `ray attach` (ours stays local — the head runs here)."""
+    import os
+    import subprocess
+
+    from ray_tpu.autoscaler.launcher import attach_address
+
+    addr = attach_address(args.config)
+    if args.print_address:
+        print(addr)
+        return
+    env = dict(os.environ, RAY_TPU_ADDRESS=addr)
+    shell = os.environ.get("SHELL", "/bin/sh")
+    print(f"RAY_TPU_ADDRESS={addr} — exit the shell to detach")
+    subprocess.call([shell], env=env)
 
 
 def main(argv=None) -> None:
@@ -279,6 +323,14 @@ def main(argv=None) -> None:
     p_prof.add_argument("--kind", choices=("cpu", "mem", "dump"), default="cpu")
     p_prof.add_argument("--duration", type=float, default=2.0)
     p_prof.add_argument("--json", action="store_true")
+    p_up = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p_up.add_argument("config")
+    p_up.add_argument("--timeout", type=float, default=60.0)
+    p_down = sub.add_parser("down", help="tear a launched cluster down")
+    p_down.add_argument("config", help="cluster YAML or cluster name")
+    p_att = sub.add_parser("attach", help="shell wired to a launched cluster")
+    p_att.add_argument("config", help="cluster YAML or cluster name")
+    p_att.add_argument("--print-address", action="store_true")
     p_start = sub.add_parser("start", help="start a head or join as a node agent")
     p_start.add_argument("--head", action="store_true")
     p_start.add_argument("--address", help="head host:port to join as a node")
@@ -286,10 +338,22 @@ def main(argv=None) -> None:
     p_start.add_argument("--node-id")
     p_start.add_argument("--num-cpus", type=int)
     p_start.add_argument("--num-tpus", type=int)
+    p_start.add_argument(
+        "--resources", help='custom resources as JSON, e.g. \'{"launched": 1}\''
+    )
     args = parser.parse_args(argv)
 
     if args.cmd == "start":
         cmd_start(args)
+        return
+    if args.cmd == "up":
+        cmd_up(args)
+        return
+    if args.cmd == "down":
+        cmd_down(args)
+        return
+    if args.cmd == "attach":
+        cmd_attach(args)
         return
     if args.cmd == "dashboard":
         from ray_tpu.dashboard import dashboard_url

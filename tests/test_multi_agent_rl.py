@@ -225,3 +225,39 @@ def test_qmix_mixer_monotonic():
     qs = jnp.asarray(np.random.default_rng(1).normal(size=(5, 2)), jnp.float32)
     grads = jax.vmap(jax.grad(lambda q, s: mix(params, q[None], s[None])[0]))(qs, state)
     assert (np.asarray(grads) >= -1e-6).all()
+
+
+def test_trainable_contract_checkpoint_cleanup():
+    """MultiAgentPPO and QMIX honor the full Trainable surface (tune calls
+    save_checkpoint/cleanup on every trial): save -> perturb -> load
+    restores weights; cleanup() doesn't raise."""
+    import numpy as np
+
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(ContextMatchEnv)
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=32)
+        .training(train_batch_size=64, minibatch_size=32, num_epochs=1)
+    )
+    algo = cfg.build()
+    algo.train()
+    ckpt = algo.save_checkpoint()
+    w0 = algo.learner_group.get_weights()["default_policy"]
+    algo.train()  # weights move
+    algo.load_checkpoint(ckpt)
+    w1 = algo.learner_group.get_weights()["default_policy"]
+    import jax
+
+    for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    algo.cleanup()
+
+    qcfg = QMIXConfig().environment(TwoStepGame).training(train_batch_size=64)
+    qcfg.learning_starts = 32
+    qalgo = qcfg.build()
+    qalgo.train()
+    qckpt = qalgo.save_checkpoint()
+    qalgo.train()
+    qalgo.load_checkpoint(qckpt)
+    assert qalgo._env_steps == qckpt["env_steps"]
+    qalgo.cleanup()
