@@ -2609,6 +2609,19 @@ class Head:
             self._spawn_bg(self._escalate_kill(job["proc"]))
         return True
 
+    async def _h_delete_job(self, conn, msg):
+        """Remove a TERMINAL job's record (reference: job_head.py DELETE
+        /api/jobs/{id} — running jobs must be stopped first)."""
+        job = self.jobs.get(msg["submission_id"])
+        if job is None:
+            raise ValueError(f"no such job {msg['submission_id']!r}")
+        if job["status"] in ("PENDING", "RUNNING"):
+            raise ValueError(
+                f"job {msg['submission_id']!r} is {job['status']}; stop it first"
+            )
+        del self.jobs[msg["submission_id"]]
+        return True
+
     async def _escalate_kill(self, proc, grace_s: float = 3.0):
         """SIGTERM then, if the group ignores it, SIGKILL (reference:
         JobSupervisor stop escalation)."""
