@@ -322,6 +322,34 @@ class Agent:
         self._stop.set()
         return True
 
+    async def _ensure_package(self, src: str):
+        """For a pkg:// runtime-env source, pull the zip from the head into
+        this node's package store if it isn't cached yet, so stage_into
+        resolves it locally (reference: the per-node runtime-env agent
+        downloading packages from GCS object storage)."""
+        if not src.startswith("pkg://"):
+            return
+        name = src[len("pkg://"):]
+        pkg_dir = os.path.join(self.scratch_dir, "packages")
+        pkg_path = os.path.join(pkg_dir, name)
+        if os.path.exists(pkg_path):
+            return
+        data = await self.conn.request({"t": "get_package", "name": name}, timeout=120)
+        loop = asyncio.get_running_loop()
+
+        def _write():
+            import threading
+
+            os.makedirs(pkg_dir, exist_ok=True)
+            # pid+tid: concurrent spawns fetching the same package must not
+            # share a tmp path (staging.py stage_into pattern)
+            tmp = f"{pkg_path}.tmp-{os.getpid()}-{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, pkg_path)
+
+        await loop.run_in_executor(None, _write)
+
     async def _h_spawn_worker(self, msg):
         """Spawn a local worker that dials the head directly over TCP."""
         worker_id = msg["worker_id"]
@@ -340,11 +368,13 @@ class Agent:
         extra_paths = []
         loop = asyncio.get_running_loop()
         if runtime_env.get("working_dir"):
+            await self._ensure_package(runtime_env["working_dir"])
             cwd = await loop.run_in_executor(
                 None, _stage_dir, self.scratch_dir, runtime_env["working_dir"]
             )
             extra_paths.append(cwd)
         for mod in runtime_env.get("py_modules") or []:
+            await self._ensure_package(mod)
             staged = await loop.run_in_executor(None, _stage_dir, self.scratch_dir, mod)
             extra_paths.append(staged if os.path.isdir(staged) else os.path.dirname(staged))
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]

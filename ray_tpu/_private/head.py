@@ -2609,6 +2609,26 @@ class Head:
             self._spawn_bg(self._escalate_kill(job["proc"]))
         return True
 
+    async def _h_get_package(self, conn, msg):
+        """Serve an uploaded working-dir package's bytes to a node agent so
+        pkg:// runtime envs stage on remote nodes too (reference:
+        runtime_env_agent downloading from GCS object storage —
+        _private/runtime_env/packaging.py download_and_unpack_package)."""
+        name = msg["name"]
+        if "/" in name or ".." in name or not name:
+            raise ValueError(f"bad package name {name!r}")
+        path = os.path.join(self.session_dir, "packages", name)
+        loop = asyncio.get_running_loop()
+
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        try:
+            return await loop.run_in_executor(None, _read)
+        except FileNotFoundError:
+            raise ValueError(f"no such uploaded package {name!r}") from None
+
     async def _h_delete_job(self, conn, msg):
         """Remove a TERMINAL job's record (reference: job_head.py DELETE
         /api/jobs/{id} — running jobs must be stopped first)."""

@@ -209,6 +209,13 @@ class Connection:
 
     async def send(self, msg: dict, codec: Optional[str] = None):
         async with self._send_lock:
+            if self.writer.is_closing():
+                # peer went away between request and reply (e.g. a job
+                # driver exiting). drain() would raise this same error
+                # after the write anyway — skip the write so asyncio's
+                # conn-lost warning counter never fires, but keep the
+                # raise so callers still detect the dead peer.
+                raise ConnectionResetError("peer connection closed")
             self.writer.write(_frame(msg, codec or self.codec))
             await self.writer.drain()
 

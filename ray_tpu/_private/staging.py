@@ -14,10 +14,50 @@ import shutil
 import threading
 
 
+def stage_package(base_dir: str, name: str) -> str:
+    """Extract an uploaded zip package (REST `PUT /api/packages/pkg/<name>`,
+    stored at base_dir/packages/<name>) into runtime_resources, keyed by the
+    zip's content hash, and return the extracted directory. Reference parity:
+    _private/runtime_env/packaging.py download_and_unpack_package — ours
+    reads the head-local package store instead of GCS object storage."""
+    import zipfile
+
+    pkg_path = os.path.join(base_dir, "packages", name)
+    if not os.path.isfile(pkg_path):
+        raise ValueError(f"no such uploaded package {name!r}")
+    h = hashlib.sha1()
+    with open(pkg_path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    dest = os.path.join(base_dir, "runtime_resources", "pkg-" + h.hexdigest()[:16])
+    if not os.path.exists(dest):
+        tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with zipfile.ZipFile(pkg_path) as zf:
+                for info in zf.infolist():
+                    # refuse path traversal (absolute paths / ..)
+                    p = os.path.normpath(info.filename)
+                    if p.startswith("..") or os.path.isabs(p):
+                        raise ValueError(f"unsafe path in package: {info.filename!r}")
+                zf.extractall(tmp)
+            os.rename(tmp, dest)
+        except OSError:
+            if not os.path.exists(dest):
+                raise
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
 def stage_into(base_dir: str, src: str) -> str:
     """Copy `src` (dir or file) under base_dir/runtime_resources/<sig>/ and
     return the staged path. Concurrent stages of the same content are safe:
-    copy to a temp path, then atomically rename."""
+    copy to a temp path, then atomically rename.
+
+    `pkg://<name>` sources resolve against the session's uploaded-package
+    store (Job REST API working-dir upload)."""
+    if src.startswith("pkg://"):
+        return stage_package(base_dir, src[len("pkg://"):])
     h = hashlib.sha1(src.encode())
     for root, _dirs, files in os.walk(src):
         for f in sorted(files):

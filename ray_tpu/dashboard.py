@@ -143,22 +143,59 @@ class Dashboard:
         if self.server is not None:
             self.server.close()
 
+    # largest accepted request body (working-dir package uploads)
+    MAX_BODY = 256 * 1024 * 1024
+
     async def _on_client(self, reader, writer):
         try:
             request = await asyncio.wait_for(reader.readline(), timeout=10)
             parts = request.decode("latin1").split()
+            method = parts[0].upper() if parts else "GET"
             path = parts[1] if len(parts) >= 2 else "/"
-            while True:  # drain headers
+            content_length = 0
+            while True:  # headers: only Content-Length matters to us
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, ctype, body = await self._route(path)
-            writer.write(
-                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
-            )
-            writer.write(body)
-            await writer.drain()
+                name, _, value = line.decode("latin1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        content_length = 0
+            req_body = b""
+            if content_length > self.MAX_BODY:
+                # discard the body first — closing with bytes unread sends
+                # RST and the client never sees the 413
+                remaining = content_length
+                while remaining > 0:
+                    chunk = await asyncio.wait_for(
+                        reader.read(min(remaining, 1 << 20)), timeout=120
+                    )
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                status, ctype, body = self._json(
+                    "413 Payload Too Large",
+                    {"error": f"body exceeds {self.MAX_BODY} bytes"},
+                )
+            elif content_length < 0:
+                status, ctype, body = self._json(
+                    "400 Bad Request", {"error": "bad Content-Length"}
+                )
+            else:
+                if content_length:
+                    req_body = await asyncio.wait_for(
+                        reader.readexactly(content_length), timeout=120
+                    )
+                status, ctype, body = await self._route(path, method, req_body)
+            if not writer.is_closing():  # client may have hung up mid-handle
+                writer.write(
+                    f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+                    + body
+                )
+                await writer.drain()
         except Exception:
             pass
         finally:
@@ -167,11 +204,16 @@ class Dashboard:
             except Exception:
                 pass
 
-    async def _route(self, path: str):
+    async def _route(self, path: str, method: str = "GET", req_body: bytes = b""):
         if path in ("/", "/index.html"):
             return "200 OK", "text/html; charset=utf-8", _PAGE.encode()
         if not path.startswith("/api/"):
             return "404 Not Found", "text/plain", b"not found"
+        bare = path.split("?", 1)[0].rstrip("/")
+        if bare in ("/api/jobs", "/api/packages") or path.startswith(
+            ("/api/jobs/", "/api/packages/")
+        ):
+            return await self._route_rest(path, method, req_body)
         kind, _, query = path[len("/api/"):].partition("?")
         if kind == "profile":
             # /api/profile?worker_id=..&kind=cpu|mem|dump&duration=2
@@ -215,7 +257,7 @@ class Dashboard:
             "workers": {"t": "list_workers"},
             "tasks": {"t": "list_tasks", "limit": 1000},
             "objects": {"t": "list_objects"},
-            "jobs": {"t": "list_jobs"},
+            # "jobs" is served by the REST router above
             "cluster": {"t": "cluster_resources"},
             "timeline": {"t": "timeline"},
             "metrics": {"t": "get_metrics"},
@@ -230,6 +272,115 @@ class Dashboard:
         data = await self.head.handle(None, dict(msg))
         body = json.dumps(data, default=str).encode()
         return "200 OK", "application/json", body
+
+    # ------------------------------------------------------------------
+    # Job REST API (reference: dashboard/modules/job/job_head.py:140,273 —
+    # JobHead's curl-able endpoints: submit/list/info/logs/stop/delete +
+    # working-dir package upload). Same resource shapes over the head's
+    # native job handlers, so curl / CI / a k8s operator can drive the
+    # cluster with zero Python.
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _json(status: str, obj) -> tuple:
+        return status, "application/json", json.dumps(obj, default=str).encode()
+
+    async def _route_rest(self, path: str, method: str, req_body: bytes):
+        try:
+            return await self._route_rest_inner(path, method, req_body)
+        except ValueError as e:
+            msg = str(e)
+            status = "404 Not Found" if "no such job" in msg else "400 Bad Request"
+            return self._json(status, {"error": msg})
+        except Exception as e:
+            return self._json("500 Internal Server Error", {"error": repr(e)})
+
+    async def _route_rest_inner(self, path: str, method: str, req_body: bytes):
+        import os
+
+        segs = [s for s in path.split("?", 1)[0].split("/") if s]  # api jobs ...
+        if segs[1] == "packages":
+            # PUT/GET /api/packages/pkg/<name> — zip upload + existence probe
+            # (reference: job_head.py PUT /api/packages/{protocol}/{name})
+            if len(segs) != 4 or segs[2] != "pkg":
+                return self._json("404 Not Found", {"error": "bad package path"})
+            name = segs[3]
+            if "/" in name or ".." in name or not name:
+                return self._json("400 Bad Request", {"error": "bad package name"})
+            pkg_dir = os.path.join(self.head.session_dir, "packages")
+            pkg_path = os.path.join(pkg_dir, name)
+            if method == "PUT":
+                loop = asyncio.get_running_loop()
+
+                def _write():
+                    import threading
+
+                    os.makedirs(pkg_dir, exist_ok=True)
+                    # pid+tid: concurrent PUTs of the same name are safe
+                    tmp = f"{pkg_path}.tmp-{os.getpid()}-{threading.get_ident()}"
+                    with open(tmp, "wb") as f:
+                        f.write(req_body)
+                    os.replace(tmp, pkg_path)
+
+                await loop.run_in_executor(None, _write)
+                return self._json("200 OK", {"package_uri": f"pkg://{name}"})
+            if method == "GET":
+                if os.path.exists(pkg_path):
+                    return self._json("200 OK", {"package_uri": f"pkg://{name}"})
+                return self._json("404 Not Found", {"error": "no such package"})
+            return self._json("405 Method Not Allowed", {"error": method})
+
+        # /api/jobs[/<id>[/logs|/stop]]
+        if len(segs) == 2:
+            if method == "GET":
+                jobs = await self.head.handle(None, {"t": "list_jobs"})
+                return self._json("200 OK", jobs)
+            if method == "POST":
+                try:
+                    req = json.loads(req_body or b"{}")
+                except json.JSONDecodeError:
+                    return self._json("400 Bad Request", {"error": "invalid JSON body"})
+                if not req.get("entrypoint"):
+                    return self._json("400 Bad Request", {"error": "entrypoint required"})
+                from .runtime_env import RuntimeEnv
+
+                runtime_env = dict(req.get("runtime_env") or {})
+                # pkg:// working_dir resolves against the head's package
+                # store at stage time; local-path validation doesn't apply
+                pkg_wd = None
+                if str(runtime_env.get("working_dir", "")).startswith("pkg://"):
+                    pkg_wd = runtime_env.pop("working_dir")
+                runtime_env = dict(RuntimeEnv.validate(runtime_env) or {})
+                if pkg_wd is not None:
+                    runtime_env["working_dir"] = pkg_wd
+                sid = await self.head.handle(
+                    None,
+                    {
+                        "t": "submit_job",
+                        "entrypoint": req["entrypoint"],
+                        "runtime_env": runtime_env,
+                        "submission_id": req.get("submission_id"),
+                        "metadata": req.get("metadata"),
+                    },
+                )
+                return self._json("200 OK", {"submission_id": sid})
+            return self._json("405 Method Not Allowed", {"error": method})
+        sid = segs[2]
+        if len(segs) == 3:
+            if method == "GET":
+                info = await self.head.handle(None, {"t": "job_info", "submission_id": sid})
+                return self._json("200 OK", info)
+            if method == "DELETE":
+                await self.head.handle(None, {"t": "delete_job", "submission_id": sid})
+                return self._json("200 OK", {"deleted": True})
+            return self._json("405 Method Not Allowed", {"error": method})
+        if len(segs) == 4 and segs[3] == "logs" and method == "GET":
+            logs = await self.head.handle(None, {"t": "job_logs", "submission_id": sid})
+            return self._json("200 OK", {"logs": logs})
+        if len(segs) == 4 and segs[3] == "stop" and method == "POST":
+            stopped = await self.head.handle(None, {"t": "stop_job", "submission_id": sid})
+            return self._json("200 OK", {"stopped": bool(stopped)})
+        return self._json("404 Not Found", {"error": "unknown jobs api"})
 
 
 def dashboard_url(session_dir: str) -> Optional[str]:

@@ -1,10 +1,18 @@
 """Job submission: run an entrypoint command against a live cluster.
 
 Reference parity: python/ray/dashboard/modules/job/ (JobSubmissionClient
-sdk.py, JobStatus, job_manager.py JobSupervisor). A submitted job is a
-shell entrypoint spawned by the head with RAY_TPU_ADDRESS pointing at the
-cluster, so `ray_tpu.init(address="auto")` inside the job attaches to the
-SAME cluster; stdout/stderr stream to a per-job log in the session dir.
+sdk.py, JobStatus, job_manager.py JobSupervisor, job_head.py REST routes).
+A submitted job is a shell entrypoint spawned by the head with
+RAY_TPU_ADDRESS pointing at the cluster, so `ray_tpu.init(address="auto")`
+inside the job attaches to the SAME cluster; stdout/stderr stream to a
+per-job log in the session dir.
+
+Two transports, same client API (mirrors the reference, whose SDK always
+speaks HTTP to the dashboard):
+- native: pickle protocol over the head socket (address=None/'auto'/socket)
+- HTTP:   the dashboard's /api/jobs/ REST routes (address='http://host:port')
+  — with automatic working-dir zip upload (PUT /api/packages/pkg/<name>),
+  matching job_head.py:140,273 + packaging upload semantics.
 """
 
 from __future__ import annotations
@@ -25,12 +33,139 @@ class JobStatus(str, enum.Enum):
         return self in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED)
 
 
-class JobSubmissionClient:
-    """Submits/inspects jobs. With no address, uses the current driver's
-    connection (ray_tpu.init must have run); with address, attaches to that
-    head socket ('auto' = newest live session)."""
+class _HttpBackend:
+    """Speaks the dashboard's Job REST API with only stdlib http.client."""
 
-    def __init__(self, address: Optional[str] = None):
+    def __init__(self, address: str):
+        from urllib.parse import urlparse
+
+        parsed = urlparse(address)
+        if parsed.scheme not in ("http", ""):
+            raise ValueError(f"unsupported scheme {parsed.scheme!r} (http only)")
+        netloc = parsed.netloc or parsed.path  # tolerate 'host:port' w/o scheme
+        host, _, port = netloc.partition(":")
+        self.host, self.port = host, int(port or 80)
+
+    def _http(self, method: str, path: str, body: Optional[bytes] = None,
+              ctype: str = "application/json") -> tuple:
+        import http.client
+        import json
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=120)
+        try:
+            headers = {"Content-Type": ctype} if body is not None else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                data = json.loads(raw) if raw else None
+            except ValueError:
+                data = raw.decode(errors="replace")
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def _ok(self, method: str, path: str, body: Optional[bytes] = None) -> Any:
+        status, data = self._http(method, path, body)
+        if status >= 400:
+            err = data.get("error") if isinstance(data, dict) else data
+            raise RuntimeError(f"{method} {path} -> {status}: {err}")
+        return data
+
+    # never shipped in a working-dir package (reference: packaging.py
+    # always-excluded patterns + user `excludes`)
+    _DEFAULT_EXCLUDES = (".git", "__pycache__", ".venv", "*.pyc")
+
+    def _upload_working_dir(self, working_dir: str, excludes=()) -> str:
+        """Zip a local directory and upload it; return its pkg:// URI.
+        Content-hashed name so identical dirs dedupe (reference:
+        packaging.py get_uri_for_directory + upload_package_if_needed)."""
+        import fnmatch
+        import hashlib
+        import io
+        import os
+        import zipfile
+
+        patterns = list(self._DEFAULT_EXCLUDES) + list(excludes)
+
+        def _excluded(rel: str) -> bool:
+            parts = rel.split(os.sep)
+            return any(
+                fnmatch.fnmatch(part, pat) or fnmatch.fnmatch(rel, pat)
+                for part in parts
+                for pat in patterns
+            )
+
+        h = hashlib.sha1()
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, dirs, files in os.walk(working_dir):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not _excluded(os.path.relpath(os.path.join(root, d), working_dir))
+                )
+                for fname in sorted(files):
+                    p = os.path.join(root, fname)
+                    rel = os.path.relpath(p, working_dir)
+                    if _excluded(rel):
+                        continue
+                    with open(p, "rb") as f:
+                        data = f.read()
+                    h.update(rel.encode())
+                    h.update(data)
+                    # fixed timestamp -> deterministic zip for the same tree
+                    info = zipfile.ZipInfo(rel, date_time=(2020, 1, 1, 0, 0, 0))
+                    zf.writestr(info, data)
+        name = f"ray-pkg-{h.hexdigest()[:20]}.zip"
+        status, _ = self._http("GET", f"/api/packages/pkg/{name}")
+        if status != 200:
+            self._ok("PUT", f"/api/packages/pkg/{name}", buf.getvalue())
+        return f"pkg://{name}"
+
+    def submit(self, entrypoint, runtime_env, submission_id, metadata) -> str:
+        import json
+        import os
+
+        runtime_env = dict(runtime_env or {})
+        wd = runtime_env.get("working_dir")
+        excludes = runtime_env.pop("excludes", ())
+        if wd and not str(wd).startswith("pkg://"):
+            if not os.path.isdir(wd):
+                raise ValueError(f"working_dir {wd!r} is not a directory")
+            runtime_env["working_dir"] = self._upload_working_dir(wd, excludes)
+        body = json.dumps(
+            {
+                "entrypoint": entrypoint,
+                "runtime_env": runtime_env,
+                "submission_id": submission_id,
+                "metadata": metadata,
+            }
+        ).encode()
+        return self._ok("POST", "/api/jobs/", body)["submission_id"]
+
+    def status(self, sid: str) -> str:
+        return self._ok("GET", f"/api/jobs/{sid}")["status"]
+
+    def info(self, sid: str) -> dict:
+        return self._ok("GET", f"/api/jobs/{sid}")
+
+    def logs(self, sid: str) -> str:
+        return self._ok("GET", f"/api/jobs/{sid}/logs")["logs"]
+
+    def list(self) -> List[dict]:
+        return self._ok("GET", "/api/jobs/")
+
+    def stop(self, sid: str) -> bool:
+        return self._ok("POST", f"/api/jobs/{sid}/stop")["stopped"]
+
+    def delete(self, sid: str) -> bool:
+        return self._ok("DELETE", f"/api/jobs/{sid}")["deleted"]
+
+
+class _NativeBackend:
+    """Head-socket pickle protocol (in-process driver connection)."""
+
+    def __init__(self, address: Optional[str]):
         import ray_tpu
         from ray_tpu._private.worker import global_worker
 
@@ -41,16 +176,14 @@ class JobSubmissionClient:
     def _request(self, msg: dict) -> Any:
         return self._worker.request(msg)
 
-    def submit_job(
-        self,
-        *,
-        entrypoint: str,
-        runtime_env: Optional[dict] = None,
-        submission_id: Optional[str] = None,
-        metadata: Optional[Dict[str, str]] = None,
-    ) -> str:
+    def submit(self, entrypoint, runtime_env, submission_id, metadata) -> str:
         from ..runtime_env import RuntimeEnv
 
+        runtime_env = dict(runtime_env or {})
+        # 'excludes' only shapes the HTTP upload zip; the native path stages
+        # the directory in place — accept and ignore it so the same
+        # submit_job call works on both transports
+        runtime_env.pop("excludes", None)
         return self._request(
             {
                 "t": "submit_job",
@@ -61,20 +194,67 @@ class JobSubmissionClient:
             }
         )
 
-    def get_job_status(self, submission_id: str) -> JobStatus:
-        return JobStatus(self._request({"t": "job_status", "submission_id": submission_id}))
+    def status(self, sid: str) -> str:
+        return self._request({"t": "job_status", "submission_id": sid})
 
-    def get_job_info(self, submission_id: str) -> dict:
-        return self._request({"t": "job_info", "submission_id": submission_id})
+    def info(self, sid: str) -> dict:
+        return self._request({"t": "job_info", "submission_id": sid})
 
-    def get_job_logs(self, submission_id: str) -> str:
-        return self._request({"t": "job_logs", "submission_id": submission_id})
+    def logs(self, sid: str) -> str:
+        return self._request({"t": "job_logs", "submission_id": sid})
 
-    def list_jobs(self) -> List[dict]:
+    def list(self) -> List[dict]:
         return self._request({"t": "list_jobs"})
 
+    def stop(self, sid: str) -> bool:
+        return self._request({"t": "stop_job", "submission_id": sid})
+
+    def delete(self, sid: str) -> bool:
+        return self._request({"t": "delete_job", "submission_id": sid})
+
+
+class JobSubmissionClient:
+    """Submits/inspects jobs.
+
+    address=None/'auto'/<socket path>: native head-socket transport using the
+    current driver connection (ray_tpu.init runs if needed).
+    address='http://host:port': the dashboard's REST API — usable from a
+    process with no cluster connection at all, like the reference SDK.
+    """
+
+    def __init__(self, address: Optional[str] = None):
+        if address is not None and str(address).startswith("http"):
+            self._backend = _HttpBackend(address)
+        else:
+            self._backend = _NativeBackend(address)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        runtime_env: Optional[dict] = None,
+        submission_id: Optional[str] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        return self._backend.submit(entrypoint, runtime_env, submission_id, metadata)
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        return JobStatus(self._backend.status(submission_id))
+
+    def get_job_info(self, submission_id: str) -> dict:
+        return self._backend.info(submission_id)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._backend.logs(submission_id)
+
+    def list_jobs(self) -> List[dict]:
+        return self._backend.list()
+
     def stop_job(self, submission_id: str) -> bool:
-        return self._request({"t": "stop_job", "submission_id": submission_id})
+        return self._backend.stop(submission_id)
+
+    def delete_job(self, submission_id: str) -> bool:
+        return self._backend.delete(submission_id)
 
     def wait_until_status(
         self,
