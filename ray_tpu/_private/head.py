@@ -2609,6 +2609,20 @@ class Head:
             self._spawn_bg(self._escalate_kill(job["proc"]))
         return True
 
+    async def _h_report_data_stats(self, conn, msg):
+        """Driver-reported Dataset execution stats (reference: the data
+        module's StatsActor feeding the dashboard's DataHead). Bounded ring:
+        the dashboard shows recent executions, not history."""
+        if not hasattr(self, "_data_stats"):
+            from collections import deque
+
+            self._data_stats = deque(maxlen=50)
+        self._data_stats.append(msg["stats"])
+        return True
+
+    async def _h_data_stats(self, conn, msg):
+        return list(getattr(self, "_data_stats", ()))
+
     async def _h_get_package(self, conn, msg):
         """Serve an uploaded working-dir package's bytes to a node agent so
         pkg:// runtime envs stage on remote nodes too (reference:

@@ -30,6 +30,7 @@ th{background:#f0f0f0} .dead{color:#b00} .alive{color:#080}
 <h2>Actors</h2><table id="actors"></table>
 <h2>Workers</h2><table id="workers"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Dataset executions (recent)</h2><table id="datasets"></table>
 <h2>Tasks (last 50 — click a row for its event timeline)</h2>
 <pre id="taskdetail" style="display:none;background:#fff;border:1px solid #ddd;padding:.5rem"></pre>
 <table id="tasks"></table>
@@ -91,8 +92,8 @@ async function tickLogs(){
 }
 async function tick(){
   try{
-    const [res, nodes, actors, workers, jobs, tasks, hist] = await Promise.all(
-      ["cluster","nodes","actors","workers","jobs","tasks","node_history"].map(
+    const [res, nodes, actors, workers, jobs, tasks, hist, dstats] = await Promise.all(
+      ["cluster","nodes","actors","workers","jobs","tasks","node_history","data_stats"].map(
         p=>fetch("/api/"+p).then(r=>r.json())));
     document.getElementById("res").textContent =
       Object.entries(res.total).map(([k,v])=>
@@ -109,6 +110,13 @@ async function tick(){
     fill("actors", actors, ["actor_id","class_name","name","state","worker_id"]);
     fill("workers", workers, ["worker_id","node_id","state","actor_id","pid"]);
     fill("jobs", jobs, ["submission_id","status","entrypoint","log_path"]);
+    fill("datasets", dstats.slice(-10).reverse().map(s=>({
+      pipeline: s.operators.map(o=>o.name).join(" → "),
+      blocks: s.blocks, rows: s.output_rows,
+      total_ms: Math.round(s.total_s*1000),
+      wait_ms: Math.round(s.iter_wait_s*1000),
+      where: s.executed_remotely ? "cluster" : "driver",
+    })), ["pipeline","blocks","rows","total_ms","wait_ms","where"]);
     taskRows = tasks;
     fill("tasks", tasks.slice(-50).reverse(),
          ["task_id","name","state","node_id","worker_id"], showTask);
@@ -265,6 +273,7 @@ class Dashboard:
             "pgs": {"t": "pg_table"},
             "node_history": {"t": "node_history"},
             "object_stats": {"t": "object_stats"},
+            "data_stats": {"t": "data_stats"},
         }
         msg = handlers.get(kind)
         if msg is None:

@@ -1,0 +1,180 @@
+"""Per-operator dataset execution stats.
+
+Reference parity: python/ray/data/_internal/stats.py (DatasetStats /
+StatsManager) — per-operator wall time, output rows, output bytes, block
+counts, plus driver-side iterator timings (time blocked waiting on the
+cluster vs. total). The reference threads a StatsActor through the
+streaming executor; ray_tpu's per-block op chain lets each task time its
+own ops and ship the rows back WITH the block, so stats cost one tuple
+per (block, op) and no extra RPCs.
+
+Stats answer the question that matters on TPU: is the input pipeline
+keeping the chip fed, and if not, which operator is the bottleneck.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# one measurement: (op_index, wall_s, rows_out, bytes_out); op_index -1 is
+# the source read
+StatRow = Tuple[int, float, int, int]
+
+
+def timed_apply(apply_fn, block, ops, cache=None) -> Tuple[Any, List[StatRow]]:
+    """Run `apply_fn(block, [op], cache)` per op, timing each: the remote
+    side of stats collection (runs inside tasks / pool actors)."""
+    from .dataset import _block_num_rows, _block_size_bytes
+
+    rows: List[StatRow] = []
+    for i, op in enumerate(ops):
+        t0 = time.perf_counter()
+        block = apply_fn(block, [op], cache)
+        wall = time.perf_counter() - t0
+        rows.append((i, wall, _block_num_rows(block), _block_size_bytes(block)))
+    return block, rows
+
+
+def read_stat(wall: float, block) -> StatRow:
+    from .dataset import _block_num_rows, _block_size_bytes
+
+    return (-1, wall, _block_num_rows(block), _block_size_bytes(block))
+
+
+class _OpAcc:
+    __slots__ = ("name", "wall_s", "max_wall_s", "rows", "bytes", "blocks")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.wall_s = 0.0
+        self.max_wall_s = 0.0
+        self.rows = 0
+        self.bytes = 0
+        self.blocks = 0
+
+    def add(self, wall: float, rows: int, nbytes: int):
+        self.wall_s += wall
+        self.max_wall_s = max(self.max_wall_s, wall)
+        self.rows += rows
+        self.bytes += nbytes
+        self.blocks += 1
+
+
+def _op_name(op) -> str:
+    kind = op.kind
+    if kind == "row_chain":
+        steps = getattr(op.fn, "_steps", ())
+        return "row_chain(%s)" % ",".join(k for k, _ in steps)
+    return kind
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+class DatasetStats:
+    """Driver-side aggregate for one dataset execution."""
+
+    def __init__(self, ops: List[Any], executed_remotely: bool):
+        self.op_accs: List[_OpAcc] = [_OpAcc("read")] + [
+            _OpAcc(_op_name(op)) for op in ops
+        ]
+        self.executed_remotely = executed_remotely
+        self.iter_wait_s = 0.0  # driver blocked on the cluster (get)
+        self.total_s = 0.0  # first submit -> iterator exhausted/closed
+        self.blocks = 0
+        self.finished = False
+        self._t0 = time.perf_counter()
+
+    def record(self, stat_rows: List[StatRow]):
+        self.blocks += 1
+        for idx, wall, rows, nbytes in stat_rows:
+            acc = self.op_accs[idx + 1]
+            acc.add(wall, rows, nbytes)
+
+    def add_wait(self, dt: float):
+        self.iter_wait_s += dt
+
+    def close(self, finished: bool):
+        if not self.finished:
+            self.total_s = time.perf_counter() - self._t0
+            self.finished = finished
+
+    @property
+    def output_rows(self) -> int:
+        for acc in reversed(self.op_accs):
+            if acc.blocks:
+                return acc.rows
+        return 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "operators": [
+                {
+                    "name": a.name,
+                    "wall_s": round(a.wall_s, 6),
+                    "max_block_wall_s": round(a.max_wall_s, 6),
+                    "rows": a.rows,
+                    "bytes": a.bytes,
+                    "blocks": a.blocks,
+                }
+                for a in self.op_accs
+                if a.blocks
+            ],
+            "iter_wait_s": round(self.iter_wait_s, 6),
+            "total_s": round(self.total_s, 6),
+            "blocks": self.blocks,
+            "output_rows": self.output_rows,
+            "executed_remotely": self.executed_remotely,
+            "finished": self.finished,
+        }
+
+    def summary(self) -> str:
+        """Human-readable per-operator table (reference: DatasetStats
+        __repr__ / Dataset.stats() output)."""
+        lines = []
+        where = "cluster tasks" if self.executed_remotely else "driver process"
+        state = "" if self.finished else " (iteration stopped early)"
+        lines.append(
+            f"Dataset execution over {self.blocks} blocks on {where}{state}:"
+        )
+        for a in self.op_accs:
+            if not a.blocks:
+                continue
+            avg_ms = 1000.0 * a.wall_s / a.blocks
+            lines.append(
+                f"  {a.name}: {a.wall_s * 1000:.1f}ms total"
+                f" (avg {avg_ms:.2f}ms/block, max {a.max_wall_s * 1000:.1f}ms),"
+                f" {a.rows} rows out, {_fmt_bytes(a.bytes)} out,"
+                f" {a.blocks} blocks"
+            )
+        lines.append(
+            f"  iterator: {self.total_s * 1000:.1f}ms total,"
+            f" {self.iter_wait_s * 1000:.1f}ms blocked waiting on blocks"
+            f" ({100.0 * self.iter_wait_s / self.total_s if self.total_s else 0:.0f}%"
+            " of wall)"
+        )
+        lines.append(f"  output rows: {self.output_rows}")
+        return "\n".join(lines)
+
+
+def publish(stats: "DatasetStats", label: Optional[str] = None):
+    """Best-effort push of a finished execution's stats to the head so the
+    dashboard's Datasets panel can show them (reference: StatsActor feeding
+    dashboard/data's DataHead). Never raises; never blocks the iterator."""
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        if not global_worker.connected:
+            return
+        payload = stats.to_dict()
+        payload["label"] = label
+        payload["time"] = time.time()
+        global_worker.request({"t": "report_data_stats", "stats": payload})
+    except Exception:
+        pass

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import builtins
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
@@ -197,6 +198,20 @@ def _execute_block(block_fn, ops: List[_Op]):
     return _apply_ops(block_fn(), ops)
 
 
+def _execute_block_stats(block_fn, ops: List[_Op], cache=None):
+    """_execute_block variant for the streaming iterator: returns
+    (block, per-op stat rows) — the executing side times its own read + ops
+    and ships the measurements back with the block (_stats.py). Used by
+    task execution, pool actors, and the local (driver-process) path."""
+    from . import _stats
+
+    t0 = time.perf_counter()
+    block = block_fn()
+    read = _stats.read_stat(time.perf_counter() - t0, block)
+    block, rows = _stats.timed_apply(_apply_ops, block, ops, cache)
+    return block, [read] + rows
+
+
 class _MapWorker:
     """Stateful pool worker for compute="actors" map operators: the op
     chain (and any callable-class state) lives for the actor's lifetime
@@ -207,7 +222,7 @@ class _MapWorker:
         self._cache: Dict[int, Callable] = {}
 
     def run(self, block_fn):
-        return _apply_ops(block_fn(), self._ops, self._cache)
+        return _execute_block_stats(block_fn, self._ops, self._cache)
 
 
 def _block_size_bytes(block) -> int:
@@ -562,6 +577,7 @@ class Dataset:
 
         capped = Dataset(self._block_fns, push_limit(self._ops, n),
                          read_meta=self._read_meta)
+        capped._stats_sink = self
         taken = []
         remaining = n
         for block in capped._iter_computed_blocks():
@@ -746,16 +762,35 @@ class Dataset:
         a pool of stateful _MapWorker actors (round-robin, same windowing)."""
         import ray_tpu
 
+        from . import _stats
         from ._plan import optimize, pushdown_reads
 
         block_fns, ops = pushdown_reads(self._read_meta, self._block_fns, self._ops)
         ops = optimize(ops)
         use_cluster = parallel and ray_tpu.is_initialized() and len(block_fns) > 1
 
+        # per-execution stats live on the dataset the USER executed (take()/
+        # count() run internal derived datasets; _stats_sink points back).
+        # sink=None (schema()'s probe) collects without attaching/publishing,
+        # so a metadata peek never clobbers a real execution's stats.
+        sink = getattr(self, "_stats_sink", self)
+        stats = _stats.DatasetStats(ops, use_cluster)
+        if sink is not None:
+            sink._last_stats = stats
+
         if not use_cluster:
-            cache: Dict[int, Callable] = {}
-            for fn in block_fns:
-                yield _apply_ops(fn(), ops, cache)
+            completed = False
+            try:
+                cache: Dict[int, Callable] = {}
+                for fn in block_fns:
+                    block, stat_rows = _execute_block_stats(fn, ops, cache)
+                    stats.record(stat_rows)
+                    yield block
+                completed = True
+            finally:
+                stats.close(completed)
+                if completed and sink is not None:
+                    _stats.publish(stats)
             return
 
         actor_ops = [op for op in ops if op.compute == "actors"]
@@ -772,7 +807,7 @@ class Dataset:
             def submit(fn):
                 return next(rr).run.remote(fn)
         else:
-            exec_task = ray_tpu.remote(_execute_block)
+            exec_task = ray_tpu.remote(_execute_block_stats)
 
             def submit(fn):
                 return exec_task.remote(fn, ops)
@@ -789,6 +824,7 @@ class Dataset:
                 return 1
             return max(1, min(window, int(max_in_flight_bytes // max(1.0, avg_bytes))))
 
+        completed = False
         try:
             pending: List[Any] = []
             fn_iter = iter(block_fns)
@@ -796,8 +832,11 @@ class Dataset:
                 pending.append(submit(fn))
             while pending:
                 ref = pending.pop(0)
-                block = ray_tpu.get(ref)
-                size = _block_size_bytes(block)
+                t0 = time.perf_counter()
+                block, stat_rows = ray_tpu.get(ref)
+                stats.add_wait(time.perf_counter() - t0)
+                stats.record(stat_rows)
+                size = stat_rows[-1][3]  # always >=1 row: the read stat
                 avg_bytes = (avg_bytes * fetched + size) / (fetched + 1)
                 fetched += 1
                 while len(pending) < effective_window():
@@ -806,7 +845,13 @@ class Dataset:
                         break
                     pending.append(submit(nxt))
                 yield block
+            completed = True
         finally:
+            stats.close(completed)
+            if completed and sink is not None:
+                # publish only on normal completion: abandoned iterators
+                # finalize from GC, where a head round-trip is unsafe
+                _stats.publish(stats)
             for a in actors:
                 try:
                     ray_tpu.kill(a)
@@ -902,6 +947,7 @@ class Dataset:
 
         capped = Dataset(self._block_fns, push_limit(self._ops, limit),
                          read_meta=self._read_meta)
+        capped._stats_sink = self
         out = []
         for row in capped.iter_rows():
             out.append(row)
@@ -923,6 +969,7 @@ class Dataset:
         while ops and _preserves_row_count(ops[-1]):
             ops.pop()
         pruned = Dataset(self._block_fns, ops, read_meta=self._read_meta)
+        pruned._stats_sink = self
         return sum(_block_num_rows(b) for b in pruned._iter_computed_blocks())
 
     def explain(self) -> str:
@@ -931,8 +978,30 @@ class Dataset:
 
         return explain(self._ops)
 
+    def stats(self) -> str:
+        """Per-operator execution stats for this dataset's LAST execution:
+        wall time, rows out, bytes out per operator, plus how long the
+        consuming iterator sat blocked waiting for blocks (reference:
+        Dataset.stats() over _internal/stats.py DatasetStats). Execute the
+        dataset first (iterate/take/count/materialize), then read stats."""
+        st = getattr(self, "_last_stats", None)
+        if st is None:
+            return (
+                "Dataset has not been executed yet. stats() reports the "
+                "last execution (iterate, take, count, or materialize first)."
+            )
+        return st.summary()
+
+    def stats_dict(self) -> Optional[Dict[str, Any]]:
+        """Structured form of stats() (None before first execution)."""
+        st = getattr(self, "_last_stats", None)
+        return st.to_dict() if st is not None else None
+
     def schema(self):
-        for block in self._iter_computed_blocks(parallel=False):
+        # metadata probe: must not clobber the last REAL execution's stats
+        probe = Dataset(self._block_fns, self._ops, read_meta=self._read_meta)
+        probe._stats_sink = None
+        for block in probe._iter_computed_blocks(parallel=False):
             if isinstance(block, dict):
                 return {k: getattr(v, "dtype", type(v)) for k, v in block.items()}
             try:

@@ -1,0 +1,151 @@
+"""Dataset.stats(): per-operator wall/rows/bytes for the last execution.
+
+Reference parity: python/ray/data/_internal/stats.py (DatasetStats) +
+Dataset.stats() — per-operator timing collected IN the execution tasks and
+shipped back with each block, plus driver-side iterator wait accounting.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture
+def started():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_stats_before_execution():
+    ds = data.range(100)
+    assert "has not been executed" in ds.stats()
+    assert ds.stats_dict() is None
+
+
+def test_stats_local_pipeline():
+    """Driver-process execution still gets per-op rows (no cluster)."""
+    ds = data.range(1000, override_num_blocks=4).map_batches(
+        lambda b: {"id": b["id"] * 2}
+    ).filter(lambda r: r["id"] % 3 == 0)
+    ds.take_all()
+    d = ds.stats_dict()
+    assert d is not None and d["finished"]
+    names = [o["name"] for o in d["operators"]]
+    assert names[0] == "read"
+    assert "map_batches" in names
+    # filter is fused into a row_chain by the optimizer
+    assert any("filter" in n for n in names)
+    read = d["operators"][0]
+    assert read["rows"] == 1000 and read["blocks"] == 4
+    filt = [o for o in d["operators"] if "filter" in o["name"]][0]
+    assert filt["rows"] == d["output_rows"] < 1000
+    assert all(o["wall_s"] >= 0 for o in d["operators"])
+    s = ds.stats()
+    assert "read" in s and "rows out" in s and "iterator" in s
+
+
+def test_stats_cluster_pipeline(started):
+    """Stats ride back from real remote tasks; a deliberately slow op
+    dominates its operator's wall time."""
+
+    def slow(b):
+        time.sleep(0.05)
+        return {"x": b["x"] + 1}
+
+    ds = data.from_numpy(np.arange(400), override_num_blocks=4)
+    ds = ds.map_batches(lambda b: {"x": b["data"]}).map_batches(slow)
+    rows = ds.take_all()
+    assert len(rows) == 400
+    d = ds.stats_dict()
+    assert d["executed_remotely"] and d["finished"]
+    assert d["blocks"] == 4
+    ops = {o["name"]: o for o in d["operators"]}
+    assert ops["read"]["rows"] == 400
+    # stats report the OPTIMIZED plan: the two stateless map_batches fuse
+    # into one op (fuse_map_batches), whose wall carries the slow fn
+    mb = [o for o in d["operators"] if o["name"] == "map_batches"]
+    assert len(mb) == 1
+    assert mb[0]["wall_s"] >= 4 * 0.05  # the slow op: 4 blocks x 50ms
+    assert mb[0]["bytes"] > 0 and mb[0]["blocks"] == 4
+
+
+def test_stats_count_and_take_attach_to_parent(started):
+    ds = data.range(500, override_num_blocks=4).map(lambda r: {"id": r["id"] + 1})
+    assert ds.count() == 500
+    d = ds.stats_dict()
+    assert d is not None and d["finished"]
+    ds.take(5)
+    d2 = ds.stats_dict()
+    assert d2 is not None
+
+
+def test_schema_probe_keeps_real_stats(started):
+    """schema() is a metadata peek; it must not replace the stats of the
+    execution the user actually measured."""
+    ds = data.range(400, override_num_blocks=4).map(lambda r: {"id": r["id"]})
+    ds.take_all()
+    d = ds.stats_dict()
+    assert d["finished"] and d["blocks"] == 4
+    ds.schema()
+    assert ds.stats_dict() == d
+
+
+def test_limit_attaches_stats_to_parent(started):
+    ds = data.range(600, override_num_blocks=4)
+    ds.limit(5)
+    assert ds.stats_dict() is not None
+
+
+def test_stats_early_stop_marked():
+    ds = data.range(10_000, override_num_blocks=8)
+    it = ds.iter_batches(batch_size=10)
+    next(it)
+    it.close()
+    d = ds.stats_dict()
+    assert d is not None and not d["finished"]
+
+
+def test_stats_actor_pool(started):
+    """compute='actors' chains report stats from the pool workers too."""
+
+    class AddOne:
+        def __call__(self, b):
+            return {"id": b["id"] + 1}
+
+    ds = data.range(200, override_num_blocks=4).map_batches(
+        AddOne, compute="actors", num_actors=2
+    )
+    out = ds.take_all()
+    assert len(out) == 200
+    d = ds.stats_dict()
+    assert d["executed_remotely"]
+    assert any(o["name"] == "map_batches" and o["rows"] == 200 for o in d["operators"])
+
+
+def test_stats_published_to_dashboard(started):
+    """Finished executions surface in the head's /api/data_stats ring
+    (reference: StatsActor -> dashboard DataHead)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.dashboard import dashboard_url
+
+    ds = data.range(300, override_num_blocks=4).map(lambda r: {"id": r["id"] * 2})
+    ds.take_all()
+    url = dashboard_url(global_worker.session_dir)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with urllib.request.urlopen(url + "/api/data_stats", timeout=10) as resp:
+            entries = json.loads(resp.read())
+        if entries:
+            break
+        time.sleep(0.2)
+    assert entries, "no data stats reached the head"
+    last = entries[-1]
+    assert last["output_rows"] == 300
+    assert any(o["name"] == "read" for o in last["operators"])
