@@ -116,6 +116,10 @@ D("head_snapshot_path", str, "",
 D("head_restore_path", str, "",
   "restore head state from this snapshot at startup (reference: GCS "
   "restart reload, gcs_init_data.h)")
+D("head_storage_dir", str, "/tmp/ray_tpu/storage",
+  "head-hosted object storage root for head:// URIs (checkpoints, "
+  "experiment state); stable across sessions so a restarted cluster on "
+  "the same head host can restore by URI")
 D("head_reconnect_timeout_s", float, 60.0,
   "how long agents/workers/drivers keep retrying the head address after "
   "their connection drops (head crash + restart-from-snapshot window)")

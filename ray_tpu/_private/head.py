@@ -30,6 +30,7 @@ import asyncio
 import collections
 import logging
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -2608,6 +2609,118 @@ class Head:
             self._terminate_job_proc(job["proc"])
             self._spawn_bg(self._escalate_kill(job["proc"]))
         return True
+
+    # ------------------------------------------------------------------
+    # head:// storage plane (reference: the role object storage / a redis-
+    # backed GCS plays for air checkpoints — here a chunked tar transfer
+    # onto the head host's stable storage dir; train/storage.py is the
+    # client). Keys are sanitized relative paths; payloads stream in
+    # bounded chunks so a multi-GB checkpoint never lands in one message.
+    # ------------------------------------------------------------------
+
+    def _stor_path(self, key: str) -> str:
+        root = os.path.abspath(cfg.head_storage_dir)
+        norm = os.path.normpath(key)
+        if norm.startswith("..") or os.path.isabs(norm) or not norm or norm == ".":
+            raise ValueError(f"bad storage key {key!r}")
+        return os.path.join(root, norm + ".tar")
+
+    _STOR_UPLOAD_IDLE_S = 3600.0  # reap uploads abandoned by dead clients
+
+    def _stor_reap_uploads(self):
+        """Close + delete upload sessions idle past the reap window, and
+        sweep orphaned .up-* tmp files (e.g. from a previous head crash).
+        Lazy: runs on each stor_begin, so a long-lived head can't leak fds
+        or disk to clients that died mid-upload."""
+        now = time.time()
+        for token, (f, tmp, _path, last) in list(self._stor_uploads.items()):
+            if now - last > self._STOR_UPLOAD_IDLE_S:
+                del self._stor_uploads[token]
+                f.close()
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        live_tmp = {t[1] for t in self._stor_uploads.values()}
+        root = os.path.abspath(cfg.head_storage_dir)
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                p = os.path.join(dirpath, name)
+                if ".up-" in name and p not in live_tmp:
+                    try:
+                        if now - os.path.getmtime(p) > self._STOR_UPLOAD_IDLE_S:
+                            os.remove(p)
+                    except OSError:
+                        pass
+
+    async def _h_stor_begin(self, conn, msg):
+        import uuid as _uuid
+
+        path = self._stor_path(msg["key"])  # validates the key up front
+        if not hasattr(self, "_stor_uploads"):
+            self._stor_uploads = {}
+        self._stor_reap_uploads()
+        token = _uuid.uuid4().hex
+        tmp = f"{path}.up-{token}"
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
+        self._stor_uploads[token] = (open(tmp, "wb"), tmp, path, time.time())
+        return token
+
+    async def _h_stor_chunk(self, conn, msg):
+        f, tmp, path, _last = self._stor_uploads[msg["token"]]
+        self._stor_uploads[msg["token"]] = (f, tmp, path, time.time())
+        await asyncio.get_running_loop().run_in_executor(None, f.write, msg["data"])
+        return True
+
+    async def _h_stor_end(self, conn, msg):
+        f, tmp, path, _last = self._stor_uploads.pop(msg["token"])
+        f.close()
+        os.replace(tmp, path)
+        return True
+
+    async def _h_stor_size(self, conn, msg):
+        try:
+            return os.path.getsize(self._stor_path(msg["key"]))
+        except FileNotFoundError:
+            return None
+
+    async def _h_stor_read(self, conn, msg):
+        path = self._stor_path(msg["key"])
+        offset, size = msg["offset"], msg["size"]
+
+        def _read():
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(size)
+
+        return await asyncio.get_running_loop().run_in_executor(None, _read)
+
+    async def _h_stor_del(self, conn, msg):
+        path = self._stor_path(msg["key"])
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        # a key may also be a PREFIX of per-file keys (workflow sync lays
+        # out <wf>/meta.json, <wf>/steps/... as individual objects)
+        shutil.rmtree(path[: -len(".tar")], ignore_errors=True)
+        return True
+
+    async def _h_stor_list(self, conn, msg):
+        root = os.path.abspath(cfg.head_storage_dir)
+        norm = os.path.normpath(msg["prefix"])
+        if norm.startswith("..") or os.path.isabs(norm) or not norm or norm == ".":
+            raise ValueError(f"bad storage prefix {msg['prefix']!r}")
+        prefix = os.path.join(root, norm)
+        if not os.path.isdir(prefix):
+            return []
+        out = []
+        for name in sorted(os.listdir(prefix)):
+            if name.endswith(".tar") and ".up-" not in name:
+                out.append(name[: -len(".tar")])
+            elif os.path.isdir(os.path.join(prefix, name)):
+                out.append(name)
+        return out
 
     async def _h_report_data_stats(self, conn, msg):
         """Driver-reported Dataset execution stats (reference: the data

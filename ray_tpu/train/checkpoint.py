@@ -51,11 +51,37 @@ class Checkpoint:
     def __reduce__(self):
         return (Checkpoint, (self.path, self.metrics))
 
+    # ---- URI persistence (reference: air/checkpoint.py:707 to_uri,
+    # :735 from_uri — pyarrow-fs upload/download; ours rides the
+    # train/storage.py scheme registry: file:// head:// gs://) ----
+
+    def to_uri(self, uri: str) -> str:
+        """Upload this checkpoint's directory to a storage URI."""
+        from . import storage
+
+        return storage.upload_dir(self.path, uri)
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """Download a checkpoint from a storage URI into a local dir."""
+        from . import storage
+
+        return cls(storage.download_dir(uri))
+
 
 def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None) -> str:
-    """Save a (sharded) pytree state with orbax; returns the checkpoint dir."""
+    """Save a (sharded) pytree state with orbax; returns the checkpoint dir
+    (or the URI when `path` is one — saved locally, then uploaded)."""
     import orbax.checkpoint as ocp
 
+    from . import storage
+
+    if storage.is_uri(path):
+        uri = storage.uri_join(path, f"step_{step}") if step is not None else path
+        local = save_checkpoint(tempfile.mkdtemp(prefix="ray_tpu_ckpt_"), state)
+        storage.upload_dir(local, uri)
+        shutil.rmtree(local, ignore_errors=True)
+        return uri
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
@@ -70,9 +96,15 @@ def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None) -> str
 
 def restore_checkpoint(path: str, abstract_state: Any) -> Any:
     """Restore into the sharding/layout described by abstract_state
-    (jax.eval_shape output with shardings attached, or a concrete state)."""
+    (jax.eval_shape output with shardings attached, or a concrete state).
+    `path` may be a storage URI (downloaded first — multi-host restore
+    without shared disk)."""
     import orbax.checkpoint as ocp
 
+    from . import storage
+
+    if storage.is_uri(path):
+        path = storage.download_dir(path)
     ckptr = ocp.StandardCheckpointer()
     out = ckptr.restore(os.path.abspath(path), abstract_state)
     ckptr.close()

@@ -1,0 +1,321 @@
+"""URI storage providers: move checkpoint/experiment directories between
+hosts without shared disk.
+
+Reference parity: ray.air.checkpoint.Checkpoint.to_uri/from_uri
+(air/checkpoint.py:707,735) + air/_internal/remote_storage.py (the
+pyarrow-fs upload/download helpers behind them). The reference leans on
+fsspec/pyarrow cloud filesystems; ray_tpu ships a small scheme registry
+with three providers:
+
+- file://   — local or NFS paths (copy).
+- head://   — the CLUSTER's own storage: a chunked upload/download plane on
+  the head, persisted under a stable directory on the head host
+  (config: head_storage_dir), independent of the session. This is what
+  makes multi-host restore work with zero external infrastructure: any
+  node (or a new driver after a cluster restart on the same head host)
+  can fetch by URI.
+- gs://     — Google Cloud Storage via the `gsutil` CLI (TPU pod hosts ship
+  it); errors clearly when unavailable. The transfer tool is pluggable for
+  tests (RAY_TPU_GSUTIL env var).
+
+Register custom schemes with `register_storage("s3", provider)`.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import tempfile
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+_CHUNK = 8 * 1024 * 1024
+
+
+class StorageProvider:
+    """One URI scheme's transfer operations. Directories are the unit."""
+
+    def upload_dir(self, local_dir: str, uri: str) -> str:
+        raise NotImplementedError
+
+    def download_dir(self, uri: str, local_dir: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def list(self, uri: str) -> List[str]:
+        """Immediate children under a URI prefix (names, not full URIs)."""
+        raise NotImplementedError
+
+    def upload_file(self, local_path: str, uri: str) -> str:
+        """Single-file upload — incremental writers (workflow step sync)
+        push one file per durability point instead of re-shipping dirs."""
+        raise NotImplementedError
+
+    def download_file(self, uri: str, local_path: str) -> str:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# file://
+# --------------------------------------------------------------------------
+
+
+def _file_path(uri: str) -> str:
+    p = urlparse(uri)
+    return os.path.abspath(os.path.join("/", p.netloc + p.path))
+
+
+class FileStorage(StorageProvider):
+    def upload_dir(self, local_dir: str, uri: str) -> str:
+        dest = _file_path(uri)
+        if os.path.abspath(local_dir) != dest:
+            os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
+            shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+        return uri
+
+    def download_dir(self, uri: str, local_dir: str) -> str:
+        src = _file_path(uri)
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"no directory at {uri}")
+        if os.path.abspath(local_dir) != src:
+            shutil.copytree(src, local_dir, dirs_exist_ok=True)
+        return local_dir
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(_file_path(uri))
+
+    def delete(self, uri: str) -> None:
+        shutil.rmtree(_file_path(uri), ignore_errors=True)
+
+    def list(self, uri: str) -> List[str]:
+        p = _file_path(uri)
+        return sorted(os.listdir(p)) if os.path.isdir(p) else []
+
+    def upload_file(self, local_path: str, uri: str) -> str:
+        dest = _file_path(uri)
+        os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
+        shutil.copy2(local_path, dest)
+        return uri
+
+    def download_file(self, uri: str, local_path: str) -> str:
+        src = _file_path(uri)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(f"no file at {uri}")
+        os.makedirs(os.path.dirname(local_path) or "/", exist_ok=True)
+        shutil.copy2(src, local_path)
+        return local_path
+
+
+# --------------------------------------------------------------------------
+# head:// — cluster-hosted storage (chunked over the head protocol)
+# --------------------------------------------------------------------------
+
+
+def _head_key(uri: str) -> str:
+    p = urlparse(uri)
+    key = (p.netloc + p.path).strip("/")
+    norm = os.path.normpath(key)
+    if not key or norm.startswith("..") or os.path.isabs(norm):
+        raise ValueError(f"bad head:// key {key!r}")
+    return norm
+
+
+class HeadStorage(StorageProvider):
+    """Directories travel as tar streams in chunks over the head socket;
+    the head persists them under head_storage_dir (survives the session).
+    Requires a live cluster connection (ray_tpu.init)."""
+
+    def _worker(self):
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        if not global_worker.connected:
+            ray_tpu.init(address="auto")
+        return global_worker
+
+    def _put_path(self, local_path: str, key: str):
+        w = self._worker()
+        token = w.request({"t": "stor_begin", "key": key})
+        with open(local_path, "rb") as f:
+            while True:
+                chunk = f.read(_CHUNK)
+                if not chunk:
+                    break
+                w.request({"t": "stor_chunk", "token": token, "data": chunk})
+        w.request({"t": "stor_end", "token": token})
+
+    def _get_path(self, key: str, out, uri: str):
+        """Stream key's bytes into file object `out`."""
+        w = self._worker()
+        size = w.request({"t": "stor_size", "key": key})
+        if size is None:
+            raise FileNotFoundError(f"no object at {uri}")
+        off = 0
+        while off < size:
+            data = w.request(
+                {"t": "stor_read", "key": key, "offset": off, "size": _CHUNK}
+            )
+            if not data:  # object replaced by a smaller one mid-read
+                raise RuntimeError(
+                    f"{uri} truncated during download (concurrent overwrite?)"
+                )
+            out.write(data)
+            off += len(data)
+
+    def upload_dir(self, local_dir: str, uri: str) -> str:
+        with tempfile.NamedTemporaryFile(suffix=".tar") as tf:
+            with tarfile.open(tf.name, "w") as tar:
+                tar.add(local_dir, arcname=".")
+            self._put_path(tf.name, _head_key(uri))
+        return uri
+
+    def download_dir(self, uri: str, local_dir: str) -> str:
+        os.makedirs(local_dir, exist_ok=True)
+        with tempfile.NamedTemporaryFile(suffix=".tar") as tf:
+            self._get_path(_head_key(uri), tf, uri)
+            tf.flush()
+            with tarfile.open(tf.name) as tar:
+                tar.extractall(local_dir, filter="data")
+        return local_dir
+
+    def upload_file(self, local_path: str, uri: str) -> str:
+        self._put_path(local_path, _head_key(uri))
+        return uri
+
+    def download_file(self, uri: str, local_path: str) -> str:
+        os.makedirs(os.path.dirname(local_path) or "/", exist_ok=True)
+        tmp = f"{local_path}.dl-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as out:
+                self._get_path(_head_key(uri), out, uri)
+            os.replace(tmp, local_path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        return local_path
+
+    def exists(self, uri: str) -> bool:
+        return self._worker().request({"t": "stor_size", "key": _head_key(uri)}) is not None
+
+    def delete(self, uri: str) -> None:
+        self._worker().request({"t": "stor_del", "key": _head_key(uri)})
+
+    def list(self, uri: str) -> List[str]:
+        return self._worker().request({"t": "stor_list", "prefix": _head_key(uri)})
+
+
+# --------------------------------------------------------------------------
+# gs:// — gsutil CLI (pluggable binary for tests / airgapped CI)
+# --------------------------------------------------------------------------
+
+
+class GcsStorage(StorageProvider):
+    def _tool(self) -> List[str]:
+        tool = os.environ.get("RAY_TPU_GSUTIL") or shutil.which("gsutil")
+        if not tool:
+            raise RuntimeError(
+                "gs:// storage needs the gsutil CLI (not found on PATH; "
+                "set RAY_TPU_GSUTIL to override)"
+            )
+        return [tool]
+
+    def _run(self, *args: str, check: bool = True):
+        import subprocess
+
+        proc = subprocess.run(
+            self._tool() + list(args), capture_output=True, text=True
+        )
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"gsutil {' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        return proc
+
+    def upload_dir(self, local_dir: str, uri: str) -> str:
+        # trailing-slash contract: copy CONTENTS of local_dir under uri
+        self._run("-m", "rsync", "-r", local_dir, uri.rstrip("/"))
+        return uri
+
+    def download_dir(self, uri: str, local_dir: str) -> str:
+        os.makedirs(local_dir, exist_ok=True)
+        self._run("-m", "rsync", "-r", uri.rstrip("/"), local_dir)
+        return local_dir
+
+    def exists(self, uri: str) -> bool:
+        return self._run("ls", uri, check=False).returncode == 0
+
+    def delete(self, uri: str) -> None:
+        self._run("-m", "rm", "-r", uri, check=False)
+
+    def list(self, uri: str) -> List[str]:
+        proc = self._run("ls", uri.rstrip("/") + "/", check=False)
+        out = []
+        for line in proc.stdout.splitlines():
+            line = line.strip().rstrip("/")
+            if line:
+                out.append(line.rsplit("/", 1)[-1])
+        return out
+
+    def upload_file(self, local_path: str, uri: str) -> str:
+        self._run("cp", local_path, uri)
+        return uri
+
+    def download_file(self, uri: str, local_path: str) -> str:
+        os.makedirs(os.path.dirname(local_path) or "/", exist_ok=True)
+        proc = self._run("cp", uri, local_path, check=False)
+        if proc.returncode != 0:
+            raise FileNotFoundError(f"gsutil cp failed for {uri}: {proc.stderr.strip()}")
+        return local_path
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+_PROVIDERS: Dict[str, StorageProvider] = {
+    "file": FileStorage(),
+    "head": HeadStorage(),
+    "gs": GcsStorage(),
+}
+
+
+def register_storage(scheme: str, provider: StorageProvider) -> None:
+    _PROVIDERS[scheme] = provider
+
+
+def is_uri(path: Optional[str]) -> bool:
+    return bool(path) and "://" in str(path)
+
+
+def get_storage(uri: str) -> StorageProvider:
+    scheme = urlparse(uri).scheme
+    provider = _PROVIDERS.get(scheme)
+    if provider is None:
+        raise ValueError(
+            f"no storage provider for scheme {scheme!r} "
+            f"(known: {sorted(_PROVIDERS)}); register_storage() to add one"
+        )
+    return provider
+
+
+def upload_dir(local_dir: str, uri: str) -> str:
+    return get_storage(uri).upload_dir(local_dir, uri)
+
+
+def download_dir(uri: str, local_dir: Optional[str] = None) -> str:
+    if local_dir is None:
+        local_dir = tempfile.mkdtemp(prefix="ray_tpu_dl_")
+    return get_storage(uri).download_dir(uri, local_dir)
+
+
+def uri_join(uri: str, *parts: str) -> str:
+    return "/".join([uri.rstrip("/")] + [p.strip("/") for p in parts])

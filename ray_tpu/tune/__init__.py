@@ -167,9 +167,19 @@ class Tuner:
         import os
         import pickle
 
-        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
-            state = pickle.load(f)
-        storage_path, name = os.path.split(path.rstrip("/"))
+        from ray_tpu.train import storage as _storage
+
+        if _storage.is_uri(path):
+            # experiment lives at a storage URI (head:// / gs:// / file://):
+            # split <storage_path>/<name>, download, restore from the copy
+            storage_path, name = path.rstrip("/").rsplit("/", 1)
+            local = _storage.download_dir(path)
+            with open(os.path.join(local, "experiment_state.pkl"), "rb") as f:
+                state = pickle.load(f)
+        else:
+            with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+                state = pickle.load(f)
+            storage_path, name = os.path.split(path.rstrip("/"))
         run_config = kwargs.pop("run_config", None) or RunConfig(
             name=name, storage_path=storage_path
         )

@@ -145,7 +145,9 @@ class TuneController:
             trial.last_result = metrics
             trial.metrics_history.append(metrics)
             if rep.get("checkpoint") is not None:
-                trial.checkpoint = rep["checkpoint"]
+                trial.checkpoint = self._externalize_checkpoint(
+                    trial, rep["checkpoint"]
+                )
             self.searcher.on_trial_result(trial.trial_id, metrics)
             decision = self.scheduler.on_trial_result(trial, metrics)
             if decision == STOP or metrics.get("done"):
@@ -160,6 +162,33 @@ class TuneController:
                     trial._pbt_exploit = None
                 trial.status = PAUSED
                 return
+
+    def _externalize_checkpoint(self, trial: Trial, ckpt):
+        """With URI experiment storage, directory-backed trial checkpoints
+        must leave the trial's host: upload and replace with a URI marker
+        that TrialRunner resolves (downloads) on whichever node relaunches
+        the trial. In-memory checkpoints (dicts etc.) already travel inside
+        experiment_state.pkl and pass through untouched."""
+        from ray_tpu.train import storage as _storage
+
+        if not self.storage_path or not _storage.is_uri(self.storage_path):
+            return ckpt
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        if isinstance(ckpt, Checkpoint):
+            form, path, metrics = "checkpoint", ckpt.path, ckpt.metrics
+        elif isinstance(ckpt, str) and os.path.isdir(ckpt):
+            form, path, metrics = "path", ckpt, None
+        else:
+            return ckpt
+        uri = _storage.uri_join(
+            self.storage_path,
+            self.experiment_name,
+            "trial_ckpts",
+            f"{trial.trial_id}-{len(trial.metrics_history)}",
+        )
+        _storage.upload_dir(path, uri)
+        return {"__ray_tpu_ckpt_uri__": uri, "form": form, "metrics": metrics}
 
     def _complete(self, trial: Trial, status: str, err: Optional[str] = None):
         self._teardown(trial)
@@ -224,7 +253,19 @@ class TuneController:
         if not force and now - self._last_ckpt < self._ckpt_every:
             return
         self._last_ckpt = now
-        exp_dir = os.path.join(self.storage_path, self.experiment_name)
+        from ray_tpu.train import storage as _storage
+
+        if _storage.is_uri(self.storage_path):
+            # URI experiment storage (head:// / gs://): stage locally, then
+            # upload the experiment dir — multi-host resume needs no shared
+            # disk (reference: air/_internal/remote_storage syncing)
+            if not hasattr(self, "_stage_dir"):
+                import tempfile
+
+                self._stage_dir = tempfile.mkdtemp(prefix="ray_tpu_tune_")
+            exp_dir = self._stage_dir
+        else:
+            exp_dir = os.path.join(self.storage_path, self.experiment_name)
         os.makedirs(exp_dir, exist_ok=True)
         state = {
             "metric": self.metric,
@@ -235,9 +276,21 @@ class TuneController:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+        if _storage.is_uri(self.storage_path):
+            _storage.upload_dir(
+                exp_dir, _storage.uri_join(self.storage_path, self.experiment_name)
+            )
 
     @staticmethod
     def load_experiment_state(storage_path: str, experiment_name: str) -> Dict[str, Any]:
-        path = os.path.join(storage_path, experiment_name, "experiment_state.pkl")
+        from ray_tpu.train import storage as _storage
+
+        if _storage.is_uri(storage_path):
+            local = _storage.download_dir(
+                _storage.uri_join(storage_path, experiment_name)
+            )
+            path = os.path.join(local, "experiment_state.pkl")
+        else:
+            path = os.path.join(storage_path, experiment_name, "experiment_state.pkl")
         with open(path, "rb") as f:
             return pickle.load(f)

@@ -58,13 +58,30 @@ class Trainable:
         pass
 
 
+def _resolve_checkpoint(ckpt):
+    """Materialize a controller URI marker into the local form the
+    trainable expects (path or Checkpoint); anything else passes through."""
+    if isinstance(ckpt, dict) and "__ray_tpu_ckpt_uri__" in ckpt:
+        from ray_tpu.train import storage as _storage
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        local = _storage.download_dir(ckpt["__ray_tpu_ckpt_uri__"])
+        if ckpt.get("form") == "checkpoint":
+            return Checkpoint(local, ckpt.get("metrics") or {})
+        return local
+    return ckpt
+
+
 class TrialRunner:
     """Actor hosting one trial (max_concurrency=2: run + result pump)."""
 
     def __init__(self, trial_id: str, config: Dict[str, Any], checkpoint: Any = None):
         self.trial_id = trial_id
         self.config = config
-        self.checkpoint = checkpoint
+        # URI markers (controller._externalize_checkpoint) resolve HERE, on
+        # the node that actually hosts the trial — cross-host restore
+        # without shared disk
+        self.checkpoint = _resolve_checkpoint(checkpoint)
         self.ctx: Optional[TrainContext] = None
         self._stop = threading.Event()
 

