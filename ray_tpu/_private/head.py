@@ -2625,14 +2625,18 @@ class Head:
             raise ValueError(f"bad storage key {key!r}")
         return os.path.join(root, norm + ".tar")
 
-    _STOR_UPLOAD_IDLE_S = 3600.0  # reap uploads abandoned by dead clients
+    _STOR_UPLOAD_IDLE_S = 3600.0  # reap sessions abandoned by dead clients
+    _STOR_REAP_PERIOD_S = 300.0
 
-    def _stor_reap_uploads(self):
-        """Close + delete upload sessions idle past the reap window, and
-        sweep orphaned .up-* tmp files (e.g. from a previous head crash).
-        Lazy: runs on each stor_begin, so a long-lived head can't leak fds
-        or disk to clients that died mid-upload."""
+    def _stor_reap_sessions(self):
+        """Close + delete upload/read sessions idle past the reap window,
+        and sweep orphaned .up-* tmp files (e.g. from a previous head
+        crash). Lazy + rate-limited from stor_begin; the filesystem walk
+        runs in an executor so the control loop never blocks on it."""
         now = time.time()
+        if now - getattr(self, "_stor_last_reap", 0.0) < self._STOR_REAP_PERIOD_S:
+            return
+        self._stor_last_reap = now
         for token, (f, tmp, _path, last) in list(self._stor_uploads.items()):
             if now - last > self._STOR_UPLOAD_IDLE_S:
                 del self._stor_uploads[token]
@@ -2641,17 +2645,25 @@ class Head:
                     os.remove(tmp)
                 except OSError:
                     pass
+        for token, (f, last) in list(getattr(self, "_stor_reads", {}).items()):
+            if now - last > self._STOR_UPLOAD_IDLE_S:
+                del self._stor_reads[token]
+                f.close()
         live_tmp = {t[1] for t in self._stor_uploads.values()}
         root = os.path.abspath(cfg.head_storage_dir)
-        for dirpath, _dirs, files in os.walk(root):
-            for name in files:
-                p = os.path.join(dirpath, name)
-                if ".up-" in name and p not in live_tmp:
-                    try:
-                        if now - os.path.getmtime(p) > self._STOR_UPLOAD_IDLE_S:
-                            os.remove(p)
-                    except OSError:
-                        pass
+
+        def _sweep():
+            for dirpath, _dirs, files in os.walk(root):
+                for name in files:
+                    p = os.path.join(dirpath, name)
+                    if ".up-" in name and p not in live_tmp:
+                        try:
+                            if now - os.path.getmtime(p) > self._STOR_UPLOAD_IDLE_S:
+                                os.remove(p)
+                        except OSError:
+                            pass
+
+        self._spawn_bg(asyncio.to_thread(_sweep))
 
     async def _h_stor_begin(self, conn, msg):
         import uuid as _uuid
@@ -2659,7 +2671,7 @@ class Head:
         path = self._stor_path(msg["key"])  # validates the key up front
         if not hasattr(self, "_stor_uploads"):
             self._stor_uploads = {}
-        self._stor_reap_uploads()
+        self._stor_reap_sessions()
         token = _uuid.uuid4().hex
         tmp = f"{path}.up-{token}"
         os.makedirs(os.path.dirname(tmp), exist_ok=True)
@@ -2684,26 +2696,59 @@ class Head:
         except FileNotFoundError:
             return None
 
-    async def _h_stor_read(self, conn, msg):
+    async def _h_stor_open(self, conn, msg):
+        """Open a read session: the held fd pins ONE version of the object
+        (os.replace swaps the directory entry, not the open inode), so a
+        download that races a concurrent overwrite still sees a consistent
+        snapshot instead of interleaved bytes. Returns (token, size) or
+        None when absent."""
+        import uuid as _uuid
+
         path = self._stor_path(msg["key"])
+        if not hasattr(self, "_stor_reads"):
+            self._stor_reads = {}
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return None
+        token = _uuid.uuid4().hex
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        self._stor_reads[token] = (f, time.time())
+        return token, size
+
+    async def _h_stor_read(self, conn, msg):
+        f, _last = self._stor_reads[msg["token"]]
+        self._stor_reads[msg["token"]] = (f, time.time())
         offset, size = msg["offset"], msg["size"]
 
         def _read():
-            with open(path, "rb") as f:
-                f.seek(offset)
-                return f.read(size)
+            f.seek(offset)
+            return f.read(size)
 
         return await asyncio.get_running_loop().run_in_executor(None, _read)
 
+    async def _h_stor_close(self, conn, msg):
+        entry = self._stor_reads.pop(msg["token"], None)
+        if entry is not None:
+            entry[0].close()
+        return True
+
     async def _h_stor_del(self, conn, msg):
         path = self._stor_path(msg["key"])
-        try:
-            os.remove(path)
-        except FileNotFoundError:
-            pass
-        # a key may also be a PREFIX of per-file keys (workflow sync lays
-        # out <wf>/meta.json, <wf>/steps/... as individual objects)
-        shutil.rmtree(path[: -len(".tar")], ignore_errors=True)
+
+        def _del():
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            # a key may also be a PREFIX of per-file keys (workflow sync
+            # lays out <wf>/meta.json, <wf>/steps/... as individual objects)
+            shutil.rmtree(path[: -len(".tar")], ignore_errors=True)
+
+        # off-loop: deleting a multi-GB prefix must not stall the control
+        # plane (reference: GCS store ops never run on the main loop)
+        await asyncio.get_running_loop().run_in_executor(None, _del)
         return True
 
     async def _h_stor_list(self, conn, msg):

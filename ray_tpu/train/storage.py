@@ -76,7 +76,12 @@ class FileStorage(StorageProvider):
         dest = _file_path(uri)
         if os.path.abspath(local_dir) != dest:
             os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
-            shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+            # REPLACE, never merge: stale files from a previous upload of
+            # this URI must not mix into the new version (head:// swaps the
+            # whole tar atomically; file:// must match that contract)
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(local_dir, dest)
         return uri
 
     def download_dir(self, uri: str, local_dir: str) -> str:
@@ -151,22 +156,29 @@ class HeadStorage(StorageProvider):
         w.request({"t": "stor_end", "token": token})
 
     def _get_path(self, key: str, out, uri: str):
-        """Stream key's bytes into file object `out`."""
+        """Stream key's bytes into file object `out` through a read session:
+        the head pins one version behind an open fd, so a concurrent
+        overwrite can't interleave two versions into the download."""
         w = self._worker()
-        size = w.request({"t": "stor_size", "key": key})
-        if size is None:
+        opened = w.request({"t": "stor_open", "key": key})
+        if opened is None:
             raise FileNotFoundError(f"no object at {uri}")
-        off = 0
-        while off < size:
-            data = w.request(
-                {"t": "stor_read", "key": key, "offset": off, "size": _CHUNK}
-            )
-            if not data:  # object replaced by a smaller one mid-read
-                raise RuntimeError(
-                    f"{uri} truncated during download (concurrent overwrite?)"
+        token, size = opened
+        try:
+            off = 0
+            while off < size:
+                data = w.request(
+                    {"t": "stor_read", "token": token, "offset": off, "size": _CHUNK}
                 )
-            out.write(data)
-            off += len(data)
+                if not data:
+                    raise RuntimeError(f"{uri} truncated during download")
+                out.write(data)
+                off += len(data)
+        finally:
+            try:
+                w.request({"t": "stor_close", "token": token})
+            except Exception:
+                pass
 
     def upload_dir(self, local_dir: str, uri: str) -> str:
         with tempfile.NamedTemporaryFile(suffix=".tar") as tf:
@@ -311,9 +323,25 @@ def upload_dir(local_dir: str, uri: str) -> str:
     return get_storage(uri).upload_dir(local_dir, uri)
 
 
+_TMP_DOWNLOADS: List[str] = []
+
+
+def _clean_tmp_downloads():
+    for d in _TMP_DOWNLOADS:
+        shutil.rmtree(d, ignore_errors=True)
+    _TMP_DOWNLOADS.clear()
+
+
 def download_dir(uri: str, local_dir: Optional[str] = None) -> str:
     if local_dir is None:
+        # default-temp downloads are process-scoped scratch: remember them
+        # and sweep at exit so repeated restores don't accumulate copies
         local_dir = tempfile.mkdtemp(prefix="ray_tpu_dl_")
+        if not _TMP_DOWNLOADS:
+            import atexit
+
+            atexit.register(_clean_tmp_downloads)
+        _TMP_DOWNLOADS.append(local_dir)
     return get_storage(uri).download_dir(uri, local_dir)
 
 

@@ -55,6 +55,10 @@ class TuneConfig:
     search_alg: Optional[Searcher] = None
     scheduler: Optional[TrialScheduler] = None
     seed: Optional[int] = None
+    # park finished/paused trial actors for the next trial: skips actor
+    # cold-start and the process's jit/XLA compile caches (reference:
+    # TuneConfig.reuse_actors)
+    reuse_actors: bool = False
 
 
 class ResultGrid:
@@ -155,6 +159,7 @@ class Tuner:
             max_failures=self._run_config.failure_config.max_failures,
             storage_path=self._run_config.storage_path,
             experiment_name=self._run_config.name or "experiment",
+            reuse_actors=tc.reuse_actors,
         )
         controller.trials.extend(self._restored_trials)
         trials = controller.run()
@@ -228,6 +233,7 @@ def run(
     max_concurrent_trials: int = 0,
     storage_path: Optional[str] = None,
     name: Optional[str] = None,
+    reuse_actors: bool = False,
 ) -> ResultGrid:
     """Functional entry point (reference: tune/tune.py:293)."""
     return Tuner(
@@ -240,6 +246,7 @@ def run(
             scheduler=scheduler,
             search_alg=search_alg,
             max_concurrent_trials=max_concurrent_trials,
+            reuse_actors=reuse_actors,
         ),
         run_config=RunConfig(name=name, storage_path=storage_path),
         resources_per_trial=resources_per_trial,

@@ -35,6 +35,7 @@ class TuneController:
         storage_path: Optional[str] = None,
         experiment_name: str = "experiment",
         checkpoint_every_s: float = 5.0,
+        reuse_actors: bool = False,
     ):
         self.trainable = trainable
         self.searcher = searcher
@@ -52,10 +53,30 @@ class TuneController:
         self._ckpt_every = checkpoint_every_s
         self._last_ckpt = 0.0
         self._searcher_done = False
+        # reuse_actors: finished/paused trials park their runner actor here
+        # for the next trial instead of dying — skips actor cold-start AND
+        # the process's XLA/jit compile caches (reference:
+        # tune_controller.py reuse path; worth more on TPU than anywhere)
+        self.reuse_actors = reuse_actors
+        self._actor_cache: List[Any] = []
 
     # ---------------------------------------------------------------- launch
 
     def _launch(self, trial: Trial):
+        while self._actor_cache:
+            actor = self._actor_cache.pop()
+            try:
+                ray_tpu.get(
+                    actor.reset.remote(trial.trial_id, trial.config, trial.checkpoint),
+                    timeout=30,
+                )
+            except Exception:
+                self._kill_actor(actor)  # cached actor died in the meantime
+                continue
+            trial.actor = actor
+            trial.run_ref = actor.run.remote(self.trainable)
+            trial.status = RUNNING
+            return
         RunnerCls = ray_tpu.remote(TrialRunner)
         opts: Dict[str, Any] = {"max_concurrency": 2, "num_cpus": self.resources.get("CPU", 1)}
         if self.resources.get("TPU"):
@@ -69,15 +90,46 @@ class TuneController:
         trial.run_ref = trial.actor.run.remote(self.trainable)
         trial.status = RUNNING
 
-    def _teardown(self, trial: Trial):
+    @staticmethod
+    def _kill_actor(actor):
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    def _teardown(self, trial: Trial, reusable: bool = False):
+        """reusable=True parks a HEALTHY actor (normal completion / pause /
+        scheduler stop) in the reuse cache; failures always kill — a
+        crashed or wedged runner must not poison the next trial. An actor
+        whose run() is still executing is cached only if it settles within
+        a short grace window after stop() (class trainables exit at the
+        next step boundary; a function trainable that won't return is
+        killed as before)."""
         trial._pump_ref = None
-        if trial.actor is not None:
-            try:
-                ray_tpu.kill(trial.actor)
-            except Exception:
-                pass
+        actor, run_ref = trial.actor, trial.run_ref
         trial.actor = None
         trial.run_ref = None
+        if actor is None:
+            return
+        if (
+            reusable
+            and self.reuse_actors
+            and len(self._actor_cache) < self.max_concurrent
+        ):
+            settled = True
+            if run_ref is not None:
+                try:
+                    ray_tpu.get(actor.stop.remote(), timeout=5)
+                    ready, _ = ray_tpu.wait([run_ref], timeout=5)
+                    settled = bool(ready)
+                    if settled:
+                        ray_tpu.get(run_ref)  # raises if the run errored
+                except Exception:
+                    settled = False
+            if settled:
+                self._actor_cache.append(actor)
+                return
+        self._kill_actor(actor)
 
     # ------------------------------------------------------------------ loop
 
@@ -155,7 +207,7 @@ class TuneController:
                 return
             if decision == PAUSE:
                 exploit = getattr(trial, "_pbt_exploit", None)
-                self._teardown(trial)
+                self._teardown(trial, reusable=True)
                 if exploit is not None:
                     trial.config = exploit["config"]
                     trial.checkpoint = exploit["checkpoint"]
@@ -188,10 +240,22 @@ class TuneController:
             f"{trial.trial_id}-{len(trial.metrics_history)}",
         )
         _storage.upload_dir(path, uri)
+        # GC: keep the last two uploads per trial (the newest, plus one
+        # grace copy in case a PBT exploit captured the previous marker);
+        # without this a long run fills the storage host's disk
+        uris = getattr(trial, "_ckpt_uris", [])
+        uris.append(uri)
+        if len(uris) > 2:
+            old = uris.pop(0)
+            try:
+                _storage.get_storage(old).delete(old)
+            except Exception:
+                pass
+        trial._ckpt_uris = uris
         return {"__ray_tpu_ckpt_uri__": uri, "form": form, "metrics": metrics}
 
     def _complete(self, trial: Trial, status: str, err: Optional[str] = None):
-        self._teardown(trial)
+        self._teardown(trial, reusable=status == TERMINATED)
         trial.status = status
         trial.error = err
         self.searcher.on_trial_complete(
@@ -239,8 +303,13 @@ class TuneController:
         return live or not self._searcher_done
 
     def run(self) -> List[Trial]:
-        while self.step():
-            time.sleep(0.02)
+        try:
+            while self.step():
+                time.sleep(0.02)
+        finally:
+            for actor in self._actor_cache:
+                self._kill_actor(actor)
+            self._actor_cache.clear()
         self._maybe_checkpoint(force=True)
         return self.trials
 

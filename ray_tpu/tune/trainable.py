@@ -124,6 +124,19 @@ class TrialRunner:
         self._stop.set()
         return True
 
+    def reset(self, trial_id: str, config: Dict[str, Any], checkpoint: Any = None):
+        """Re-arm this runner for a NEW trial without a fresh actor
+        (reference: tune_controller.py reuse_actors + Trainable.reset_config).
+        The process — with its imported modules and jit/XLA compilation
+        caches — survives, which on TPU skips both actor cold-start and
+        recompilation. Only called between runs (run_ref settled)."""
+        self.trial_id = trial_id
+        self.config = config
+        self.checkpoint = _resolve_checkpoint(checkpoint)
+        self.ctx = None
+        self._stop = threading.Event()
+        return True
+
     def next_results(self, max_items: int = 100):
         out = []
         if self.ctx is None:

@@ -91,9 +91,10 @@ def _sync_up(workflow_id: str, relfile: str) -> None:
 _WF_TOP_FILES = ("meta.json", "dag.pkl", "inputs.pkl", "result.pkl")
 
 
-def _sync_down(workflow_id: str) -> None:
-    """Fetch a workflow's files from URI storage into the local mirror:
-    the fixed top-level files plus every step checkpoint."""
+def _sync_down(workflow_id: str, files: Optional[Tuple[str, ...]] = None) -> None:
+    """Fetch a workflow's files from URI storage into the local mirror.
+    `files` limits the transfer (status checks need meta.json, not every
+    step checkpoint); None = everything including steps (resume)."""
     if _STORAGE_URI is None:
         return
     from ray_tpu.train import storage as _rstorage
@@ -101,11 +102,13 @@ def _sync_down(workflow_id: str) -> None:
     st = _rstorage.get_storage(_STORAGE_URI)
     base = f"{_STORAGE_URI}/{workflow_id}"
     wdir = _wf_dir(workflow_id)
-    for name in _WF_TOP_FILES:
+    for name in files if files is not None else _WF_TOP_FILES:
         try:
             st.download_file(f"{base}/{name}", os.path.join(wdir, name))
         except FileNotFoundError:
             continue
+    if files is not None:
+        return
     try:
         steps = st.list(f"{base}/steps")
     except Exception:
@@ -326,7 +329,14 @@ def _execute_workflow(workflow_id: str) -> Any:
     with open(os.path.join(wdir, "inputs.pkl"), "rb") as f:
         input_args, input_kwargs = pickle.loads(f.read())
 
-    _write_meta(workflow_id, status=WorkflowStatus.RUNNING.value, driver_pid=os.getpid())
+    import socket
+
+    _write_meta(
+        workflow_id,
+        status=WorkflowStatus.RUNNING.value,
+        driver_pid=os.getpid(),
+        driver_host=socket.gethostname(),
+    )
     try:
         out = _run_dag(workflow_id, dag, (input_args, input_kwargs), "")
         with open(os.path.join(wdir, "result.pkl"), "wb") as f:
@@ -356,7 +366,7 @@ def run(
     wdir = _wf_dir(workflow_id)
     if not os.path.exists(os.path.join(wdir, "dag.pkl")):
         # cross-host guard: the id may exist only in URI storage
-        _sync_down(workflow_id)
+        _sync_down(workflow_id, files=("dag.pkl",))
     if os.path.exists(os.path.join(wdir, "dag.pkl")):
         raise ValueError(
             f"workflow id {workflow_id!r} already exists; use resume()"
@@ -431,23 +441,32 @@ def _pid_alive(pid: int) -> bool:
 
 def get_status(workflow_id: str) -> WorkflowStatus:
     path = _meta_path(workflow_id)
-    if not os.path.exists(path):
-        _sync_down(workflow_id)  # maybe it lives only in URI storage
+    if _STORAGE_URI is not None:
+        # URI storage is the source of truth: always refresh meta (cheap —
+        # one small file), so cross-host status is current
+        _sync_down(workflow_id, files=("meta.json",))
     if not os.path.exists(path):
         raise ValueError(f"no such workflow {workflow_id!r}")
     with open(path) as f:
         meta = json.load(f)
     status = WorkflowStatus(meta["status"])
-    if status == WorkflowStatus.RUNNING and not _pid_alive(meta.get("driver_pid")):
-        # driver died mid-run: checkpoints are on disk, resume() will finish it
-        return WorkflowStatus.RESUMABLE
+    if status == WorkflowStatus.RUNNING:
+        # the pid livenesss probe is only meaningful on the driver's own
+        # host; from another host a RUNNING workflow stays RUNNING (never
+        # invite a concurrent duplicate resume of a live driver)
+        import socket
+
+        same_host = meta.get("driver_host") in (None, socket.gethostname())
+        if same_host and not _pid_alive(meta.get("driver_pid")):
+            # driver died mid-run: checkpoints persist, resume() finishes it
+            return WorkflowStatus.RESUMABLE
     return status
 
 
 def get_output(workflow_id: str) -> Any:
     path = os.path.join(_wf_dir(workflow_id), "result.pkl")
     if not os.path.exists(path):
-        _sync_down(workflow_id)
+        _sync_down(workflow_id, files=("result.pkl",))
     if not os.path.exists(path):
         raise ValueError(f"workflow {workflow_id!r} has no result (not finished?)")
     with open(path, "rb") as f:
