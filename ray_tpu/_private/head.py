@@ -2637,7 +2637,9 @@ class Head:
         if now - getattr(self, "_stor_last_reap", 0.0) < self._STOR_REAP_PERIOD_S:
             return
         self._stor_last_reap = now
-        for token, (f, tmp, _path, last) in list(self._stor_uploads.items()):
+        for token, (f, tmp, _path, last) in list(
+            getattr(self, "_stor_uploads", {}).items()
+        ):
             if now - last > self._STOR_UPLOAD_IDLE_S:
                 del self._stor_uploads[token]
                 f.close()
@@ -2649,7 +2651,7 @@ class Head:
             if now - last > self._STOR_UPLOAD_IDLE_S:
                 del self._stor_reads[token]
                 f.close()
-        live_tmp = {t[1] for t in self._stor_uploads.values()}
+        live_tmp = {t[1] for t in getattr(self, "_stor_uploads", {}).values()}
         root = os.path.abspath(cfg.head_storage_dir)
 
         def _sweep():
@@ -2707,6 +2709,7 @@ class Head:
         path = self._stor_path(msg["key"])
         if not hasattr(self, "_stor_reads"):
             self._stor_reads = {}
+        self._stor_reap_sessions()  # download-heavy workloads reap too
         try:
             f = open(path, "rb")
         except FileNotFoundError:

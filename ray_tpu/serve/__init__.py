@@ -15,6 +15,8 @@ from typing import Any, Dict, Optional, Union
 from .batching import batch  # noqa: F401
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
 from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .http_proxy import Request, Response, StreamingResponse  # noqa: F401
+from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
@@ -98,13 +100,21 @@ def run(
                 "config": node.deployment.config,
             }
         )
-    ingress = app.deployment.name
-    ray_tpu.get(controller.deploy_application.remote(name, specs, ingress))
+    ingress_name = app.deployment.name
+    ray_tpu.get(controller.deploy_application.remote(name, specs, ingress_name))
 
+    # @serve.ingress deployments always receive the raw Request
+    if getattr(app.deployment.func_or_class, "_serve_ingress", False):
+        pass_request = True
     if route_prefix is not None:
         proxy = start_http_proxy()
-        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress, pass_request))
-    return DeploymentHandle(ingress)
+        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress_name, pass_request))
+        # record on the controller too: per-node fleet proxies (if/when
+        # started) pick the route up from there
+        ray_tpu.get(
+            controller.set_route.remote(route_prefix, ingress_name, pass_request)
+        )
+    return DeploymentHandle(ingress_name)
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
@@ -123,6 +133,27 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
     )
     ray_tpu.get(h.ready.remote())
     return h
+
+
+def start_proxies(port: int = 0) -> Dict[str, str]:
+    """Start the per-node HTTP proxy fleet: one proxy actor pinned to every
+    alive node, all sharing the controller's routing table; new nodes get a
+    proxy on the controller's next reconcile tick, dead nodes' proxies are
+    dropped (reference: serve/_private/http_state.py one-proxy-per-node).
+    Returns {node_id: "host:port"}. port=0 picks a free port per node; a
+    fixed port gives the uniform ingress endpoint a load balancer expects."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.start_proxies.remote(port))
+
+
+def proxy_addresses() -> Dict[str, str]:
+    """Live per-node proxy endpoints ({} until start_proxies)."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.proxy_addresses.remote())
 
 
 def proxy_address() -> Optional[str]:

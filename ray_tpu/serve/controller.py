@@ -38,11 +38,143 @@ class ServeController:
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # per-node proxy fleet (reference: _private/http_state.py
+        # HTTPProxyStateManager — one proxy actor per alive node, shared
+        # routing table). Disabled until start_proxies().
+        self._proxy_fleet = False
+        self._proxy_port = 0
+        self._proxies: Dict[str, Any] = {}  # node_id -> handle
+        self._proxy_addrs: Dict[str, str] = {}
+        self._routes: Dict[str, tuple] = {}  # prefix -> (deployment, pass_req)
         self._loop_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._loop_thread.start()
 
     def ready(self):
         return True
+
+    # ------------------------------------------------------- proxy fleet
+
+    def set_route(self, route_prefix: str, deployment_name: str,
+                  pass_request: bool = False):
+        """Record a route and push it to every fleet proxy. Routes set
+        before start_proxies() apply when the fleet comes up."""
+        prefix = route_prefix.rstrip("/") or "/"
+        with self._lock:
+            self._routes[prefix] = (deployment_name, pass_request)
+            proxies = list(self._proxies.values())
+        import ray_tpu
+
+        for h in proxies:
+            try:
+                ray_tpu.get(
+                    h.set_route.remote(prefix, deployment_name, pass_request),
+                    timeout=10,
+                )
+            except Exception:
+                pass  # unhealthy proxy: the reconcile loop replaces it
+        return True
+
+    def remove_route(self, route_prefix: str):
+        prefix = route_prefix.rstrip("/") or "/"
+        with self._lock:
+            self._routes.pop(prefix, None)
+            proxies = list(self._proxies.values())
+        import ray_tpu
+
+        for h in proxies:
+            try:
+                ray_tpu.get(h.remove_route.remote(prefix), timeout=10)
+            except Exception:
+                pass
+        return True
+
+    def start_proxies(self, port: int = 0) -> Dict[str, str]:
+        """Enable the per-node fleet; returns {node_id: host:port}."""
+        self._proxy_fleet = True
+        self._proxy_port = port
+        self._ensure_proxies()
+        return self.proxy_addresses()
+
+    def proxy_addresses(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._proxy_addrs)
+
+    def _spawn_proxy(self, node_id: str):
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        from .http_proxy import HTTPProxyActor
+
+        Proxy = ray_tpu.remote(HTTPProxyActor)
+        h = Proxy.options(
+            name=f"SERVE_PROXY:{node_id}",
+            lifetime="detached",
+            max_concurrency=32,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_id, soft=False
+            ),
+        ).remote("0.0.0.0", self._proxy_port)
+        info = ray_tpu.get(h.ready.remote(), timeout=30)
+        with self._lock:
+            routes = dict(self._routes)
+        for prefix, (dep, pr) in routes.items():
+            ray_tpu.get(h.set_route.remote(prefix, dep, pr), timeout=10)
+        with self._lock:
+            self._proxies[node_id] = h
+            self._proxy_addrs[node_id] = f"{info['host']}:{info['port']}"
+
+    def _ensure_proxies(self):
+        """One healthy proxy per alive node: spawn on new nodes, drop on
+        dead ones, replace unresponsive ones (reference: http_state.py
+        reconciliation)."""
+        if not self._proxy_fleet:
+            return
+        import ray_tpu
+
+        alive = {n["node_id"] for n in ray_tpu.nodes() if n.get("alive")}
+        with self._lock:
+            current = dict(self._proxies)
+        for node_id in set(current) - alive:
+            try:
+                ray_tpu.kill(current[node_id])
+            except Exception:
+                pass
+            with self._lock:
+                self._proxies.pop(node_id, None)
+                self._proxy_addrs.pop(node_id, None)
+            current.pop(node_id)
+        # health: ping every proxy CONCURRENTLY with one shared deadline, so
+        # wedged members cost one bounded wait, not a serial stall each
+        if current:
+            nodes_order = list(current)
+            refs = [current[n].ready.remote() for n in nodes_order]
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+            ready_ids = {r.id for r in ready}
+            for node_id, ref in zip(nodes_order, refs):
+                healthy = ref.id in ready_ids
+                if healthy:
+                    try:
+                        ray_tpu.get(ref)
+                    except Exception:
+                        healthy = False
+                if not healthy:
+                    # KILL before respawn: the detached name must free up,
+                    # and a wedged-but-listening proxy must not keep
+                    # serving stale routes
+                    try:
+                        ray_tpu.kill(current[node_id])
+                    except Exception:
+                        pass
+                    with self._lock:
+                        self._proxies.pop(node_id, None)
+                        self._proxy_addrs.pop(node_id, None)
+        with self._lock:
+            have = set(self._proxies)
+        for node_id in alive - have:
+            try:
+                self._spawn_proxy(node_id)
+            except Exception:
+                pass  # node may have just died; next tick retries
 
     # ---------------------------------------------------------- deploy API
 
@@ -108,6 +240,18 @@ class ServeController:
             self._stop_replicas(state.replicas)
         self._deployments.clear()
         self._apps.clear()
+        import ray_tpu
+
+        with self._lock:
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._proxy_addrs.clear()
+        for h in proxies:
+            try:
+                ray_tpu.get(h.stop.remote(), timeout=5)
+                ray_tpu.kill(h)
+            except Exception:
+                pass
         return True
 
     # ------------------------------------------------------- reconciliation
@@ -204,5 +348,10 @@ class ServeController:
                     self._health_check(state)
                     if heartbeat:
                         self._publish_replicas(state)
+                except Exception:
+                    pass
+            if heartbeat:
+                try:
+                    self._ensure_proxies()
                 except Exception:
                     pass

@@ -69,6 +69,17 @@ class StreamingResponse:
 
 
 @dataclass
+class Response:
+    """Explicit-status response from a handler (ingress handlers use it for
+    201/4xx etc.). body follows the normal result contract: str -> text,
+    bytes -> octet-stream, anything else -> JSON."""
+
+    status: int
+    body: Any = None
+    content_type: Optional[str] = None
+
+
+@dataclass
 class _Route:
     prefix: str
     handle: Any
@@ -259,26 +270,43 @@ class HTTPProxyActor:
             await self._reply(writer, 500, "application/json",
                               json.dumps({"error": repr(e)}).encode())
             return
+        status = 200
+        bare = isinstance(result, Response)  # Response bodies serialize bare
+        ctype_override = None
+        if bare:
+            status = result.status
+            ctype_override = result.content_type
+            result = result.body
         try:
+            if ctype_override is not None:
+                data = (
+                    result.encode() if isinstance(result, str)
+                    else bytes(result) if isinstance(result, (bytes, bytearray, memoryview))
+                    else json.dumps(result).encode()
+                )
+                await self._reply(writer, status, ctype_override, data)
+                return
             if isinstance(result, StreamingResponse):
                 await self._reply_chunked(writer, result)
                 return
             if isinstance(result, (bytes, bytearray, memoryview)):
-                await self._reply(writer, 200, "application/octet-stream",
+                await self._reply(writer, status, "application/octet-stream",
                                   bytes(result))
                 return
             if isinstance(result, str):
-                await self._reply(writer, 200, "text/plain; charset=utf-8",
+                await self._reply(writer, status, "text/plain; charset=utf-8",
                                   result.encode())
                 return
-            payload = json.dumps({"result": result}).encode()
+            # Response bodies serialize bare; plain results keep the stable
+            # v1 {"result": ...} wire shape
+            payload = json.dumps(result if bare else {"result": result}).encode()
         except ConnectionError:
             raise
         except Exception as e:  # a non-JSON-able result must 500, not drop
             await self._reply(writer, 500, "application/json",
                               json.dumps({"error": repr(e)}).encode())
             return
-        await self._reply(writer, 200, "application/json", payload)
+        await self._reply(writer, status, "application/json", payload)
 
     # ---------------------------------------------------------- actor API
 

@@ -1,0 +1,171 @@
+"""@serve.ingress: route decorators on a deployment class.
+
+Reference parity: serve/api.py:169 — `@serve.ingress(fastapi_app)` mounts a
+FastAPI app on a deployment so one deployment serves many routes with path
+parameters, per-method handlers, and typed responses. This deployment ships
+a dependency-free equivalent: `serve.Router()` plays the FastAPI app's
+role (method decorators + path templates), and `@serve.ingress(router)`
+installs a dispatching __call__ on the deployment class.
+
+    router = serve.Router()
+
+    @serve.deployment
+    @serve.ingress(router)
+    class Api:
+        @router.get("/items/{item_id}")
+        def get_item(self, item_id: str):
+            return {"id": item_id}
+
+        @router.post("/items")
+        def create(self, body):
+            return Response(201, body)
+
+    serve.run(Api.bind(), route_prefix="/api")
+
+Handler parameter binding (by name, FastAPI-style):
+- a path-template name ({item_id}) binds the captured segment, cast via
+  the parameter's int/float annotation when present
+- `request` binds the full http_proxy.Request
+- `body` binds the parsed request body
+- any other name binds the query parameter of that name (cast via
+  annotation), or its default when absent
+Return values follow the proxy contract (str/bytes/JSON/Streaming), plus
+`Response(status, body)` for explicit status codes; raise
+`HTTPException(status, detail)` for error responses.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .http_proxy import Request, Response
+
+_METHODS = ("get", "post", "put", "delete", "patch", "head", "options")
+
+
+class HTTPException(Exception):
+    """Raise inside an ingress handler to return a specific status
+    (reference: fastapi.HTTPException, honored by serve ingress)."""
+
+    def __init__(self, status_code: int, detail: Any = None):
+        super().__init__(detail)
+        self.status_code = int(status_code)
+        self.detail = detail
+
+
+class _IngressRoute:
+    __slots__ = ("method", "parts", "fn", "pattern")
+
+    def __init__(self, method: str, pattern: str, fn: Callable):
+        self.method = method.upper()
+        self.pattern = pattern
+        self.parts = [p for p in pattern.strip("/").split("/") if p]
+        self.fn = fn
+
+    def match(self, segments: List[str]) -> Optional[Dict[str, str]]:
+        if len(segments) != len(self.parts):
+            return None
+        params: Dict[str, str] = {}
+        for pat, seg in zip(self.parts, segments):
+            if pat.startswith("{") and pat.endswith("}"):
+                params[pat[1:-1]] = seg
+            elif pat != seg:
+                return None
+        return params
+
+
+class Router:
+    """Collects (method, path template) -> handler while the deployment
+    class body executes (the FastAPI-app stand-in)."""
+
+    def __init__(self):
+        self.routes: List[_IngressRoute] = []
+
+    def _register(self, method: str, pattern: str):
+        def deco(fn):
+            self.routes.append(_IngressRoute(method, pattern, fn))
+            return fn
+
+        return deco
+
+    def match(self, method: str, subpath: str) -> Optional[Tuple[Callable, Dict[str, str]]]:
+        segments = [s for s in subpath.strip("/").split("/") if s]
+        method_matched = False
+        for route in self.routes:
+            params = route.match(segments)
+            if params is None:
+                continue
+            if route.method != method.upper():
+                method_matched = True
+                continue
+            return route.fn, params
+        if method_matched:
+            raise HTTPException(405, "method not allowed")
+        return None
+
+
+for _m in _METHODS:
+    setattr(
+        Router,
+        _m,
+        (lambda m: lambda self, pattern: self._register(m, pattern))(_m),
+    )
+
+
+def _cast(value: str, annotation) -> Any:
+    if annotation in (int, float):
+        try:
+            return annotation(value)
+        except ValueError:
+            raise HTTPException(422, f"invalid {annotation.__name__}: {value!r}")
+    return value
+
+
+def _bind_args(fn: Callable, request: Request, path_params: Dict[str, str]) -> dict:
+    kwargs: Dict[str, Any] = {}
+    sig = inspect.signature(fn)
+    for name, param in list(sig.parameters.items())[1:]:  # skip self
+        if name == "request":
+            kwargs[name] = request
+        elif name == "body":
+            kwargs[name] = request.body
+        elif name in path_params:
+            kwargs[name] = _cast(path_params[name], param.annotation)
+        elif name in request.query:
+            kwargs[name] = _cast(str(request.query[name]), param.annotation)
+        elif param.default is not inspect.Parameter.empty:
+            kwargs[name] = param.default
+        else:
+            raise HTTPException(422, f"missing required parameter {name!r}")
+    return kwargs
+
+
+def ingress(router: Router):
+    """Class decorator installing a router-dispatching __call__. The
+    deployment automatically receives raw Requests (serve.run detects
+    `_serve_ingress` and sets pass_request)."""
+    if not isinstance(router, Router):
+        raise TypeError("serve.ingress takes a serve.Router()")
+
+    def deco(cls):
+        if not inspect.isclass(cls):
+            raise TypeError("@serve.ingress decorates a deployment CLASS")
+
+        def __call__(self, request: Request):
+            try:
+                matched = router.match(request.method, request.subpath)
+                if matched is None:
+                    raise HTTPException(404, "no matching route")
+                fn, path_params = matched
+                return fn(self, **_bind_args(fn, request, path_params))
+            except HTTPException as e:
+                body = {"detail": e.detail} if e.detail is not None else {}
+                return Response(e.status_code, body)
+
+        cls.__call__ = __call__
+        cls._serve_ingress = True
+        cls._serve_router = router
+        return cls
+
+    return deco

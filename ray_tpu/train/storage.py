@@ -76,12 +76,18 @@ class FileStorage(StorageProvider):
         dest = _file_path(uri)
         if os.path.abspath(local_dir) != dest:
             os.makedirs(os.path.dirname(dest) or "/", exist_ok=True)
-            # REPLACE, never merge: stale files from a previous upload of
-            # this URI must not mix into the new version (head:// swaps the
-            # whole tar atomically; file:// must match that contract)
-            if os.path.isdir(dest):
-                shutil.rmtree(dest)
-            shutil.copytree(local_dir, dest)
+            # REPLACE, never merge — and via rename pairs, so a concurrent
+            # reader sees the old tree or the new one, never a partial copy
+            tmp = f"{dest}.new-{os.getpid()}"
+            old = f"{dest}.old-{os.getpid()}"
+            shutil.copytree(local_dir, tmp)
+            try:
+                if os.path.isdir(dest):
+                    os.rename(dest, old)
+                os.rename(tmp, dest)
+            finally:
+                shutil.rmtree(old, ignore_errors=True)
+                shutil.rmtree(tmp, ignore_errors=True)
         return uri
 
     def download_dir(self, uri: str, local_dir: str) -> str:

@@ -240,18 +240,29 @@ class TuneController:
             f"{trial.trial_id}-{len(trial.metrics_history)}",
         )
         _storage.upload_dir(path, uri)
-        # GC: keep the last two uploads per trial (the newest, plus one
-        # grace copy in case a PBT exploit captured the previous marker);
-        # without this a long run fills the storage host's disk
+        # GC: drop this trial's older uploads — EXCEPT any URI still
+        # referenced by a trial's current checkpoint or a pending PBT
+        # exploit (a PAUSED trial may hold a marker to another trial's old
+        # checkpoint for many ticks); without GC a long run fills the
+        # storage host's disk
+        referenced = set()
+        for t in self.trials:
+            for ck in (t.checkpoint, getattr(t, "_pbt_exploit", None) and
+                       t._pbt_exploit.get("checkpoint")):
+                if isinstance(ck, dict) and "__ray_tpu_ckpt_uri__" in ck:
+                    referenced.add(ck["__ray_tpu_ckpt_uri__"])
         uris = getattr(trial, "_ckpt_uris", [])
         uris.append(uri)
-        if len(uris) > 2:
-            old = uris.pop(0)
+        keep = uris[-2:]
+        for old in uris[:-2]:
+            if old in referenced:
+                keep.insert(0, old)
+                continue
             try:
                 _storage.get_storage(old).delete(old)
             except Exception:
                 pass
-        trial._ckpt_uris = uris
+        trial._ckpt_uris = keep
         return {"__ray_tpu_ckpt_uri__": uri, "form": form, "metrics": metrics}
 
     def _complete(self, trial: Trial, status: str, err: Optional[str] = None):

@@ -78,10 +78,7 @@ class TrialRunner:
     def __init__(self, trial_id: str, config: Dict[str, Any], checkpoint: Any = None):
         self.trial_id = trial_id
         self.config = config
-        # URI markers (controller._externalize_checkpoint) resolve HERE, on
-        # the node that actually hosts the trial — cross-host restore
-        # without shared disk
-        self.checkpoint = _resolve_checkpoint(checkpoint)
+        self.checkpoint = checkpoint
         self.ctx: Optional[TrainContext] = None
         self._stop = threading.Event()
 
@@ -89,6 +86,12 @@ class TrialRunner:
         return True
 
     def run(self, trainable) -> Any:
+        # URI markers (controller._externalize_checkpoint) resolve HERE, on
+        # the node that actually hosts the trial — cross-host restore
+        # without shared disk. Lazily in run(), not __init__/reset: a
+        # multi-GB download must not eat the controller's bounded reset
+        # timeout (that would kill the cached actor and defeat reuse)
+        self.checkpoint = _resolve_checkpoint(self.checkpoint)
         self.ctx = TrainContext(
             trial_name=self.trial_id, config=self.config, checkpoint=self.checkpoint
         )
@@ -132,7 +135,7 @@ class TrialRunner:
         recompilation. Only called between runs (run_ref settled)."""
         self.trial_id = trial_id
         self.config = config
-        self.checkpoint = _resolve_checkpoint(checkpoint)
+        self.checkpoint = checkpoint  # resolved lazily in run()
         self.ctx = None
         self._stop = threading.Event()
         return True

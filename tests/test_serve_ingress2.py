@@ -1,0 +1,161 @@
+"""@serve.ingress route adapter + per-node proxy fleet.
+
+Reference parity: serve/api.py:169 (serve.ingress mounting a multi-route
+app on one deployment) and serve/_private/http_state.py (one HTTP proxy
+actor per alive node sharing the routing table).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _get(path, **kw):
+    return urllib.request.urlopen(
+        f"http://{serve.proxy_address()}{path}", timeout=30, **kw
+    )
+
+
+def _build_api():
+    router = serve.Router()
+
+    @serve.deployment
+    @serve.ingress(router)
+    class Api:
+        def __init__(self):
+            self.items = {"0": "seed"}
+
+        @router.get("/items/{item_id}")
+        def get_item(self, item_id: str):
+            if item_id not in self.items:
+                raise serve.HTTPException(404, f"no item {item_id}")
+            return {"id": item_id, "value": self.items[item_id]}
+
+        @router.post("/items")
+        def create(self, body):
+            iid = str(len(self.items))
+            self.items[iid] = body["value"]
+            return serve.Response(201, {"id": iid})
+
+        @router.get("/items")
+        def list_items(self, limit: int = 10):
+            return {"ids": sorted(self.items)[:limit]}
+
+        @router.delete("/items/{item_id}")
+        def delete_item(self, item_id: str):
+            self.items.pop(item_id, None)
+            return serve.Response(204, "")
+
+        @router.get("/math/{a}/plus/{b}")
+        def add(self, a: int, b: int):
+            return {"sum": a + b}
+
+    return Api
+
+
+def test_ingress_routes(serve_cluster):
+    serve.run(_build_api().bind(), name="api", route_prefix="/api")
+
+    # GET with path param
+    with _get("/api/items/0") as r:
+        assert json.loads(r.read())["result"]["value"] == "seed"
+
+    # POST -> 201 with bare body
+    req = urllib.request.Request(
+        f"http://{serve.proxy_address()}/api/items",
+        data=json.dumps({"value": "v1"}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 201
+        assert json.loads(r.read()) == {"id": "1"}
+
+    # multiple path params with int casting
+    with _get("/api/math/3/plus/4") as r:
+        assert json.loads(r.read())["result"]["sum"] == 7
+
+    # query param with default + casting
+    with _get("/api/items?limit=1") as r:
+        assert len(json.loads(r.read())["result"]["ids"]) == 1
+
+    # HTTPException -> status propagates
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get("/api/items/999")
+    assert ei.value.code == 404
+    assert "no item 999" in json.loads(ei.value.read())["detail"]
+
+    # unmatched subpath -> 404; wrong method -> 405; bad int -> 422
+    for path, code, method in [
+        ("/api/nope/at/all", 404, "GET"),
+        ("/api/items/0", 405, "POST"),
+        ("/api/math/x/plus/4", 422, "GET"),
+    ]:
+        req = urllib.request.Request(
+            f"http://{serve.proxy_address()}{path}",
+            data=b"{}" if method == "POST" else None,
+            method=method,
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == code, path
+
+
+def test_ingress_requires_class():
+    with pytest.raises(TypeError):
+        serve.ingress(serve.Router())(lambda x: x)
+    with pytest.raises(TypeError):
+        serve.ingress("not a router")
+
+
+def test_proxy_fleet_per_node():
+    """One proxy per node, shared routes: requests through EITHER node's
+    proxy reach the app; a node added later gets a proxy on the next
+    reconcile tick."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    try:
+        c.add_node(num_cpus=4)
+
+        @serve.deployment
+        def hello(x=None):
+            return {"hi": True}
+
+        serve.run(hello.bind(), name="h", route_prefix="/hello")
+        addrs = serve.start_proxies()
+        assert len(addrs) == 2, addrs
+
+        for node_id, addr in addrs.items():
+            with urllib.request.urlopen(f"http://{addr}/hello", timeout=30) as r:
+                assert json.loads(r.read())["result"]["hi"] is True
+
+        # a later node gets a proxy with the SAME routes, no extra calls
+        c.add_node(num_cpus=2)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            addrs = serve.proxy_addresses()
+            if len(addrs) == 3:
+                break
+            time.sleep(0.5)
+        assert len(addrs) == 3, addrs
+        third = list(addrs.values())[-1]
+        with urllib.request.urlopen(f"http://{third}/hello", timeout=30) as r:
+            assert json.loads(r.read())["result"]["hi"] is True
+    finally:
+        serve.shutdown()
+        c.shutdown()
