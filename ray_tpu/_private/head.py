@@ -580,24 +580,26 @@ class Head:
 
     def _write_state(self, state: dict):
         import pickle
-        import uuid as _uuid
 
-        path = self._snapshot_path()
-        tmp = f"{path}.tmp-{_uuid.uuid4().hex[:8]}"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, path)
+        from .snapshot_store import store_for
 
-    def _load_snapshot(self, path: str):
-        """Reload metadata from a previous head's snapshot. Processes are
-        gone: actors come back as DEAD records (name registry + specs kept
-        so they are discoverable and re-creatable), jobs that were RUNNING
-        are marked FAILED, the KV store (function/class exports included)
-        is restored verbatim."""
+        store_for(self._snapshot_path()).save(pickle.dumps(state))
+
+    def _load_snapshot(self, target: str):
+        """Reload metadata from a previous head's snapshot (any snapshot
+        store: plain file, sqlite:// versioned db, gs:// object). Processes
+        are gone: actors come back as DEAD records (name registry + specs
+        kept so they are discoverable and re-creatable), jobs that were
+        RUNNING are marked FAILED, the KV store (function/class exports
+        included) is restored verbatim."""
         import pickle
 
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        from .snapshot_store import store_for
+
+        data = store_for(target).load()
+        if data is None:
+            raise FileNotFoundError(f"no snapshot in store {target!r}")
+        state = pickle.loads(data)
         if state.get("version") != 1:
             raise ValueError(f"unsupported snapshot version {state.get('version')!r}")
         for ns, table in state.get("kv", {}).items():
@@ -638,7 +640,7 @@ class Head:
             self._spawn_bg(self._schedule_pg(rec))
         logger.info(
             "restored head state from %s: %d kv namespaces, %d actors, %d jobs",
-            path, len(state.get("kv", {})), len(state.get("actors", {})),
+            target, len(state.get("kv", {})), len(state.get("actors", {})),
             len(state.get("jobs", {})),
         )
 

@@ -46,13 +46,16 @@ def default_resources(num_cpus=None, num_tpus=None, resources=None) -> Dict[str,
     return out
 
 
-def _snapshot_session_id(path: str):
-    """The session id recorded in a head snapshot (None if unreadable)."""
+def _snapshot_session_id(target: str):
+    """The session id recorded in a head snapshot (None if unreadable).
+    `target` may name any snapshot store (file path, sqlite://, gs://)."""
     import pickle
 
+    from .snapshot_store import store_for
+
     try:
-        with open(path, "rb") as f:
-            return pickle.load(f).get("session_id")
+        data = store_for(target).load()
+        return pickle.loads(data).get("session_id") if data else None
     except Exception:
         return None
 

@@ -1,0 +1,200 @@
+"""Pluggable head-snapshot stores.
+
+Reference parity: src/ray/gcs/store_client/ — the GCS server persists its
+tables through a StoreClient interface with in-memory, redis, and
+observable backends (redis_store_client.h), so losing the head process
+doesn't lose cluster metadata, and losing the head HOST doesn't either if
+the store is external. ray_tpu's equivalent: the head's periodic state
+snapshot writes through a SnapshotStore chosen by the
+head_snapshot_path/head_restore_path config value:
+
+- plain path            -> FileSnapshotStore (atomic tmp+rename, default)
+- sqlite:///path/to.db  -> SqliteSnapshotStore: versioned rows in a SQLite
+  database (WAL), keeping a bounded history — point the path at a mounted
+  remote volume or replicate the db file and head-host disk loss stops
+  being metadata loss. This is the redis-parity external store: a real
+  database with history, not a single overwritten file.
+- gs://bucket/key.pkl   -> GcsSnapshotStore via the gsutil CLI (TPU hosts
+  ship it; RAY_TPU_GSUTIL overrides for tests/airgap), errors clearly
+  when unavailable.
+
+register_snapshot_store() adds custom schemes (e.g. a real redis client).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+
+class SnapshotStore:
+    def save(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Optional[bytes]:
+        """Latest snapshot bytes, or None when the store is empty."""
+        raise NotImplementedError
+
+
+class FileSnapshotStore(SnapshotStore):
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, data: bytes) -> None:
+        import uuid
+
+        tmp = f"{self.path}.tmp-{uuid.uuid4().hex[:8]}"
+        os.makedirs(os.path.dirname(self.path) or "/", exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self.path)
+
+    def load(self) -> Optional[bytes]:
+        try:
+            with open(self.path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+class SqliteSnapshotStore(SnapshotStore):
+    """Versioned snapshot rows; keeps the newest `keep` versions."""
+
+    def __init__(self, path: str, keep: int = 8):
+        self.path = path
+        self.keep = keep
+        self._schema_ready = False
+
+    def _conn(self):
+        import sqlite3
+
+        os.makedirs(os.path.dirname(self.path) or "/", exist_ok=True)
+        conn = sqlite3.connect(self.path, timeout=30)
+        if not self._schema_ready:
+            # once per store instance: WAL is persistent in the db file and
+            # the table is stable, so steady-state saves (every few hundred
+            # ms on the head) skip the pragma lock + schema check
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS head_snapshots ("
+                " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                " created_at REAL NOT NULL,"
+                " state BLOB NOT NULL)"
+            )
+            self._schema_ready = True
+        return conn
+
+    def save(self, data: bytes) -> None:
+        import time
+
+        conn = self._conn()
+        try:
+            with conn:
+                conn.execute(
+                    "INSERT INTO head_snapshots (created_at, state) VALUES (?, ?)",
+                    (time.time(), data),
+                )
+                conn.execute(
+                    "DELETE FROM head_snapshots WHERE id NOT IN "
+                    "(SELECT id FROM head_snapshots ORDER BY id DESC LIMIT ?)",
+                    (self.keep,),
+                )
+        finally:
+            conn.close()
+
+    def load(self) -> Optional[bytes]:
+        conn = self._conn()
+        try:
+            row = conn.execute(
+                "SELECT state FROM head_snapshots ORDER BY id DESC LIMIT 1"
+            ).fetchone()
+            return bytes(row[0]) if row else None
+        finally:
+            conn.close()
+
+    def history(self) -> list:
+        """(id, created_at) of stored versions, newest first."""
+        conn = self._conn()
+        try:
+            return conn.execute(
+                "SELECT id, created_at FROM head_snapshots ORDER BY id DESC"
+            ).fetchall()
+        finally:
+            conn.close()
+
+
+class GcsSnapshotStore(SnapshotStore):
+    def __init__(self, uri: str):
+        self.uri = uri
+
+    def _tool(self) -> str:
+        import shutil as _shutil
+
+        tool = os.environ.get("RAY_TPU_GSUTIL") or _shutil.which("gsutil")
+        if not tool:
+            raise RuntimeError(
+                "gs:// snapshot store needs the gsutil CLI (not found; set "
+                "RAY_TPU_GSUTIL to override)"
+            )
+        return tool
+
+    def save(self, data: bytes) -> None:
+        import subprocess
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".pkl") as tf:
+            tf.write(data)
+            tf.flush()
+            proc = subprocess.run(
+                [self._tool(), "cp", tf.name, self.uri],
+                capture_output=True, text=True,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(f"gsutil cp failed: {proc.stderr.strip()}")
+
+    def load(self) -> Optional[bytes]:
+        import subprocess
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".pkl") as tf:
+            proc = subprocess.run(
+                [self._tool(), "cp", self.uri, tf.name],
+                capture_output=True, text=True,
+            )
+            if proc.returncode != 0:
+                err = proc.stderr.lower()
+                # only a MISSING object means "empty store"; auth/network
+                # failures must raise, not silently mint a fresh cluster
+                if "no urls matched" in err or "does not exist" in err or (
+                    "not found" in err
+                ):
+                    return None
+                raise RuntimeError(f"gsutil cp failed: {proc.stderr.strip()}")
+            with open(tf.name, "rb") as f:
+                return f.read()
+
+
+_FACTORIES: Dict[str, Callable[[str], SnapshotStore]] = {
+    "sqlite": lambda target: SqliteSnapshotStore(target[len("sqlite://"):]),
+    "gs": GcsSnapshotStore,
+}
+
+
+def register_snapshot_store(scheme: str, factory: Callable[[str], SnapshotStore]):
+    _FACTORIES[scheme] = factory
+
+
+def store_for(target: str) -> SnapshotStore:
+    """Resolve a snapshot target string to its store. Plain paths (no
+    scheme) stay on the original single-file layout."""
+    if "://" not in target:
+        return FileSnapshotStore(target)
+    scheme = target.split("://", 1)[0]
+    factory = _FACTORIES.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"no snapshot store for scheme {scheme!r} "
+            f"(known: file-path, {sorted(_FACTORIES)}); "
+            "register_snapshot_store() to add one"
+        )
+    return factory(target)

@@ -62,16 +62,10 @@ class ServeController:
         with self._lock:
             self._routes[prefix] = (deployment_name, pass_request)
             proxies = list(self._proxies.values())
-        import ray_tpu
-
-        for h in proxies:
-            try:
-                ray_tpu.get(
-                    h.set_route.remote(prefix, deployment_name, pass_request),
-                    timeout=10,
-                )
-            except Exception:
-                pass  # unhealthy proxy: the reconcile loop replaces it
+        self._broadcast(
+            [h.set_route.remote(prefix, deployment_name, pass_request)
+             for h in proxies]
+        )
         return True
 
     def remove_route(self, route_prefix: str):
@@ -79,14 +73,18 @@ class ServeController:
         with self._lock:
             self._routes.pop(prefix, None)
             proxies = list(self._proxies.values())
+        self._broadcast([h.remove_route.remote(prefix) for h in proxies])
+        return True
+
+    @staticmethod
+    def _broadcast(refs):
+        """Push to all proxies with ONE shared deadline — a wedged member
+        costs one bounded wait, never N serial timeouts on serve.run's
+        critical path (the reconcile loop replaces stragglers)."""
         import ray_tpu
 
-        for h in proxies:
-            try:
-                ray_tpu.get(h.remove_route.remote(prefix), timeout=10)
-            except Exception:
-                pass
-        return True
+        if refs:
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
 
     def start_proxies(self, port: int = 0) -> Dict[str, str]:
         """Enable the per-node fleet; returns {node_id: host:port}."""
@@ -120,8 +118,19 @@ class ServeController:
         for prefix, (dep, pr) in routes.items():
             ray_tpu.get(h.set_route.remote(prefix, dep, pr), timeout=10)
         with self._lock:
-            self._proxies[node_id] = h
-            self._proxy_addrs[node_id] = f"{info['host']}:{info['port']}"
+            if not self._proxy_fleet:
+                # shutdown raced this spawn: don't leak a detached proxy
+                # that would block the name for every future fleet
+                abort = True
+            else:
+                abort = False
+                self._proxies[node_id] = h
+                self._proxy_addrs[node_id] = f"{info['host']}:{info['port']}"
+        if abort:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
 
     def _ensure_proxies(self):
         """One healthy proxy per alive node: spawn on new nodes, drop on
@@ -243,6 +252,8 @@ class ServeController:
         import ray_tpu
 
         with self._lock:
+            self._proxy_fleet = False  # in-flight spawns self-abort
+            self._routes.clear()
             proxies = list(self._proxies.values())
             self._proxies.clear()
             self._proxy_addrs.clear()

@@ -311,7 +311,14 @@ class HTTPProxyActor:
     # ---------------------------------------------------------- actor API
 
     def ready(self):
-        return {"host": self.host, "port": self.port}
+        host = self.host
+        if host in ("0.0.0.0", ""):
+            # advertise a ROUTABLE address, not the wildcard bind (fleet
+            # proxies feed proxy_addresses() -> load balancers off-box)
+            from .._private.head import _advertise_host
+
+            host = _advertise_host(host)
+        return {"host": host, "port": self.port}
 
     def set_route(
         self, route_prefix: str, deployment_name: str, pass_request: bool = False

@@ -133,7 +133,15 @@ def _bind_args(fn: Callable, request: Request, path_params: Dict[str, str]) -> d
         elif name in path_params:
             kwargs[name] = _cast(path_params[name], param.annotation)
         elif name in request.query:
-            kwargs[name] = _cast(str(request.query[name]), param.annotation)
+            value = request.query[name]
+            if isinstance(value, list):
+                # repeated query param (?x=1&x=2): scalar handlers get the
+                # LAST value (FastAPI semantics); a list annotation gets all
+                if param.annotation is list:
+                    kwargs[name] = value
+                    continue
+                value = value[-1]
+            kwargs[name] = _cast(str(value), param.annotation)
         elif param.default is not inspect.Parameter.empty:
             kwargs[name] = param.default
         else:

@@ -80,8 +80,8 @@ class FileStorage(StorageProvider):
             # reader sees the old tree or the new one, never a partial copy
             tmp = f"{dest}.new-{os.getpid()}"
             old = f"{dest}.old-{os.getpid()}"
-            shutil.copytree(local_dir, tmp)
             try:
+                shutil.copytree(local_dir, tmp)
                 if os.path.isdir(dest):
                     os.rename(dest, old)
                 os.rename(tmp, dest)

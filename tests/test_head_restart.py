@@ -18,19 +18,27 @@ from ray_tpu._private.spawn import child_pythonpath
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _head_env(tmp):
+def _snapshot_target(tmp, backend):
+    if backend == "sqlite":
+        # the pluggable EXTERNAL store (reference: redis_store_client.h) —
+        # a versioned database, not a single file on the session dir
+        return "sqlite://" + os.path.join(tmp, "head_meta.db")
+    return os.path.join(tmp, "head_snap.pkl")
+
+
+def _head_env(tmp, backend="file"):
     env = dict(os.environ)
     env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
     env["JAX_PLATFORMS"] = "cpu"
-    env["RAY_TPU_HEAD_SNAPSHOT_PATH"] = os.path.join(tmp, "head_snap.pkl")
+    env["RAY_TPU_HEAD_SNAPSHOT_PATH"] = _snapshot_target(tmp, backend)
     env["RAY_TPU_HEAD_SNAPSHOT_PERIOD_MS"] = "300"
     env["RAY_TPU_DASHBOARD_ENABLED"] = "0"
     env["RAY_TPU_WORKER_POOL_PRESTART"] = "0"
     return env
 
 
-def _start_head(tmp, port, restore=False):
-    env = _head_env(tmp)
+def _start_head(tmp, port, restore=False, backend="file"):
+    env = _head_env(tmp, backend)
     if restore:
         env["RAY_TPU_HEAD_RESTORE_PATH"] = env["RAY_TPU_HEAD_SNAPSHOT_PATH"]
     proc = subprocess.Popen(
@@ -63,7 +71,8 @@ def _start_agent(addr, node_id):
     )
 
 
-def test_head_kill9_restart_cluster_drains(tmp_path):
+@pytest.mark.parametrize("backend", ["file", "sqlite"])
+def test_head_kill9_restart_cluster_drains(tmp_path, backend):
     import socket
 
     s = socket.socket()
@@ -72,7 +81,7 @@ def test_head_kill9_restart_cluster_drains(tmp_path):
     s.close()
     tmp = str(tmp_path)
 
-    head, addr = _start_head(tmp, port)
+    head, addr = _start_head(tmp, port, backend=backend)
     agent = _start_agent(addr, "node-ft")
     try:
         ray_tpu.init(address=addr)
@@ -107,8 +116,16 @@ def test_head_kill9_restart_cluster_drains(tmp_path):
         head.wait(timeout=10)
 
         # ---- restart from snapshot on the SAME port ----
-        head, addr2 = _start_head(tmp, port, restore=True)
+        head, addr2 = _start_head(tmp, port, restore=True, backend=backend)
         assert addr2 == addr
+        if backend == "sqlite":
+            # the external store kept VERSIONED history, not one file
+            from ray_tpu._private.snapshot_store import SqliteSnapshotStore
+
+            hist = SqliteSnapshotStore(
+                _snapshot_target(tmp, "sqlite")[len("sqlite://"):]
+            ).history()
+            assert len(hist) >= 2
 
         # agent + actor worker reconnect; the driver reconnects lazily on
         # its next request. The actor's IN-MEMORY state must have survived

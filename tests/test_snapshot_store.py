@@ -1,0 +1,91 @@
+"""Snapshot store unit tests (reference: gcs/store_client/ — pluggable
+metadata persistence backends)."""
+
+import os
+import stat
+
+import pytest
+
+from ray_tpu._private.snapshot_store import (
+    FileSnapshotStore,
+    GcsSnapshotStore,
+    SqliteSnapshotStore,
+    register_snapshot_store,
+    store_for,
+)
+
+
+def test_scheme_resolution(tmp_path):
+    assert isinstance(store_for(str(tmp_path / "x.pkl")), FileSnapshotStore)
+    st = store_for(f"sqlite://{tmp_path}/m.db")
+    assert isinstance(st, SqliteSnapshotStore)
+    assert st.path == f"{tmp_path}/m.db"
+    assert isinstance(store_for("gs://b/k.pkl"), GcsSnapshotStore)
+    with pytest.raises(ValueError, match="no snapshot store"):
+        store_for("redis://localhost/0")
+
+
+def test_file_store_roundtrip(tmp_path):
+    st = FileSnapshotStore(str(tmp_path / "s.pkl"))
+    assert st.load() is None
+    st.save(b"v1")
+    st.save(b"v2")
+    assert st.load() == b"v2"
+
+
+def test_sqlite_store_versions(tmp_path):
+    st = SqliteSnapshotStore(str(tmp_path / "m.db"), keep=3)
+    assert st.load() is None
+    for i in range(5):
+        st.save(b"v%d" % i)
+    assert st.load() == b"v4"
+    hist = st.history()
+    assert len(hist) == 3  # bounded history
+    # a second store instance (new process) reads the same db
+    assert SqliteSnapshotStore(str(tmp_path / "m.db")).load() == b"v4"
+
+
+def test_register_custom_scheme(tmp_path):
+    class Mem(FileSnapshotStore):
+        pass
+
+    register_snapshot_store("mem", lambda t: Mem(str(tmp_path / "mem.pkl")))
+    try:
+        st = store_for("mem://whatever")
+        st.save(b"x")
+        assert st.load() == b"x"
+    finally:
+        from ray_tpu._private import snapshot_store
+
+        snapshot_store._FACTORIES.pop("mem", None)
+
+
+def test_gcs_store_fenced_and_shimmed(tmp_path, monkeypatch):
+    import shutil as _sh
+
+    monkeypatch.delenv("RAY_TPU_GSUTIL", raising=False)
+    monkeypatch.setattr(_sh, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="gsutil"):
+        GcsSnapshotStore("gs://b/k").save(b"x")
+    monkeypatch.undo()
+
+    root = tmp_path / "fake"
+    root.mkdir()
+    shim = tmp_path / "gsutil"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f"ROOT={root}\n"
+        'cmd="$1"; shift\n'
+        '[ "$cmd" = cp ] || exit 1\n'
+        'src="$1"; dst="$2"\n'
+        'case "$src" in gs://*) src="$ROOT/${src#gs://}";; esac\n'
+        'case "$dst" in gs://*) dst="$ROOT/${dst#gs://}";; esac\n'
+        '[ -f "$src" ] || { echo "No URLs matched: $1" >&2; exit 1; }\n'
+        'mkdir -p "$(dirname "$dst")" && cp "$src" "$dst"\n'
+    )
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("RAY_TPU_GSUTIL", str(shim))
+    st = GcsSnapshotStore("gs://bucket/head.pkl")
+    assert st.load() is None
+    st.save(b"cloud-snap")
+    assert st.load() == b"cloud-snap"
