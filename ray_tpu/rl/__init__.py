@@ -20,6 +20,7 @@ actors.
 
 from .a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from .algorithm import Algorithm, WorkerSet  # noqa: F401
+from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayActor  # noqa: F401
 from .appo import APPO, APPOConfig, APPOLearner  # noqa: F401
 from .config import AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
@@ -36,7 +37,7 @@ from .offline_algos import (  # noqa: F401
 from .models import ac_apply, init_ac_params  # noqa: F401
 from .policy import Policy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
-from .replay_buffer import ReplayBuffer  # noqa: F401
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .ddpg import DDPG, DDPGConfig, DDPGLearner  # noqa: F401
@@ -50,7 +51,9 @@ from .multi_agent import (  # noqa: F401
     MultiAgentRolloutWorker,
     make_multi_agent,
 )
+from .maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from .qmix import QMIX, QMIXConfig  # noqa: F401
+from .qmix_rec import RecurrentQMIX, RecurrentQMIXConfig  # noqa: F401
 from . import offline  # noqa: F401,E402
 
 from .._private.usage import record_library_usage as _rlu  # noqa: E402

@@ -1,0 +1,275 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Reference parity: rllib/algorithms/apex_dqn/apex_dqn.py — the Ape-X
+architecture (Horgan et al.): many exploration actors with an epsilon
+LADDER push transitions to dedicated replay-buffer ACTORS; the learner
+samples prioritized batches from them, trains, and writes updated TD-error
+priorities back; weights broadcast periodically. The rollout→replay data
+path rides the object store actor-to-actor (`replay.add.remote(sample_ref)`
+— the driver never touches transition bytes), which is exactly the
+reference's ray-object-store replay plumbing.
+
+TPU-first: the learner's per-batch update (IS-weighted double-Q Huber step
++ per-sample |TD| for the priority write-back) is ONE jitted function; the
+distributed machinery around it is ordinary actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .dqn import DQNConfig, DQNLearner, _EpsilonGreedyWorker
+from .learner import LearnerGroup, TrainState
+from .models import q_apply
+from .replay_buffer import PrioritizedReplayBuffer
+from .rollout_worker import _make_env
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+
+
+class ReplayActor:
+    """A replay shard as an actor (reference: apex's ReplayActor). Rollout
+    actors push into it; the learner samples from it and writes priorities
+    back. Holding the buffer in an actor is what lets N rollout actors and
+    the learner run fully asynchronously."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, seed: int = 0):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha, seed=seed)
+
+    def ready(self) -> bool:
+        return True
+
+    def add(self, batch: SampleBatch) -> int:
+        self.buffer.add(batch)
+        return len(self.buffer)
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+    def sample(self, batch_size: int, beta: float = 0.4):
+        if len(self.buffer) < batch_size:
+            return None
+        batch, idx, weights = self.buffer.sample(batch_size, beta=beta)
+        return dict(batch), idx, weights
+
+    def update_priorities(self, indices, priorities) -> bool:
+        self.buffer.update_priorities(indices, priorities)
+        return True
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ApexDQN
+        self.num_rollout_workers = 2
+        self.replay_buffer_capacity: int = 100_000
+        self.prioritized_replay_alpha: float = 0.6
+        self.prioritized_replay_beta: float = 0.4
+        # epsilon ladder (Ape-X eq. 1): worker i of N explores with
+        # eps_base ** (1 + i/(N-1) * eps_exponent)
+        self.epsilon_base: float = 0.4
+        self.epsilon_exponent: float = 7.0
+        self.samples_per_iteration: int = 4  # sample() calls per worker/iter
+
+
+class ApexDQNLearner(DQNLearner):
+    """DQN learner whose update is importance-weighted and returns the
+    per-sample |TD| the replay actor needs for its priority write-back."""
+
+    def _build_prio_update(self):
+        optimizer = self.optimizer
+        gamma, double_q = self.gamma, self.double_q
+
+        def update(state: TrainState, mb, is_weights):
+            def loss_fn(online):
+                q = q_apply(online, mb[OBS])
+                q_sel = jnp.take_along_axis(q, mb[ACTIONS][:, None], axis=-1)[:, 0]
+                q_next_t = q_apply(state.params["target"], mb[NEXT_OBS])
+                if double_q:
+                    a_star = jnp.argmax(q_apply(online, mb[NEXT_OBS]), axis=-1)
+                    q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=-1)[:, 0]
+                else:
+                    q_next = jnp.max(q_next_t, axis=-1)
+                y = mb[REWARDS] + gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
+                td = q_sel - y
+                huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+                loss = jnp.mean(is_weights * huber)
+                return loss, (td, q_sel)
+
+            (loss, (td, q_sel)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params["online"]
+            )
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params["online"]
+            )
+            online = optax.apply_updates(state.params["online"], updates)
+            new_state = TrainState(
+                {"online": online, "target": state.params["target"]},
+                opt_state,
+                state.rng,
+            )
+            metrics = {"loss": loss, "mean_q": jnp.mean(q_sel)}
+            return new_state, jnp.abs(td), metrics
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def update_prioritized(self, batch: Dict[str, np.ndarray], is_weights):
+        if getattr(self, "_prio_update_fn", None) is None:
+            self._prio_update_fn = self._build_prio_update()
+        mb = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()}
+        self.state, td_abs, metrics = self._prio_update_fn(
+            self.state, mb, jnp.asarray(is_weights)
+        )
+        self._grad_steps += 1
+        if self._grad_steps % self.target_update_freq == 0:
+            p = self.state.params
+            self.state = self.state._replace(
+                params={
+                    "online": p["online"],
+                    "target": jax.tree_util.tree_map(jnp.copy, p["online"]),
+                }
+            )
+        return np.asarray(td_abs), {k: float(v) for k, v in metrics.items()}
+
+
+class ApexDQN(Algorithm):
+    _config_class = ApexDQNConfig
+
+    def __init__(self, config=None, **kwargs):
+        # validate BEFORE Algorithm.__init__ spawns the WorkerSet, so a bad
+        # config doesn't leak live envs/actors on the error path
+        n = (
+            config.get("num_rollout_workers")
+            if isinstance(config, dict)
+            else getattr(config, "num_rollout_workers", None)
+        )
+        if n is not None and n < 1:
+            raise ValueError(
+                "ApexDQN is the DISTRIBUTED replay architecture: it needs "
+                "num_rollout_workers >= 1 (use DQN for single-process runs)"
+            )
+        super().__init__(config, **kwargs)
+
+    def _worker_cls(self):
+        return _EpsilonGreedyWorker
+
+    def _worker_kwargs(self):
+        cfg = self.algo_config
+        return dict(
+            env_spec=cfg.env,
+            num_envs=cfg.num_envs_per_worker,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            policy_hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+
+    def _build_learner(self) -> LearnerGroup:
+        import ray_tpu
+
+        cfg = self.algo_config
+        if cfg.num_rollout_workers < 1:
+            raise ValueError(
+                "ApexDQN is the DISTRIBUTED replay architecture: it needs "
+                "num_rollout_workers >= 1 (use DQN for single-process runs)"
+            )
+        env = _make_env(cfg.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+
+        Replay = ray_tpu.remote(ReplayActor)
+        self.replay_actor = Replay.remote(
+            cfg.replay_buffer_capacity,
+            alpha=cfg.prioritized_replay_alpha,
+            seed=cfg.seed,
+        )
+        ray_tpu.get(self.replay_actor.ready.remote())
+
+        # epsilon ladder across workers (Ape-X): diverse exploration
+        n = max(1, cfg.num_rollout_workers)
+        self._worker_eps = [
+            cfg.epsilon_base ** (1.0 + (i / max(1, n - 1)) * cfg.epsilon_exponent)
+            for i in range(n)
+        ]
+
+        def factory():
+            return ApexDQNLearner(
+                obs_dim=obs_dim,
+                num_actions=num_actions,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                lr=cfg.lr,
+                gamma=cfg.gamma,
+                double_q=cfg.double_q,
+                target_update_freq=cfg.target_update_freq,
+                num_sgd_iter=cfg.num_sgd_iter,
+                minibatch_size=cfg.minibatch_size,
+                seed=cfg.seed,
+            )
+
+        return LearnerGroup(factory, remote=False)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.algo_config
+        learner: ApexDQNLearner = self.learner_group._learner
+        workers = self.workers._remote_workers
+
+        # 1. rollout -> replay, actor-to-actor: pass each sample's REF to
+        # the replay actor; transition bytes ride the object store, never
+        # the driver (reference: apex's store_to_replay pipeline)
+        add_refs = []
+        for _ in range(cfg.samples_per_iteration):
+            for w, eps in zip(workers, self._worker_eps):
+                add_refs.append(self.replay_actor.add.remote(w.sample.remote(eps)))
+        sizes = ray_tpu.get(add_refs)
+        self._timesteps_total += (
+            cfg.samples_per_iteration
+            * len(workers)
+            * cfg.rollout_fragment_length
+            * cfg.num_envs_per_worker
+        )
+
+        metrics: Dict[str, Any] = {"replay_size": int(sizes[-1])}
+        if sizes[-1] < cfg.learning_starts:
+            return metrics
+
+        # 2. prioritized learn loop with TD-priority write-back; the next
+        # batch is prefetched while the current one trains — but only when
+        # another iteration will actually consume it (a dangling sample is
+        # an O(buffer) cumsum + transfer thrown away)
+        next_ref = self.replay_actor.sample.remote(
+            cfg.minibatch_size, cfg.prioritized_replay_beta
+        )
+        for i in range(cfg.num_sgd_iter):
+            got = ray_tpu.get(next_ref)
+            if i + 1 < cfg.num_sgd_iter and got is not None:
+                next_ref = self.replay_actor.sample.remote(
+                    cfg.minibatch_size, cfg.prioritized_replay_beta
+                )
+            if got is None:
+                break
+            batch, idx, weights = got
+            td_abs, m = learner.update_prioritized(batch, weights)
+            self.replay_actor.update_priorities.remote(idx, td_abs)
+            metrics.update(m)
+
+        # 3. weight broadcast
+        weights = learner.get_weights()
+        ray_tpu.get([w.set_weights.remote(weights) for w in workers])
+        return metrics
+
+    def cleanup(self) -> None:
+        import ray_tpu
+
+        super().cleanup()
+        try:
+            ray_tpu.kill(self.replay_actor)
+        except Exception:
+            pass
+
+    stop = cleanup

@@ -1,0 +1,293 @@
+"""MADDPG: multi-agent DDPG with centralized critics, decentralized actors.
+
+Reference parity: rllib/algorithms/maddpg/ (Lowe et al., "Multi-Agent
+Actor-Critic for Mixed Cooperative-Competitive Environments") — each agent
+owns a deterministic actor over its OWN observation, while its critic sees
+ALL agents' observations and actions (centralized training, decentralized
+execution). This is the continuous-action MARL family the discrete
+MAPPO/QMIX stack doesn't cover.
+
+TPU-first: all agents' critic and actor updates for a minibatch compile
+into ONE jitted function (a static python loop over agents inside the jit
+— per-agent shapes may differ, the compiler sees each as its own fused
+subgraph), with Polyak target updates folded in. One dispatch per gradient
+step for the whole population.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .config import AlgorithmConfig
+from .models import _tower_init, _mlp
+from .multi_agent import MultiAgentEnv
+from .replay_buffer import ReplayBuffer
+from .sample_batch import SampleBatch
+from ..tune.trainable import Trainable
+
+
+def _actor_apply(params, obs):
+    return jnp.tanh(_mlp(params, obs))
+
+
+def _critic_apply(params, joint):
+    return _mlp(params, joint)[..., 0]
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=MADDPG)
+        self.actor_lr: float = 1e-3
+        self.critic_lr: float = 1e-3
+        self.tau: float = 0.01
+        self.buffer_size: int = 100_000
+        self.learning_starts: int = 1_000
+        self.minibatch_size: int = 256
+        self.num_sgd_iter: int = 8
+        self.exploration_noise: float = 0.2
+        self.train_batch_size = 256  # env steps collected per iteration
+        self.model = {"hidden": (64, 64)}
+
+    def environment(self, env: Callable[[], MultiAgentEnv], **kwargs):
+        self.env = env
+        return self
+
+
+class MADDPGLearner:
+    """All-agent update as one compiled step (critics + actors + Polyak)."""
+
+    def __init__(self, agent_specs: Dict[str, tuple], hidden, actor_lr,
+                 critic_lr, gamma, tau, seed: int = 0):
+        # agent_specs: {agent_id: (obs_dim, act_dim)}; insertion order fixes
+        # the joint concat layout everywhere
+        self.agent_ids = list(agent_specs)
+        self.specs = agent_specs
+        self.gamma, self.tau = gamma, tau
+        joint_dim = sum(o + a for o, a in agent_specs.values())
+        rng = jax.random.PRNGKey(seed)
+        params = {}
+        for aid, (obs_dim, act_dim) in agent_specs.items():
+            rng, k1, k2 = jax.random.split(rng, 3)
+            params[aid] = {
+                "actor": _tower_init(k1, (obs_dim, *hidden, act_dim), 0.01),
+                "critic": _tower_init(k2, (joint_dim, *hidden, 1), 1.0),
+            }
+        self.params = params
+        self.target = jax.tree_util.tree_map(jnp.copy, params)
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        self.opt_state = {
+            aid: {
+                "actor": self.actor_opt.init(params[aid]["actor"]),
+                "critic": self.critic_opt.init(params[aid]["critic"]),
+            }
+            for aid in self.agent_ids
+        }
+        self._update_fn = None
+
+    def _build_update(self):
+        agent_ids, gamma, tau = self.agent_ids, self.gamma, self.tau
+        actor_opt, critic_opt = self.actor_opt, self.critic_opt
+
+        def update(params, target, opt_state, mb):
+            obs = {a: mb[f"obs_{a}"] for a in agent_ids}
+            acts = {a: mb[f"act_{a}"] for a in agent_ids}
+            metrics = {}
+            # target joint next action (all target actors, computed once)
+            next_acts = [
+                _actor_apply(target[a]["actor"], mb[f"next_obs_{a}"])
+                for a in agent_ids
+            ]
+            next_joint = jnp.concatenate(
+                [mb[f"next_obs_{a}"] for a in agent_ids] + next_acts, axis=-1
+            )
+            joint = jnp.concatenate(
+                [obs[a] for a in agent_ids] + [acts[a] for a in agent_ids], axis=-1
+            )
+            for a in agent_ids:
+                # ---- centralized critic: TD target from target nets
+                q_next = _critic_apply(target[a]["critic"], next_joint)
+                y = mb[f"rew_{a}"] + gamma * (1.0 - mb["done"]) * (
+                    jax.lax.stop_gradient(q_next)
+                )
+
+                def critic_loss(cp):
+                    q = _critic_apply(cp, joint)
+                    return jnp.mean((q - y) ** 2)
+
+                cl, cgrads = jax.value_and_grad(critic_loss)(params[a]["critic"])
+                cup, opt_state[a]["critic"] = critic_opt.update(
+                    cgrads, opt_state[a]["critic"], params[a]["critic"]
+                )
+                params[a]["critic"] = optax.apply_updates(params[a]["critic"], cup)
+
+                # ---- decentralized actor: ascend own critic with own
+                # action swapped for the policy's output
+                def actor_loss(ap):
+                    my_act = _actor_apply(ap, obs[a])
+                    cols = [obs[x] for x in agent_ids] + [
+                        my_act if x == a else acts[x] for x in agent_ids
+                    ]
+                    q = _critic_apply(
+                        params[a]["critic"], jnp.concatenate(cols, axis=-1)
+                    )
+                    return -jnp.mean(q)
+
+                al, agrads = jax.value_and_grad(actor_loss)(params[a]["actor"])
+                aup, opt_state[a]["actor"] = actor_opt.update(
+                    agrads, opt_state[a]["actor"], params[a]["actor"]
+                )
+                params[a]["actor"] = optax.apply_updates(params[a]["actor"], aup)
+                metrics[f"critic_loss_{a}"] = cl
+                metrics[f"actor_loss_{a}"] = al
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target, params
+            )
+            return params, target, opt_state, metrics
+
+        return jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def update(self, mb: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        mb = {k: jnp.asarray(v) for k, v in mb.items()}
+        self.params, self.target, self.opt_state, metrics = self._update_fn(
+            self.params, self.target, self.opt_state, mb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def act(self, obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {
+            a: np.asarray(_actor_apply(self.params[a]["actor"], obs[a]))
+            for a in obs
+        }
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.device_put(weights)
+
+
+class MADDPG(Trainable):
+    """Driver-local env loop + joint replay + all-agent jitted updates
+    (the reference's MADDPG also trains through one local worker)."""
+
+    _config_class = MADDPGConfig
+
+    def __init__(self, config: Optional[MADDPGConfig] = None, **kwargs):
+        if config is None:
+            config = MADDPGConfig()
+        if isinstance(config, dict):
+            # Tune constructs trainables with plain dicts: apply key-by-key
+            # (Algorithm.__init__'s convention)
+            cfg_obj = MADDPGConfig()
+            for k, v in config.items():
+                setattr(cfg_obj, k, v)
+            config = cfg_obj
+        self.algo_config = config
+        cfg = config
+        self.env: MultiAgentEnv = cfg.env()
+        obs, _ = self.env.reset(seed=cfg.seed)
+        self.agent_ids = sorted(obs)
+        specs = {}
+        for a in self.agent_ids:
+            # per-agent spaces when the env provides them, else the uniform
+            # MultiAgentEnv.action_space
+            spaces = getattr(self.env, "action_spaces", None) or {}
+            act_space = spaces.get(a) or self.env.action_space
+            specs[a] = (int(np.prod(np.shape(obs[a]))), int(np.prod(act_space.shape)))
+        self.specs = specs
+        hidden = tuple(cfg.model.get("hidden", (64, 64)))
+        self.learner = MADDPGLearner(
+            specs, hidden, cfg.actor_lr, cfg.critic_lr, cfg.gamma, cfg.tau,
+            seed=cfg.seed,
+        )
+        self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._obs = {a: np.asarray(obs[a], np.float32) for a in self.agent_ids}
+        self._rng = np.random.default_rng(cfg.seed)
+        self._ep_return = 0.0
+        self._ep_returns: List[float] = []
+        self._timesteps_total = 0
+        self.iteration = 0
+
+    # ------------------------------------------------------------- rollout
+
+    def _collect(self, n_steps: int):
+        cfg = self.algo_config
+        for _ in range(n_steps):
+            stacked = {a: self._obs[a][None] for a in self.agent_ids}
+            acts = self.learner.act(stacked)
+            actions = {}
+            for a in self.agent_ids:
+                noise = cfg.exploration_noise * self._rng.standard_normal(
+                    self.specs[a][1]
+                ).astype(np.float32)
+                actions[a] = np.clip(acts[a][0] + noise, -1.0, 1.0)
+            nobs, rews, terms, truncs, _ = self.env.step(actions)
+            done = bool(terms.get("__all__", False))
+            trunc = bool(truncs.get("__all__", False))
+            row = {"done": np.array([np.float32(done)])}
+            for a in self.agent_ids:
+                row[f"obs_{a}"] = self._obs[a][None]
+                row[f"act_{a}"] = np.asarray(actions[a], np.float32)[None]
+                row[f"rew_{a}"] = np.array([np.float32(rews[a])])
+                row[f"next_obs_{a}"] = np.asarray(nobs[a], np.float32)[None] \
+                    if a in nobs else self._obs[a][None]
+            self.replay.add(SampleBatch(row))
+            self._ep_return += float(np.mean([rews[a] for a in self.agent_ids]))
+            self._timesteps_total += 1
+            if done or trunc:
+                self._ep_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                obs, _ = self.env.reset()
+                nobs = obs
+            self._obs = {a: np.asarray(nobs[a], np.float32) for a in self.agent_ids}
+
+    # ------------------------------------------------------------- training
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        self._collect(cfg.train_batch_size)
+        metrics: Dict[str, Any] = {}
+        if len(self.replay) >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_iter):
+                mb = self.replay.sample(cfg.minibatch_size)
+                metrics.update(self.learner.update(dict(mb)))
+        window = self._ep_returns[-100:]
+        if window:
+            metrics["episode_reward_mean"] = float(np.mean(window))
+        metrics["timesteps_total"] = self._timesteps_total
+        return metrics
+
+    def train(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    def save_checkpoint(self) -> Any:
+        return {"weights": self.learner.get_weights(),
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.learner.set_weights(checkpoint["weights"])
+        self._timesteps_total = checkpoint.get("timesteps_total", 0)
+
+    def compute_actions(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Deterministic (no-noise) joint action for evaluation."""
+        stacked = {a: np.asarray(obs[a], np.float32)[None] for a in obs}
+        return {a: v[0] for a, v in self.learner.act(stacked).items()}
+
+    def stop(self) -> None:
+        try:
+            self.env.close()
+        except Exception:
+            pass
+
+    cleanup = stop

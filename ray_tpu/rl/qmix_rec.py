@@ -1,0 +1,313 @@
+"""Recurrent QMIX: GRU agents + EPISODE replay for POMDP cooperative MARL.
+
+Reference parity: rllib/algorithms/qmix/qmix_policy.py — the reference's
+QMIX is recurrent (RNN agent networks unrolled over whole episodes drawn
+from an episode replay buffer), which is what lets agents act on memory in
+partially observed tasks; qmix.py here is the feedforward transition-replay
+variant. TPU-first: the GRU unroll is a lax.scan over time INSIDE one
+jitted update (batch of episodes in parallel), mixer and TD masking fused
+into the same program — one dispatch per gradient step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .learner import TrainState
+from .multi_agent import MultiAgentEnv
+from .qmix import QMIX, QMIXConfig, _dense, mix, init_qmix_params
+
+
+def init_rec_params(rng, obs_dim, n_agents, n_actions, state_dim,
+                    rnn_hidden=64, mixing_embed=32):
+    """GRU agent net (shared, id-onehot input) + the same mixer hypernets."""
+    ks = jax.random.split(rng, 10)
+    in_dim = obs_dim + n_agents
+    agent = {
+        "enc": _dense(ks[0], in_dim, rnn_hidden),
+        # GRU gates: one fused input->3H and hidden->3H block each
+        "gru_x": _dense(ks[1], rnn_hidden, 3 * rnn_hidden),
+        "gru_h": _dense(ks[2], rnn_hidden, 3 * rnn_hidden),
+        "out": _dense(ks[3], rnn_hidden, n_actions, scale=0.01),
+    }
+    mixer = init_qmix_params(
+        ks[4], obs_dim, n_agents, n_actions, state_dim,
+        mixing_embed=mixing_embed,
+    )["mixer"]
+    return {"agent": agent, "mixer": mixer}
+
+
+def gru_cell(params, h, x_enc):
+    """Fused-gate GRU step: h' = GRU(h, x_enc). Shapes [..., H]."""
+    gx = x_enc @ params["gru_x"]["w"] + params["gru_x"]["b"]
+    gh = h @ params["gru_h"]["w"] + params["gru_h"]["b"]
+    H = h.shape[-1]
+    z = jax.nn.sigmoid(gx[..., :H] + gh[..., :H])
+    r = jax.nn.sigmoid(gx[..., H:2 * H] + gh[..., H:2 * H])
+    n = jnp.tanh(gx[..., 2 * H:] + r * gh[..., 2 * H:])
+    return (1.0 - z) * n + z * h
+
+
+def agent_step(params, h, obs_id):
+    """One acting step: (hidden, obs+id) -> (new hidden, q-values)."""
+    a = params["agent"]
+    x = jax.nn.relu(obs_id @ a["enc"]["w"] + a["enc"]["b"])
+    h = gru_cell(a, h, x)
+    return h, h @ a["out"]["w"] + a["out"]["b"]
+
+
+def agent_q_unroll(params, obs_id_seq, h0):
+    """Unroll over time: [T, ..., in] -> [T, ..., n_actions]."""
+
+    def step(h, obs_id):
+        h, q = agent_step(params, h, obs_id)
+        return h, q
+
+    _, q_seq = jax.lax.scan(step, h0, obs_id_seq)
+    return q_seq
+
+
+class RecurrentQMIXConfig(QMIXConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = RecurrentQMIX
+        self.rnn_hidden: int = 64
+        self.episode_limit: int = 32       # max episode length (padded to)
+        self.buffer_size = 2_000           # EPISODES, not transitions
+        self.learning_starts = 32          # episodes before training
+        self.minibatch_size = 32           # episodes per gradient step
+        self.train_batch_size = 8          # episodes collected per iteration
+
+
+class RecurrentQMIX(QMIX):
+    """Episode-replay QMIX with memoryful agents (reference qmix_policy.py
+    recurrence). Collection runs whole episodes; the update unrolls the
+    shared GRU over each episode with TD masking past episode end."""
+
+    _config_class = RecurrentQMIXConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = self.algo_config
+        if not callable(cfg.env):
+            raise ValueError("RecurrentQMIX needs a callable MultiAgentEnv maker")
+        self.env: MultiAgentEnv = cfg.env()
+        self.agents = list(self.env.possible_agents)
+        self.n_agents = len(self.agents)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.n_actions = int(self.env.action_space.n)
+        self._obs, _ = self.env.reset(seed=cfg.seed)
+        self.state_dim = int(np.asarray(self.env.get_state()).shape[0])
+
+        params = init_rec_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.n_agents,
+            self.n_actions, self.state_dim, cfg.rnn_hidden, cfg.mixing_embed,
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(10.0), optax.adam(cfg.lr)
+        )
+        self.state = TrainState(
+            params={"online": params, "target": jax.tree.map(jnp.copy, params)},
+            opt_state=self.optimizer.init(params),
+            rng=jax.random.PRNGKey(cfg.seed + 1),
+        )
+        self._step_fn = jax.jit(agent_step)
+        self._update_fn = None
+        self._grad_steps = 0
+        self._eps_rng = np.random.default_rng(cfg.seed + 2)
+        self._episodes: List[dict] = []
+        self._buf_pos = 0
+        self._env_steps = 0
+        self._recent_returns: List[float] = []
+        self._id_eye = np.eye(self.n_agents, dtype=np.float32)
+
+    # ------------------------------------------------------------ rollouts
+
+    def _collect_episode(self) -> dict:
+        cfg = self.algo_config
+        T = cfg.episode_limit
+        ep = {
+            "obs": np.zeros((T + 1, self.n_agents, self.obs_dim), np.float32),
+            "state": np.zeros((T + 1, self.state_dim), np.float32),
+            "actions": np.zeros((T, self.n_agents), np.int64),
+            "reward": np.zeros(T, np.float32),
+            "done": np.zeros(T, np.float32),
+            "mask": np.zeros(T, np.float32),
+        }
+        obs, _ = self.env.reset()
+        h = jnp.zeros((self.n_agents, cfg.rnn_hidden), jnp.float32)
+        ret, eps = 0.0, self._epsilon()
+        for t in range(T):
+            obs_all = np.stack([obs[a] for a in self.agents]).reshape(
+                self.n_agents, self.obs_dim
+            )
+            ep["obs"][t] = obs_all
+            ep["state"][t] = np.asarray(self.env.get_state(), np.float32)
+            inp = np.concatenate([obs_all, self._id_eye], axis=-1)
+            h, q = self._step_fn(self.state.params["online"], h, jnp.asarray(inp))
+            acts = np.asarray(jax.device_get(q)).argmax(axis=-1)
+            explore = self._eps_rng.random(self.n_agents) < eps
+            acts[explore] = self._eps_rng.integers(0, self.n_actions, explore.sum())
+            nobs, rews, terms, truncs, _ = self.env.step(
+                {a: int(acts[i]) for i, a in enumerate(self.agents)}
+            )
+            team_r = float(sum(rews.values()))
+            ret += team_r
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            ep["actions"][t] = acts
+            ep["reward"][t] = team_r
+            ep["done"][t] = float(bool(terms.get("__all__")))
+            ep["mask"][t] = 1.0
+            self._env_steps += 1
+            obs = nobs
+            if done:
+                break
+        final = np.stack(
+            [np.asarray(obs.get(a, ep["obs"][t][i]), np.float32).reshape(-1)
+             for i, a in enumerate(self.agents)]
+        )
+        ep["obs"][t + 1] = final
+        try:
+            ep["state"][t + 1] = np.asarray(self.env.get_state(), np.float32)
+        except Exception:
+            pass
+        self._recent_returns.append(ret)
+        self._recent_returns = self._recent_returns[-100:]
+        return ep
+
+    def _collect(self, n_episodes: int):
+        cfg = self.algo_config
+        for _ in range(n_episodes):
+            ep = self._collect_episode()
+            if len(self._episodes) < cfg.buffer_size:
+                self._episodes.append(ep)
+            else:
+                self._episodes[self._buf_pos] = ep
+                self._buf_pos = (self._buf_pos + 1) % cfg.buffer_size
+
+    # -------------------------------------------------------------- update
+
+    def _build_update(self):
+        cfg = self.algo_config
+        optimizer = self.optimizer
+        gamma = cfg.gamma
+        n_agents, rnn_hidden = self.n_agents, cfg.rnn_hidden
+        id_eye = jnp.asarray(self._id_eye)
+
+        def td_loss(online, target, mb):
+            B, Tp1 = mb["obs"].shape[0], mb["obs"].shape[1]
+            # [T+1, B, N, obs+N] — scan over leading time axis
+            ids = jnp.broadcast_to(id_eye, (Tp1, B, n_agents, n_agents))
+            obs_id = jnp.concatenate(
+                [jnp.moveaxis(mb["obs"], 1, 0), ids], axis=-1
+            )
+            h0 = jnp.zeros((B, n_agents, rnn_hidden), jnp.float32)
+            q_on = agent_q_unroll(online, obs_id, h0)   # [T+1, B, N, A]
+            q_tg = agent_q_unroll(target, obs_id, h0)
+            q_on = jnp.moveaxis(q_on, 0, 1)  # [B, T+1, N, A]
+            q_tg = jnp.moveaxis(q_tg, 0, 1)
+            chosen = jnp.take_along_axis(
+                q_on[:, :-1], mb["actions"][..., None], axis=-1
+            )[..., 0]                                    # [B, T, N]
+            # double-Q: online argmax at t+1, target evaluates
+            a_star = jnp.argmax(q_on[:, 1:], axis=-1)
+            q_next = jnp.take_along_axis(
+                q_tg[:, 1:], a_star[..., None], axis=-1
+            )[..., 0]                                    # [B, T, N]
+            qtot = mix(
+                {"mixer": online["mixer"]},
+                chosen.reshape(-1, n_agents),
+                mb["state"][:, :-1].reshape(chosen.shape[0] * chosen.shape[1], -1),
+            ).reshape(chosen.shape[:2])                  # [B, T]
+            qtot_next = mix(
+                {"mixer": target["mixer"]},
+                q_next.reshape(-1, n_agents),
+                mb["state"][:, 1:].reshape(q_next.shape[0] * q_next.shape[1], -1),
+            ).reshape(q_next.shape[:2])
+            y = mb["reward"] + gamma * (1.0 - mb["done"]) * (
+                jax.lax.stop_gradient(qtot_next)
+            )
+            td = (qtot - y) * mb["mask"]
+            loss = jnp.sum(td**2) / jnp.maximum(jnp.sum(mb["mask"]), 1.0)
+            return loss, {"loss": loss, "qtot_mean": jnp.mean(qtot)}
+
+        def update(state: TrainState, mb):
+            (_, metrics), grads = jax.value_and_grad(
+                lambda p: td_loss(p, state.params["target"], mb), has_aux=True
+            )(state.params["online"])
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params["online"]
+            )
+            online = optax.apply_updates(state.params["online"], updates)
+            return (
+                TrainState(
+                    {"online": online, "target": state.params["target"]},
+                    opt_state,
+                    state.rng,
+                ),
+                metrics,
+            )
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        self._collect(cfg.train_batch_size)
+        metrics: Dict[str, Any] = {"episodes_collected": len(self._episodes)}
+        if len(self._episodes) >= cfg.learning_starts:
+            if self._update_fn is None:
+                self._update_fn = self._build_update()
+            rng = np.random.default_rng(self._grad_steps)
+            for _ in range(cfg.num_sgd_iter):
+                idx = rng.integers(0, len(self._episodes), cfg.minibatch_size)
+                mb = {
+                    k: jnp.asarray(np.stack([self._episodes[i][k] for i in idx]))
+                    for k in self._episodes[0]
+                }
+                self.state, m = self._update_fn(self.state, mb)
+                self._grad_steps += 1
+                if self._grad_steps % cfg.target_update_freq == 0:
+                    p = self.state.params
+                    self.state = self.state._replace(
+                        params={
+                            "online": p["online"],
+                            "target": jax.tree.map(jnp.copy, p["online"]),
+                        }
+                    )
+            metrics.update({k: float(v) for k, v in m.items()})
+        if self._recent_returns:
+            metrics["episode_reward_mean"] = float(np.mean(self._recent_returns))
+        metrics["timesteps_total"] = self._env_steps
+        return metrics
+
+    def greedy_actions(self, obs_all: np.ndarray) -> np.ndarray:
+        raise NotImplementedError(
+            "RecurrentQMIX agents are stateful: a single-step greedy action "
+            "without hidden state is meaningless. Use greedy_episode() to "
+            "evaluate, or drive agent_step() with your own hidden state."
+        )
+
+    def greedy_episode(self) -> float:
+        """Play one greedy (eps=0) episode; returns the team return."""
+        cfg = self.algo_config
+        obs, _ = self.env.reset()
+        h = jnp.zeros((self.n_agents, cfg.rnn_hidden), jnp.float32)
+        ret = 0.0
+        for _ in range(cfg.episode_limit):
+            obs_all = np.stack([obs[a] for a in self.agents]).reshape(
+                self.n_agents, self.obs_dim
+            )
+            inp = np.concatenate([obs_all, self._id_eye], axis=-1)
+            h, q = self._step_fn(self.state.params["online"], h, jnp.asarray(inp))
+            acts = np.asarray(jax.device_get(q)).argmax(axis=-1)
+            obs, rews, terms, truncs, _ = self.env.step(
+                {a: int(acts[i]) for i, a in enumerate(self.agents)}
+            )
+            ret += float(sum(rews.values()))
+            if bool(terms.get("__all__")) or bool(truncs.get("__all__")):
+                break
+        return ret

@@ -50,3 +50,49 @@ class ReplayBuffer:
             raise ValueError("cannot sample from an empty replay buffer")
         idx = self._rng.integers(0, self._size, size=batch_size)
         return SampleBatch({k: v[idx] for k, v in self._cols.items()})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    rllib/utils/replay_buffers/prioritized_replay_buffer.py — the sum-tree
+    proportional scheme of Schaul et al.). Numpy-vectorized: sampling is one
+    cumsum + searchsorted over the priority ring, importance weights are
+    (N * P)^-beta normalized by their max (the published correction)."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, seed: int = 0):
+        super().__init__(capacity, seed=seed)
+        self.alpha = float(alpha)
+        self._prios = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        n = len(batch)
+        start = self._idx
+        super().add(batch)
+        # new transitions get max priority so they are seen at least once
+        idx = (start + np.arange(n)) % self.capacity
+        self._prios[idx] = self._max_prio ** self.alpha
+
+    def sample(self, batch_size: int, beta: float = 0.4):
+        """Returns (batch, indices, is_weights)."""
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty replay buffer")
+        p = self._prios[: self._size]
+        cum = np.cumsum(p)
+        total = cum[-1]
+        targets = self._rng.random(batch_size) * total
+        idx = np.searchsorted(cum, targets, side="right")
+        idx = np.minimum(idx, self._size - 1)
+        probs = p[idx] / total
+        weights = (self._size * probs) ** (-float(beta))
+        # normalize by the BUFFER-wide max weight (Schaul et al. eq. after
+        # (1): max_i w_i comes from the min-probability transition), so a
+        # transition's weight doesn't depend on which batch sampled it
+        max_w = (self._size * (p.min() / total)) ** (-float(beta))
+        weights = (weights / max_w).astype(np.float32)
+        return SampleBatch({k: v[idx] for k, v in self._cols.items()}), idx, weights
+
+    def update_priorities(self, indices, priorities) -> None:
+        priorities = np.asarray(priorities, np.float64) + 1e-6
+        self._prios[np.asarray(indices)] = priorities ** self.alpha
+        self._max_prio = max(self._max_prio, float(priorities.max()))

