@@ -16,32 +16,90 @@ from typing import Optional
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title><style>
-body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
-h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+body{font-family:system-ui,sans-serif;margin:1.2rem;background:#fafafa;color:#222}
+h1{font-size:1.2rem;margin:.2rem 0 .6rem} h2{font-size:1rem;margin:1rem 0 .4rem}
 table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
 th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
 th{background:#f0f0f0} .dead{color:#b00} .alive{color:#080}
 #res{font-size:.9rem;margin:.3rem 0}
+nav{margin:.4rem 0 .8rem} nav a{margin-right:1rem;text-decoration:none;color:#07c}
+nav a.cur{font-weight:bold;color:#000;border-bottom:2px solid #07c}
+.tab{display:none} .tab.cur{display:block}
+pre.detail{background:#fff;border:1px solid #ddd;padding:.5rem;max-height:22rem;overflow:auto}
+pre.log{background:#111;color:#ddd;padding:.5rem;min-height:3rem;max-height:22rem;overflow:auto}
+input,select{font-size:.85rem;padding:.15rem .3rem;margin:.2rem .4rem .2rem 0}
+button{font-size:.8rem;margin-right:.4rem}
+.crumb{font-size:.85rem;margin:.3rem 0;color:#555}
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
-<div id="res"></div>
-<h2>Nodes</h2><table id="nodes"></table>
-<div id="spark"></div>
-<h2>Actors</h2><table id="actors"></table>
-<h2>Workers</h2><table id="workers"></table>
-<h2>Jobs</h2><table id="jobs"></table>
-<h2>Dataset executions (recent)</h2><table id="datasets"></table>
-<h2>Tasks (last 50 — click a row for its event timeline)</h2>
-<pre id="taskdetail" style="display:none;background:#fff;border:1px solid #ddd;padding:.5rem"></pre>
-<table id="tasks"></table>
-<h2>Worker logs</h2>
-<select id="logsel"><option value="">(choose a worker)</option></select>
-<pre id="logview" style="background:#111;color:#ddd;padding:.5rem;min-height:3rem;max-height:20rem;overflow:auto"></pre>
+<nav id="nav"></nav>
+<div id="err" style="display:none;color:#b00;font-size:.85rem;margin:.2rem 0"></div>
+<div id="tab-overview" class="tab">
+  <div id="res"></div>
+  <h2>Nodes</h2><table id="nodes"></table>
+  <div id="spark"></div>
+  <h2>Workers</h2><table id="workers"></table>
+  <h2>Dataset executions (recent)</h2><table id="datasets"></table>
+</div>
+<div id="tab-jobs" class="tab">
+  <div id="jobdetail" style="display:none">
+    <div class="crumb"><a href="#jobs" onclick="closeJob()">jobs</a> /
+      <span id="jobid"></span>
+      <button onclick="jobAction('stop')">stop</button>
+      <button onclick="jobAction('delete')">delete</button></div>
+    <pre class="detail" id="jobinfo"></pre>
+    <h2>Job log (live tail)</h2><pre class="log" id="joblog"></pre>
+  </div>
+  <div id="joblist"><h2>Jobs (click a row)</h2><table id="jobs"></table></div>
+</div>
+<div id="tab-actors" class="tab">
+  <input id="actorfilter" placeholder="filter by name/class/state" oninput="tick()">
+  <div id="actordetail" style="display:none">
+    <div class="crumb"><a href="#actors" onclick="sel.actor=null;render()">actors</a> /
+      <span id="actorid"></span></div>
+    <pre class="detail" id="actorinfo"></pre>
+  </div>
+  <h2>Actors (click a row)</h2><table id="actors"></table>
+</div>
+<div id="tab-tasks" class="tab">
+  <input id="taskfilter" placeholder="filter by name/id" oninput="tick()">
+  <select id="taskstate" onchange="tick()">
+    <option value="">(any state)</option><option>pending</option>
+    <option>waiting_deps</option><option>scheduled</option>
+    <option>running</option><option>done</option><option>failed</option>
+    <option>cancelled</option>
+  </select>
+  <pre id="taskdetail" class="detail" style="display:none"></pre>
+  <h2>Tasks (latest first, click a row)</h2><table id="tasks"></table>
+</div>
+<div id="tab-logs" class="tab">
+  <h2>Worker logs</h2>
+  <select id="logsel"><option value="">(choose a worker)</option></select>
+  <pre class="log" id="logview"></pre>
+</div>
 <script>
+const TABS = ["overview","jobs","actors","tasks","logs"];
+const sel = {job:null, actor:null};
 function esc(s){
   return String(s).replace(/[&<>"']/g,
     c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 }
+function curTab(){
+  const h = location.hash.replace("#","");
+  return TABS.includes(h) ? h : "overview";
+}
+function render(){
+  const cur = curTab();
+  document.getElementById("nav").innerHTML = TABS.map(t=>
+    `<a href="#${t}" class="${t===cur?"cur":""}">${t}</a>`).join("");
+  for(const t of TABS)
+    document.getElementById("tab-"+t).className = "tab"+(t===cur?" cur":"");
+  document.getElementById("jobdetail").style.display = sel.job?"block":"none";
+  document.getElementById("joblist").style.display = sel.job?"none":"block";
+  document.getElementById("actordetail").style.display = sel.actor?"block":"none";
+  tick();
+}
+window.onhashchange = render;
 function fill(id, rows, cols, onclick){
   const t = document.getElementById(id);
   if(!rows.length){t.innerHTML = "<tr><td>(empty)</td></tr>"; return;}
@@ -64,6 +122,31 @@ function sparkline(pts, color){
   const path=pts.map((v,i)=>`${i?"L":"M"}${(i/(pts.length-1||1)*w).toFixed(1)},${(h-2-(v/max)*(h-4)).toFixed(1)}`).join(" ");
   return `<svg width="${w}" height="${h}" style="vertical-align:middle"><path d="${path}" fill="none" stroke="${color}" stroke-width="1.5"/></svg>`;
 }
+// ---- jobs drill-down (REST routes double as the UI backend) ----
+function showJob(id){ sel.job = id; render(); }
+function closeJob(){ sel.job = null; render(); }
+async function jobAction(act){
+  if(!sel.job) return;
+  if(act==="delete" && !confirm("Delete job "+sel.job+"?")) return;
+  const r = await fetch("/api/jobs/"+sel.job+(act==="stop"?"/stop":""),
+    {method: act==="stop"?"POST":"DELETE"});
+  if(act==="delete" && r.ok) closeJob(); else tick();
+}
+async function tickJobDetail(){
+  if(!sel.job) return;
+  document.getElementById("jobid").textContent = sel.job;
+  try{
+    const [info, logs] = await Promise.all([
+      fetch("/api/jobs/"+sel.job).then(r=>r.json()),
+      fetch("/api/jobs/"+sel.job+"/logs").then(r=>r.json())]);
+    document.getElementById("jobinfo").textContent = JSON.stringify(info, null, 2);
+    const v = document.getElementById("joblog");
+    const atEnd = v.scrollTop+v.clientHeight >= v.scrollHeight-8;
+    v.textContent = logs.logs || "(empty)";
+    if(atEnd) v.scrollTop = v.scrollHeight;
+  }catch(e){ document.getElementById("jobinfo").textContent = ""+e; }
+}
+function showActor(id){ sel.actor = id; render(); }
 async function showTask(tid){
   const d=document.getElementById("taskdetail");
   try{
@@ -73,17 +156,16 @@ async function showTask(tid){
   }catch(e){ d.textContent=""+e; }
   d.style.display="block";
 }
-let taskRows=[];
 async function tickLogs(){
-  const sel=document.getElementById("logsel"), view=document.getElementById("logview");
+  const sel_=document.getElementById("logsel"), view=document.getElementById("logview");
   try{
-    const q = sel.value ? ("?worker_id="+encodeURIComponent(sel.value)) : "";
+    const q = sel_.value ? ("?worker_id="+encodeURIComponent(sel_.value)) : "";
     const data = await fetch("/api/logs"+q).then(r=>r.json());
-    const cur = new Set([...sel.options].map(o=>o.value));
+    const cur = new Set([...sel_.options].map(o=>o.value));
     for(const w of data.workers) if(!cur.has(w)){
-      const o=document.createElement("option"); o.value=o.textContent=w; sel.appendChild(o);
+      const o=document.createElement("option"); o.value=o.textContent=w; sel_.appendChild(o);
     }
-    if(sel.value && data.lines){
+    if(sel_.value && data.lines){
       const atEnd = view.scrollTop+view.clientHeight >= view.scrollHeight-8;
       view.textContent = data.lines.join("\\n");
       if(atEnd) view.scrollTop = view.scrollHeight;
@@ -91,38 +173,80 @@ async function tickLogs(){
   }catch(e){}
 }
 async function tick(){
+  const cur = curTab();
   try{
-    const [res, nodes, actors, workers, jobs, tasks, hist, dstats] = await Promise.all(
-      ["cluster","nodes","actors","workers","jobs","tasks","node_history","data_stats"].map(
-        p=>fetch("/api/"+p).then(r=>r.json())));
-    document.getElementById("res").textContent =
-      Object.entries(res.total).map(([k,v])=>
-        `${k}: ${Math.round((res.available[k]??0)*100)/100}/${Math.round(v*100)/100}`).join("   ");
-    fill("nodes", nodes, ["node_id","alive","resources","available"]);
-    let sh = "";
-    for(const [nid, pts] of Object.entries(hist)){
-      sh += `<div><code>${esc(nid)}</code> load ` +
-        sparkline(pts.map(p=>p.load_1m??0), "#07c") + " mem " +
-        sparkline(pts.map(p=>p.mem_frac??0), "#c70") +
-        ` ${Math.round((pts.at(-1)?.mem_frac??0)*100)}%</div>`;
+    if(cur === "overview"){
+      const [res, nodes, workers, hist, dstats] = await Promise.all(
+        ["cluster","nodes","workers","node_history","data_stats"].map(
+          p=>fetch("/api/"+p).then(r=>r.json())));
+      document.getElementById("res").textContent =
+        Object.entries(res.total).map(([k,v])=>
+          `${k}: ${Math.round((res.available[k]??0)*100)/100}/${Math.round(v*100)/100}`).join("   ");
+      fill("nodes", nodes, ["node_id","alive","resources","available"]);
+      let sh = "";
+      for(const [nid, pts] of Object.entries(hist)){
+        sh += `<div><code>${esc(nid)}</code> load ` +
+          sparkline(pts.map(p=>p.load_1m??0), "#07c") + " mem " +
+          sparkline(pts.map(p=>p.mem_frac??0), "#c70") +
+          ` ${Math.round((pts.at(-1)?.mem_frac??0)*100)}%</div>`;
+      }
+      document.getElementById("spark").innerHTML = sh;
+      fill("workers", workers, ["worker_id","node_id","state","actor_id","pid"]);
+      fill("datasets", dstats.slice(-10).reverse().map(s=>({
+        pipeline: s.operators.map(o=>o.name).join(" → "),
+        blocks: s.blocks, rows: s.output_rows,
+        total_ms: Math.round(s.total_s*1000),
+        wait_ms: Math.round(s.iter_wait_s*1000),
+        where: s.executed_remotely ? "cluster" : "driver",
+      })), ["pipeline","blocks","rows","total_ms","wait_ms","where"]);
+    } else if(cur === "jobs"){
+      if(sel.job){ await tickJobDetail(); }
+      else {
+        const jobs = await fetch("/api/jobs").then(r=>r.json());
+        fill("jobs", jobs.map(j=>({
+          submission_id: j.submission_id, status: j.status,
+          entrypoint: j.entrypoint,
+          started: j.start_time ? new Date(j.start_time*1000).toLocaleTimeString() : "",
+          runtime_s: j.start_time ? Math.round(((j.end_time||Date.now()/1000)-j.start_time)) : "",
+        })), ["submission_id","status","entrypoint","started","runtime_s"], showJob);
+      }
+    } else if(cur === "actors"){
+      const actors = await fetch("/api/actors").then(r=>r.json());
+      const f = document.getElementById("actorfilter").value.toLowerCase();
+      const rows = actors.filter(a => !f ||
+        (a.name||"").toLowerCase().includes(f) ||
+        (a.class_name||"").toLowerCase().includes(f) ||
+        (a.state||"").toLowerCase().includes(f));
+      fill("actors", rows,
+        ["actor_id","class_name","name","state","worker_id","node_id"], showActor);
+      if(sel.actor){
+        document.getElementById("actorid").textContent = sel.actor;
+        const a = actors.find(x=>x.actor_id===sel.actor);
+        document.getElementById("actorinfo").textContent =
+          a ? JSON.stringify(a, null, 2) : "actor gone";
+      }
+    } else if(cur === "tasks"){
+      const tasks = await fetch("/api/tasks").then(r=>r.json());
+      const f = document.getElementById("taskfilter").value.toLowerCase();
+      const st = document.getElementById("taskstate").value;
+      const rows = tasks.filter(t =>
+        (!f || (t.name||"").toLowerCase().includes(f) ||
+               (t.task_id||"").toLowerCase().includes(f)) &&
+        (!st || t.state === st));
+      fill("tasks", rows.slice(-100).reverse(),
+           ["task_id","name","state","node_id","worker_id"], showTask);
     }
-    document.getElementById("spark").innerHTML = sh;
-    fill("actors", actors, ["actor_id","class_name","name","state","worker_id"]);
-    fill("workers", workers, ["worker_id","node_id","state","actor_id","pid"]);
-    fill("jobs", jobs, ["submission_id","status","entrypoint","log_path"]);
-    fill("datasets", dstats.slice(-10).reverse().map(s=>({
-      pipeline: s.operators.map(o=>o.name).join(" → "),
-      blocks: s.blocks, rows: s.output_rows,
-      total_ms: Math.round(s.total_s*1000),
-      wait_ms: Math.round(s.iter_wait_s*1000),
-      where: s.executed_remotely ? "cluster" : "driver",
-    })), ["pipeline","blocks","rows","total_ms","wait_ms","where"]);
-    taskRows = tasks;
-    fill("tasks", tasks.slice(-50).reverse(),
-         ["task_id","name","state","node_id","worker_id"], showTask);
-  }catch(e){ document.getElementById("res").textContent = "head unreachable: "+e; }
+    const err = document.getElementById("err");
+    err.style.display = "none";
+  }catch(e){
+    // stale tables must not read as a live-but-idle cluster: surface the
+    // failure on EVERY tab
+    const err = document.getElementById("err");
+    err.textContent = "head unreachable: " + e;
+    err.style.display = "block";
+  }
 }
-tick(); setInterval(tick, 2000); tickLogs(); setInterval(tickLogs, 1500);
+render(); setInterval(tick, 2000); tickLogs(); setInterval(tickLogs, 1500);
 </script></body></html>"""
 
 

@@ -24,12 +24,11 @@ import numpy as np
 import optax
 
 from .algorithm import Algorithm
-from .dqn import DQNConfig, DQNLearner, _EpsilonGreedyWorker
+from .dqn import DQNConfig, DQNLearner, _EpsilonGreedyWorker, dqn_td_huber
 from .learner import LearnerGroup, TrainState
-from .models import q_apply
 from .replay_buffer import PrioritizedReplayBuffer
 from .rollout_worker import _make_env
-from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+from .sample_batch import SampleBatch
 
 
 class ReplayActor:
@@ -87,17 +86,9 @@ class ApexDQNLearner(DQNLearner):
 
         def update(state: TrainState, mb, is_weights):
             def loss_fn(online):
-                q = q_apply(online, mb[OBS])
-                q_sel = jnp.take_along_axis(q, mb[ACTIONS][:, None], axis=-1)[:, 0]
-                q_next_t = q_apply(state.params["target"], mb[NEXT_OBS])
-                if double_q:
-                    a_star = jnp.argmax(q_apply(online, mb[NEXT_OBS]), axis=-1)
-                    q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=-1)[:, 0]
-                else:
-                    q_next = jnp.max(q_next_t, axis=-1)
-                y = mb[REWARDS] + gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
-                td = q_sel - y
-                huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+                q_sel, td, huber = dqn_td_huber(
+                    online, state.params["target"], mb, gamma, double_q
+                )
                 loss = jnp.mean(is_weights * huber)
                 return loss, (td, q_sel)
 
@@ -171,11 +162,6 @@ class ApexDQN(Algorithm):
         import ray_tpu
 
         cfg = self.algo_config
-        if cfg.num_rollout_workers < 1:
-            raise ValueError(
-                "ApexDQN is the DISTRIBUTED replay architecture: it needs "
-                "num_rollout_workers >= 1 (use DQN for single-process runs)"
-            )
         env = _make_env(cfg.env)
         obs_dim = int(np.prod(env.observation_space.shape))
         num_actions = int(env.action_space.n)

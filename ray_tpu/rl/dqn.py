@@ -101,6 +101,24 @@ class _EpsilonGreedyWorker(EnvLoopWorker):
         return SampleBatch({k: v.reshape((self.T * E,) + v.shape[2:]) for k, v in cols.items()})
 
 
+def dqn_td_huber(online, target, mb, gamma: float, double_q: bool):
+    """The (double-)DQN TD computation shared by DQN and Ape-X: returns
+    (chosen q, td error, elementwise Huber). Huber is the reference's
+    default loss; callers reduce it (mean, or IS-weighted mean)."""
+    q = q_apply(online, mb[OBS])
+    q_sel = jnp.take_along_axis(q, mb[ACTIONS][:, None], axis=-1)[:, 0]
+    q_next_t = q_apply(target, mb[NEXT_OBS])
+    if double_q:
+        a_star = jnp.argmax(q_apply(online, mb[NEXT_OBS]), axis=-1)
+        q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=-1)[:, 0]
+    else:
+        q_next = jnp.max(q_next_t, axis=-1)
+    y = mb[REWARDS] + gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
+    td = q_sel - y
+    huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+    return q_sel, td, huber
+
+
 class DQNLearner(Learner):
     def __init__(
         self,
@@ -132,18 +150,10 @@ class DQNLearner(Learner):
         self._update_fn = None
 
     def loss(self, online, target, mb):
-        q = q_apply(online, mb[OBS])
-        q_sel = jnp.take_along_axis(q, mb[ACTIONS][:, None], axis=-1)[:, 0]
-        q_next_t = q_apply(target, mb[NEXT_OBS])
-        if self.double_q:
-            a_star = jnp.argmax(q_apply(online, mb[NEXT_OBS]), axis=-1)
-            q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=-1)[:, 0]
-        else:
-            q_next = jnp.max(q_next_t, axis=-1)
-        y = mb[REWARDS] + self.gamma * (1.0 - mb[DONES]) * jax.lax.stop_gradient(q_next)
-        td = q_sel - y
-        # Huber loss (the reference's default)
-        loss = jnp.mean(jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5))
+        q_sel, td, huber = dqn_td_huber(
+            online, target, mb, self.gamma, self.double_q
+        )
+        loss = jnp.mean(huber)
         return loss, {"loss": loss, "mean_q": jnp.mean(q_sel), "mean_td": jnp.mean(jnp.abs(td))}
 
     def _build_update(self):

@@ -89,6 +89,14 @@ class MADDPGLearner:
             for aid in self.agent_ids
         }
         self._update_fn = None
+        # jitted joint act: one compiled dispatch per env step for the
+        # whole population (eager per-agent forwards dominate rollout
+        # wall-clock otherwise)
+        self._act_fn = jax.jit(
+            lambda params, obs: {
+                a: _actor_apply(params[a]["actor"], obs[a]) for a in obs
+            }
+        )
 
     def _build_update(self):
         agent_ids, gamma, tau = self.agent_ids, self.gamma, self.tau
@@ -162,10 +170,21 @@ class MADDPGLearner:
         return {k: float(v) for k, v in metrics.items()}
 
     def act(self, obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        return {
-            a: np.asarray(_actor_apply(self.params[a]["actor"], obs[a]))
-            for a in obs
-        }
+        out = self._act_fn(self.params, {a: jnp.asarray(v) for a, v in obs.items()})
+        return {a: np.asarray(v) for a, v in out.items()}
+
+    def get_state(self):
+        """Full training state: online + target params and optimizer state
+        (resuming from online-only would TD-bootstrap off random targets)."""
+        return jax.device_get(
+            {"params": self.params, "target": self.target,
+             "opt_state": self.opt_state}
+        )
+
+    def set_state(self, state):
+        self.params = jax.device_put(state["params"])
+        self.target = jax.device_put(state["target"])
+        self.opt_state = jax.device_put(state["opt_state"])
 
     def get_weights(self):
         return jax.device_get(self.params)
@@ -272,11 +291,14 @@ class MADDPG(Trainable):
         return result
 
     def save_checkpoint(self) -> Any:
-        return {"weights": self.learner.get_weights(),
+        return {"state": self.learner.get_state(),
                 "timesteps_total": self._timesteps_total}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
-        self.learner.set_weights(checkpoint["weights"])
+        if "state" in checkpoint:
+            self.learner.set_state(checkpoint["state"])
+        else:  # older online-only checkpoints
+            self.learner.set_weights(checkpoint["weights"])
         self._timesteps_total = checkpoint.get("timesteps_total", 0)
 
     def compute_actions(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
