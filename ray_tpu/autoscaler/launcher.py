@@ -190,12 +190,29 @@ class SSHNodeLauncher:
     consumed round-robin."""
 
     def __init__(self, head_address: str, hosts: List[str], user: str = "",
-                 ssh_args: Optional[List[str]] = None):
+                 ssh_args: Optional[List[str]] = None,
+                 ssh_cmd: Optional[str] = None,
+                 python: str = "python3",
+                 env: Optional[Dict[str, str]] = None):
         self.head_address = head_address
         self.hosts = list(hosts)
         self.user = user
         self.ssh_args = list(ssh_args or [])
+        # pluggable transport binary: tests drive a local sh-exec shim
+        # through the SAME code path (RAY_TPU_SSH / provider ssh_cmd)
+        self.ssh_cmd = ssh_cmd or os.environ.get("RAY_TPU_SSH", "ssh")
+        self.python = python
+        # provider.env: exported before the start command (PYTHONPATH to a
+        # checkout, JAX flags, ... — the slot the reference fills with
+        # setup_commands)
+        self.env = dict(env or {})
         self._next = 0
+
+    def _run(self, target: str, command: str, check: bool):
+        return subprocess.run(
+            [self.ssh_cmd, *self.ssh_args, target, command],
+            check=check, timeout=60,
+        )
 
     def launch(self, node_id: str, resources: Dict[str, float]) -> Dict[str, Any]:
         if not self.hosts:
@@ -203,25 +220,39 @@ class SSHNodeLauncher:
         host = self.hosts[self._next % len(self.hosts)]
         self._next += 1
         target = f"{self.user}@{host}" if self.user else host
+        numeric = {k: v for k, v in resources.items() if k != "_node_type"}
+        res_arg = ""
+        if numeric:
+            res_arg = f" --resources '{json.dumps(numeric)}'"
+        # record the agent's pid remotely so terminate kills EXACTLY this
+        # process (pattern-matching pkill could hit unrelated commands)
+        pidfile = f"/tmp/ray_tpu_agent_{node_id}.pid"
+        import shlex
+
+        exports = "".join(
+            f"export {k}={shlex.quote(str(v))}; " for k, v in self.env.items()
+        )
         remote = (
-            f"nohup python3 -m ray_tpu.scripts start --address "
-            f"{self.head_address} --node-id {node_id} "
-            f">/tmp/ray_tpu_agent_{node_id}.log 2>&1 &"
+            f"{exports}nohup {self.python} -m ray_tpu.scripts start --address "
+            f"{self.head_address} --node-id {node_id}{res_arg} "
+            f">/tmp/ray_tpu_agent_{node_id}.log 2>&1 & echo $! > {pidfile}"
         )
-        subprocess.run(
-            ["ssh", *self.ssh_args, target, remote], check=True, timeout=60
-        )
-        return {"kind": "ssh", "host": host, "node_id": node_id}
+        self._run(target, remote, check=True)
+        return {"kind": "ssh", "host": host, "node_id": node_id,
+                "pidfile": pidfile}
 
     def terminate(self, handle: Dict[str, Any]) -> None:
         target = (
             f"{self.user}@{handle['host']}" if self.user else handle["host"]
         )
-        subprocess.run(
-            ["ssh", *self.ssh_args, target,
-             f"pkill -f 'node-id {handle['node_id']}'"],
-            check=False, timeout=60,
-        )
+        pidfile = handle.get("pidfile")
+        if pidfile:
+            cmd = (
+                f"kill $(cat {pidfile}) 2>/dev/null; rm -f {pidfile}"
+            )
+        else:  # pre-pidfile handles: best-effort pattern match
+            cmd = f"pkill -f 'node-id {handle['node_id']}'"
+        self._run(target, cmd, check=False)
 
 
 def _make_launcher(cfg: Dict[str, Any], head_address: str):
@@ -234,6 +265,9 @@ def _make_launcher(cfg: Dict[str, Any], head_address: str):
             hosts=cfg["provider"].get("nodes", []),
             user=cfg["provider"].get("ssh_user", ""),
             ssh_args=cfg["provider"].get("ssh_args"),
+            ssh_cmd=cfg["provider"].get("ssh_cmd"),
+            python=cfg["provider"].get("python", "python3"),
+            env=cfg["provider"].get("env"),
         )
     if ptype == "gcp_tpu":
         from .node_provider import GCPTPUNodeProvider
@@ -251,10 +285,11 @@ def _make_launcher(cfg: Dict[str, Any], head_address: str):
         class _GCPAdapter:
             def launch(self, node_id, resources):
                 # GCP names nodes itself via the provider counter; node_id
-                # is advisory
-                real = provider.create_node(
-                    resources.pop("_node_type", "v5e-4"), resources
-                )
+                # is advisory. Copy before pop: the caller's resource dict
+                # is shared cluster config, not ours to mutate
+                resources = dict(resources)
+                accel = resources.pop("_node_type", "v5e-4")
+                real = provider.create_node(accel, resources)
                 return {"kind": "gcp", "node_id": real}
 
             def terminate(self, handle):

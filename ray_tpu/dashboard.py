@@ -157,6 +157,7 @@ async function showTask(tid){
   d.style.display="block";
 }
 async function tickLogs(){
+  if(curTab() !== "logs") return;  // don't poll tails the user can't see
   const sel_=document.getElementById("logsel"), view=document.getElementById("logview");
   try{
     const q = sel_.value ? ("?worker_id="+encodeURIComponent(sel_.value)) : "";
@@ -226,7 +227,7 @@ async function tick(){
           a ? JSON.stringify(a, null, 2) : "actor gone";
       }
     } else if(cur === "tasks"){
-      const tasks = await fetch("/api/tasks").then(r=>r.json());
+      const tasks = await fetch("/api/tasks?limit=0").then(r=>r.json());
       const f = document.getElementById("taskfilter").value.toLowerCase();
       const st = document.getElementById("taskstate").value;
       const rows = tasks.filter(t =>
@@ -402,7 +403,18 @@ class Dashboard:
         msg = handlers.get(kind)
         if msg is None:
             return "404 Not Found", "text/plain", b"unknown api"
-        data = await self.head.handle(None, dict(msg))
+        msg = dict(msg)
+        if kind == "tasks" and query:
+            # ?limit=N (0 = all — client-side filters need the full set)
+            from urllib.parse import parse_qs
+
+            q = parse_qs(query)
+            if q.get("limit"):
+                try:
+                    msg["limit"] = int(q["limit"][0])
+                except ValueError:
+                    pass
+        data = await self.head.handle(None, msg)
         body = json.dumps(data, default=str).encode()
         return "200 OK", "application/json", body
 

@@ -126,18 +126,38 @@ def push_limit(ops: List["_Op"], n: int) -> List["_Op"]:
     return ops[:i] + [cap] + ops[i:]
 
 
+_PUSHDOWN_READERS = {}  # kind -> read_one(path, columns, filter_expr)
+
+
+def _pushdown_reader(kind: str):
+    """read_one factory per pushdown-capable source. parquet prunes at the
+    file reader (columns + row-group filters); csv projects at parse time
+    and masks post-parse; json masks/projects post-parse — each the deepest
+    pruning its format supports (reference: per-datasource pushdown in the
+    planner's read-op rules)."""
+    if not _PUSHDOWN_READERS:
+        from .dataset import _read_csv_one, _read_json_one, _read_parquet_one
+
+        _PUSHDOWN_READERS.update(
+            parquet=_read_parquet_one, csv=_read_csv_one, json=_read_json_one
+        )
+    return _PUSHDOWN_READERS.get(kind)
+
+
 def pushdown_reads(read_meta, block_fns, ops: List["_Op"]):
     """Fold leading structured ops into the datasource scan.
 
     Scans the op-chain prefix for planner-markered ops (op.meta): every
     leading `filter(Expr)` pushes its predicate, and a `select_columns`
-    pushes its projection (and ends the scan — later ops see the projected
-    schema). Pushed ops are dropped; the reads are rebuilt with
-    columns=/filters= so pruning happens inside the parquet reader
-    (reference: the logical planner's read-op pushdown rules +
-    datasource-level `columns`/`filter` args).
+    pushes its projection; filters AFTER the projection still push when
+    every column they read survives it. Pushed ops are dropped; the reads
+    are rebuilt with columns=/filters= so pruning happens inside the
+    reader (reference: the logical planner's read-op pushdown rules +
+    datasource-level `columns`/`filter` args). Applies to parquet, csv,
+    and json sources.
     """
-    if not read_meta or read_meta.get("kind") != "parquet":
+    read_one = _pushdown_reader(read_meta.get("kind")) if read_meta else None
+    if read_one is None:
         return block_fns, ops
     exprs = []
     cols = None
@@ -147,18 +167,21 @@ def pushdown_reads(read_meta, block_fns, ops: List["_Op"]):
         if not tag:
             break
         if tag[0] == "filter_expr":
+            if cols is not None and not set(tag[1].columns()) <= set(cols):
+                break  # reads a projected-away column: cannot cross
             exprs.append(tag[1])
             n_pushed += 1
             continue
         if tag[0] == "select":
+            if cols is not None:
+                break  # a second projection: stop at the first
             cols = list(tag[1])
             n_pushed += 1
+            continue
         break
     if n_pushed == 0:
         return block_fns, ops
     import functools
-
-    from .dataset import _read_parquet_one
 
     expr = read_meta.get("filter")
     for e in exprs:
@@ -166,7 +189,7 @@ def pushdown_reads(read_meta, block_fns, ops: List["_Op"]):
     if cols is None:
         cols = read_meta.get("columns")
     fns = [
-        functools.partial(_read_parquet_one, p, cols, expr)
+        functools.partial(read_one, p, cols, expr)
         for p in read_meta["paths"]
     ]
     return fns, ops[n_pushed:]

@@ -1430,20 +1430,78 @@ def read_parquet(paths, *, columns=None, filter=None) -> Dataset:
     )
 
 
-def read_csv(paths) -> Dataset:
+def _apply_scan_prune(block, columns, filter_expr):
+    """Shared post-parse pruning for readers without native projection/
+    predicate support: mask first (filters may read dropped columns —
+    pushdown_reads only pushes filters whose columns survive a pushed
+    projection, so this order is safe), then project."""
+    from . import _exchange
+
+    if filter_expr is not None:
+        mask = np.asarray(filter_expr.mask(_exchange.to_columns(block)), bool)
+        block = _block_take(block, np.nonzero(mask)[0])
+    if columns is not None:
+        try:
+            import pyarrow as pa
+
+            if isinstance(block, pa.Table):
+                return block.select(list(columns))
+        except ImportError:
+            pass
+        if isinstance(block, dict):
+            return {k: block[k] for k in columns}
+    return block
+
+
+def _read_csv_one(path: str, columns=None, filter_expr=None):
     import pyarrow.csv as pacsv
 
-    return _file_blocks(paths, lambda p: pacsv.read_csv(p))
+    opts = None
+    if columns is not None and filter_expr is None:
+        # true parse-level projection; with a filter, parse the filter's
+        # columns too, prune after masking
+        opts = pacsv.ConvertOptions(include_columns=list(columns))
+    elif columns is not None:
+        need = sorted(set(columns) | set(filter_expr.columns()))
+        opts = pacsv.ConvertOptions(include_columns=need)
+    table = pacsv.read_csv(path, convert_options=opts)
+    return _apply_scan_prune(table, columns, filter_expr)
+
+
+def read_csv(paths) -> Dataset:
+    expanded = _expand_paths(paths)
+    return Dataset(
+        [lambda p=p: _read_csv_one(p) for p in expanded],
+        read_meta={"kind": "csv", "paths": expanded},
+    )
+
+
+def _read_json_one(path: str, columns=None, filter_expr=None):
+    import json
+
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    # stay in ROW space: JSONL rows may be ragged (optional keys), so a
+    # columnar conversion keyed off any one row would drop columns. Only
+    # the filter's own columns materialize as arrays for the mask.
+    if filter_expr is not None and rows:
+        cols = {
+            k: np.asarray([r.get(k) for r in rows])
+            for k in filter_expr.columns()
+        }
+        mask = np.asarray(filter_expr.mask(cols), bool)
+        rows = [r for r, m in zip(rows, mask) if m]
+    if columns is not None:
+        rows = [{k: r.get(k) for k in columns} for r in rows]
+    return rows
 
 
 def read_json(paths) -> Dataset:
-    import json
-
-    def read_one(p):
-        with open(p) as f:
-            return [json.loads(line) for line in f if line.strip()]
-
-    return _file_blocks(paths, read_one)
+    expanded = _expand_paths(paths)
+    return Dataset(
+        [lambda p=p: _read_json_one(p) for p in expanded],
+        read_meta={"kind": "json", "paths": expanded},
+    )
 
 
 def read_numpy(paths) -> Dataset:

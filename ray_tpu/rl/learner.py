@@ -20,7 +20,7 @@ import numpy as np
 import optax
 
 from .models import ac_apply, init_ac_params
-from .sample_batch import ACTIONS, ADVANTAGES, LOGP, OBS, TARGETS, VALUES, SampleBatch
+from .sample_batch import ACTIONS, ADVANTAGES, LOGP, LOSS_MASK, OBS, TARGETS, VALUES, SampleBatch
 
 
 class TrainState(NamedTuple):
@@ -87,13 +87,23 @@ class PPOLearner(Learner):
         )
 
     def loss(self, params, mb):
+        # mask-aware means: padded rows (multi-agent ragged batches carry
+        # LOSS_MASK=0 padding) contribute zero gradient, not duplicate data
+        w = mb[LOSS_MASK]
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+
+        def wmean(x):
+            return jnp.sum(x * w) / wsum
+
         logits, value = ac_apply(params, mb[OBS])
         logp_all = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(logp_all, mb[ACTIONS][:, None], axis=-1)[:, 0]
         ratio = jnp.exp(logp - mb[LOGP])
         adv = mb[ADVANTAGES]
-        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        pg_loss = -jnp.mean(
+        adv_mean = wmean(adv)
+        adv_std = jnp.sqrt(jnp.maximum(wmean((adv - adv_mean) ** 2), 0.0))
+        adv = (adv - adv_mean) / (adv_std + 1e-8)
+        pg_loss = -wmean(
             jnp.minimum(
                 ratio * adv,
                 jnp.clip(ratio, 1.0 - self.clip_eps, 1.0 + self.clip_eps) * adv,
@@ -103,12 +113,12 @@ class PPOLearner(Learner):
         v_clip = mb[VALUES] + jnp.clip(
             value - mb[VALUES], -self.clip_eps, self.clip_eps
         )
-        vf_loss = 0.5 * jnp.mean(
+        vf_loss = 0.5 * wmean(
             jnp.maximum((value - mb[TARGETS]) ** 2, (v_clip - mb[TARGETS]) ** 2)
         )
-        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        entropy = wmean(-jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
         total = pg_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
-        approx_kl = jnp.mean(mb[LOGP] - logp)
+        approx_kl = wmean(mb[LOGP] - logp)
         return total, {
             "total_loss": total,
             "policy_loss": pg_loss,
@@ -185,6 +195,11 @@ class PPOLearner(Learner):
             k: jnp.asarray(batch[k][:used])
             for k in (OBS, ACTIONS, LOGP, ADVANTAGES, TARGETS, VALUES)
         }
+        cols[LOSS_MASK] = (
+            jnp.asarray(batch[LOSS_MASK][:used])
+            if LOSS_MASK in batch.keys()
+            else jnp.ones(used, jnp.float32)
+        )
         if self._batch_sharding is not None:
             cols = {k: jax.device_put(v, self._batch_sharding) for k, v in cols.items()}
         self.state, metrics = self._update_fn(self.state, cols)

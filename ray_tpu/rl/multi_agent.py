@@ -489,10 +489,12 @@ class MultiAgentPPO(Algorithm):
     def _fit_policy_batch(self, b: SampleBatch) -> SampleBatch:
         """Fix each policy's batch at ONE size across iterations: per-policy
         agent-step counts are ragged (episodes finish at different times),
-        and PPOLearner.update re-jits for every new size — and would train
-        on clamped-duplicate rows for batches under minibatch_size. Cyclic
-        padding duplicates early rows when short (standard practice);
-        overflow is dropped."""
+        and PPOLearner.update re-jits for every new size. Short batches pad
+        cyclically for SHAPE only — padded rows carry LOSS_MASK=0, so the
+        mask-aware PPO loss gives them zero gradient weight (no silent
+        training on duplicated data); overflow is dropped."""
+        from .sample_batch import LOSS_MASK
+
         cfg = self.algo_config
         mb = cfg.minibatch_size
         n_pol = max(1, len(cfg.policies))
@@ -503,7 +505,9 @@ class MultiAgentPPO(Algorithm):
         if n > target:
             return b.slice(0, target)
         idx = np.arange(target) % n
-        return SampleBatch({k: v[idx] for k, v in b.items()})
+        out = SampleBatch({k: v[idx] for k, v in b.items()})
+        out[LOSS_MASK] = (np.arange(target) < n).astype(np.float32)
+        return out
 
     def training_step(self) -> Dict[str, Any]:
         collected: List[MultiAgentBatch] = []

@@ -22,6 +22,9 @@ VALUES = "values"
 LOGP = "logp"
 ADVANTAGES = "advantages"
 TARGETS = "value_targets"
+# 0 for rows that exist only as shape padding (multi-agent ragged batches);
+# mask-aware learners give them zero gradient weight
+LOSS_MASK = "loss_mask"
 
 
 class SampleBatch(dict):
