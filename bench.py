@@ -471,6 +471,9 @@ def main_rl():
         k: jnp.asarray(batch[k][:used])
         for k in (OBS, ACTIONS, LOGP, ADVANTAGES, TARGETS, VALUES)
     }
+    from ray_tpu.rl.sample_batch import LOSS_MASK
+
+    cols[LOSS_MASK] = jnp.ones(used, jnp.float32)
     state, m = learner._update_fn(learner.state, cols)
     jax.block_until_ready(m["total_loss"])
     t0 = time.perf_counter()
@@ -485,7 +488,7 @@ def main_rl():
     import ray_tpu
     from ray_tpu.rl.ppo import PPOConfig
 
-    ray_tpu.init(num_cpus=4)
+    ray_tpu.init(num_cpus=10)  # logical slots: the scaling sweep peaks at 8 actors + learner
     algo = (
         PPOConfig()
         .environment("CartPole-v1")
@@ -506,6 +509,56 @@ def main_rl():
     algo.workers.set_weights(w)
     broadcast_ms = (time.perf_counter() - t0) * 1000.0
     algo.stop()
+
+    # -- rollout-actor scaling curves (VERDICT r4 #9): the SAME pipeline at
+    # 1/2/4/8 rollout actors, two env regimes:
+    #   cpu_bound     — CartPole as-is: rollouts saturate host cores, so on
+    #                   an N-core host the curve tops out at ~N (on this
+    #                   1-core rig it INVERTS from scheduler contention —
+    #                   recorded as-is, host_cpus rides along)
+    #   latency_bound — CartPole with 1ms step latency (simulator/IO-wait
+    #                   shaped, the regime distributed rollouts exist for):
+    #                   actors overlap their waits, so the curve shows the
+    #                   framework's actual fan-out scaling even on 1 core
+    def _slow_cartpole():
+        import gymnasium
+
+        class _SlowStep(gymnasium.Wrapper):
+            def step(self, action):
+                time.sleep(0.001)
+                return self.env.step(action)
+
+        return _SlowStep(gymnasium.make("CartPole-v1"))
+
+    def _curve(env_spec, train_batch, frag):
+        pts = []
+        for n_workers in (1, 2, 4, 8):
+            a = (
+                PPOConfig()
+                .environment(env_spec)
+                .rollouts(num_rollout_workers=n_workers,
+                          rollout_fragment_length=frag)
+                .training(train_batch_size=train_batch, minibatch_size=256,
+                          num_epochs=4)
+                .build()
+            )
+            a.train()  # warm (actor spawn; learner jit is size-cached)
+            t0 = time.perf_counter()
+            n = 0
+            for _ in range(2):
+                res = a.train()
+                n += res["num_env_steps_sampled_this_iter"]
+            pts.append(
+                {"rollout_actors": n_workers,
+                 "samples_per_sec": round(n / (time.perf_counter() - t0), 1)}
+            )
+            a.stop()
+        return pts
+
+    scaling = {
+        "cpu_bound": _curve("CartPole-v1", 2000, 250),
+        "latency_bound": _curve(_slow_cartpole, 2000, 250),
+    }
     ray_tpu.shutdown()
 
     print(
@@ -528,6 +581,8 @@ def main_rl():
                 "weight_broadcast_ms": round(broadcast_ms, 2),
                 "update_ms": round(dt / iters * 1000, 2),
                 "batch_size": B,
+                "rollout_scaling": scaling,
+                "host_cpus": os.cpu_count(),
             }
         )
     )

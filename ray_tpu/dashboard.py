@@ -227,9 +227,12 @@ async function tick(){
           a ? JSON.stringify(a, null, 2) : "actor gone";
       }
     } else if(cur === "tasks"){
-      const tasks = await fetch("/api/tasks?limit=0").then(r=>r.json());
       const f = document.getElementById("taskfilter").value.toLowerCase();
       const st = document.getElementById("taskstate").value;
+      // full-table fetch ONLY while a filter is active: an idle tasks tab
+      // must not make the head serialize its whole history every 2s
+      const q = (f || st) ? "?limit=0" : "";
+      const tasks = await fetch("/api/tasks"+q).then(r=>r.json());
       const rows = tasks.filter(t =>
         (!f || (t.name||"").toLowerCase().includes(f) ||
                (t.task_id||"").toLowerCase().includes(f)) &&

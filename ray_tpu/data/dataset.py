@@ -1492,7 +1492,10 @@ def _read_json_one(path: str, columns=None, filter_expr=None):
         mask = np.asarray(filter_expr.mask(cols), bool)
         rows = [r for r, m in zip(rows, mask) if m]
     if columns is not None:
-        rows = [{k: r.get(k) for k in columns} for r in rows]
+        # r[k], not r.get: a missing key must raise exactly like the
+        # unpushed select_columns op would — the optimizer firing must
+        # never change observable semantics
+        rows = [{k: r[k] for k in columns} for r in rows]
     return rows
 
 
