@@ -20,7 +20,7 @@ Rules:
 
 from __future__ import annotations
 
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 from typing import TYPE_CHECKING
 
@@ -195,9 +195,31 @@ def pushdown_reads(read_meta, block_fns, ops: List["_Op"]):
     return fns, ops[n_pushed:]
 
 
+# ordered, extensible rule registry (reference: logical/optimizers.py —
+# LogicalOptimizer runs a list of Rule objects; users add theirs). Each
+# rule: List[_Op] -> List[_Op], pure. pushdown_reads stays separate — it
+# rewrites the SOURCE, not the chain, and needs read_meta.
+_RULES: List[Callable[[List["_Op"]], List["_Op"]]] = [
+    fuse_row_ops,
+    fuse_map_batches,
+]
+
+
+def register_optimizer_rule(rule: Callable[[List["_Op"]], List["_Op"]],
+                            *, before: Optional[Callable] = None) -> None:
+    """Add a chain-rewrite rule to the optimizer pipeline (appended, or
+    inserted before an existing rule)."""
+    if before is not None:
+        _RULES.insert(_RULES.index(before), rule)
+    else:
+        _RULES.append(rule)
+
+
 def optimize(ops: List["_Op"]) -> List["_Op"]:
     """The rule pipeline applied before execution."""
-    return fuse_map_batches(fuse_row_ops(ops))
+    for rule in _RULES:
+        ops = rule(ops)
+    return ops
 
 
 def explain(ops: List["_Op"]) -> str:

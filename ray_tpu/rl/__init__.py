@@ -22,8 +22,15 @@ from .a2c import A2C, A2CConfig, A2CLearner  # noqa: F401
 from .algorithm import Algorithm, WorkerSet  # noqa: F401
 from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayActor  # noqa: F401
 from .appo import APPO, APPOConfig, APPOLearner  # noqa: F401
+from .bandit import (  # noqa: F401
+    BanditConfig,
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+)
 from .config import AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
+from .es import ES, ESConfig  # noqa: F401
 from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace  # noqa: F401
 from .learner import Learner, LearnerGroup  # noqa: F401
 from .offline_algos import (  # noqa: F401

@@ -133,13 +133,7 @@ class Algorithm(Trainable):
     _config_class = AlgorithmConfig
 
     def __init__(self, config: Optional[AlgorithmConfig] = None, **kwargs):
-        if config is None:
-            config = self._config_class()
-        if isinstance(config, dict):
-            cfg_obj = self._config_class()
-            for k, v in config.items():
-                setattr(cfg_obj, "lambda_" if k == "lambda" else k, v)
-            config = cfg_obj
+        config = self._config_class.coerce(config)
         self.algo_config = config
         self._timesteps_total = 0
         super().__init__(config=config.to_dict())

@@ -1,0 +1,177 @@
+"""Contextual bandits: LinUCB and linear Thompson sampling.
+
+Reference parity: rllib/algorithms/bandit/ (BanditLinUCB / BanditLinTS over
+the online linear models in bandit_torch_model.py). A bandit env is a
+one-step MDP: reset() yields a context, step(arm) yields a reward and the
+next context. Both algorithms keep per-arm ridge-regression sufficient
+statistics (A = I + sum x x^T, b = sum r x) — pure numpy, updated online;
+no replay, no networks.
+
+TPU note: bandit state is KB-sized linear algebra — deliberately host-side
+(the reference's is torch-on-CPU too); it exists for inventory parity and
+as the exploration-theory baseline next to the deep algorithms."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .config import AlgorithmConfig
+from .rollout_worker import _make_env
+from ..tune.trainable import Trainable
+
+
+class _LinearArms:
+    """Per-arm ridge statistics with incrementally maintained A^-1
+    (Sherman–Morrison), so act() is O(d^2) per arm, not O(d^3)."""
+
+    def __init__(self, n_arms: int, dim: int, lam: float = 1.0):
+        self.n_arms, self.dim = n_arms, dim
+        self.A_inv = np.stack([np.eye(dim) / lam for _ in range(n_arms)])
+        self.b = np.zeros((n_arms, dim))
+        self.versions = np.zeros(n_arms, np.int64)  # cache keys (LinTS chol)
+
+    def theta(self) -> np.ndarray:
+        return np.einsum("kij,kj->ki", self.A_inv, self.b)
+
+    def update(self, arm: int, x: np.ndarray, r: float) -> None:
+        Ai = self.A_inv[arm]
+        Ax = Ai @ x
+        self.A_inv[arm] = Ai - np.outer(Ax, Ax) / (1.0 + x @ Ax)
+        self.b[arm] += r * x
+        self.versions[arm] += 1
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BanditLinUCB)
+        self.alpha: float = 1.0        # UCB exploration width
+        self.lambda_reg: float = 1.0
+        self.train_batch_size = 100    # env interactions per train()
+
+    def exploration(self, *, alpha: Optional[float] = None) -> "BanditConfig":
+        if alpha is not None:
+            self.alpha = alpha
+        return self
+
+
+class BanditLinUCB(Trainable):
+    """LinUCB (Li et al. 2010): pick argmax_k theta_k.x + alpha*sqrt(x'A^-1x)."""
+
+    _config_class = BanditConfig
+
+    def __init__(self, config=None, **kwargs):
+        config = self._config_class.coerce(config)
+        self.algo_config = config
+        cfg = config
+        self.env = _make_env(cfg.env)
+        self.dim = int(np.prod(self.env.observation_space.shape))
+        self.n_arms = int(self.env.action_space.n)
+        self.arms = _LinearArms(self.n_arms, self.dim, cfg.lambda_reg)
+        self._obs, _ = self.env.reset(seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._timesteps_total = 0
+        self.iteration = 0
+        self._cum_reward = 0.0
+
+    # -- per-algorithm scoring --
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        exploit = self.arms.theta() @ x
+        widths = np.sqrt(np.einsum("i,kij,j->k", x, self.arms.A_inv, x))
+        return exploit + self.algo_config.alpha * widths
+
+    def compute_action(self, obs) -> int:
+        x = np.asarray(obs, np.float64).reshape(-1)
+        return int(np.argmax(self._scores(x)))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        rewards = []
+        for _ in range(cfg.train_batch_size):
+            x = np.asarray(self._obs, np.float64).reshape(-1)
+            arm = self.compute_action(x)
+            obs2, r, term, trunc, _ = self.env.step(arm)
+            self.arms.update(arm, x, float(r))
+            rewards.append(float(r))
+            self._timesteps_total += 1
+            self._obs = self.env.reset()[0] if (term or trunc) else obs2
+        self._cum_reward += float(np.sum(rewards))
+        return {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "cumulative_reward": self._cum_reward,
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def train(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    # tune's TrialRunner drives class trainables via step()
+    step = training_step
+
+    def save_checkpoint(self) -> Any:
+        return {"A_inv": self.arms.A_inv.copy(), "b": self.arms.b.copy(),
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.arms.A_inv = np.asarray(checkpoint["A_inv"])
+        self.arms.b = np.asarray(checkpoint["b"])
+        self._timesteps_total = checkpoint.get("timesteps_total", 0)
+
+    def stop(self) -> None:
+        try:
+            self.env.close()
+        except Exception:
+            pass
+
+    cleanup = stop
+
+
+class BanditLinTS(BanditLinUCB):
+    """Linear Thompson sampling: score each arm with a posterior draw
+    theta_k ~ N(theta_hat_k, alpha^2 A_k^-1) (reference: BanditLinTS)."""
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        cfg = self.algo_config
+        theta = self.arms.theta()
+        out = np.empty(self.n_arms)
+        for k in range(self.n_arms):
+            # symmetrize (Sherman–Morrison drift) and sample via a Cholesky
+            # factor with a jitter fallback: O(d^3) only when the cached
+            # factor is stale, never an SVD per pull
+            draw = theta[k] + cfg.alpha * self._chol(k) @ self._rng.standard_normal(
+                self.dim
+            )
+            out[k] = draw @ x
+        return out
+
+    def _chol(self, arm: int) -> np.ndarray:
+        if not hasattr(self, "_chol_cache"):
+            self._chol_cache = {}
+        version = int(self.arms.versions[arm])
+        cached = self._chol_cache.get(arm)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        cov = self.arms.A_inv[arm]
+        cov = 0.5 * (cov + cov.T)
+        for jitter in (0.0, 1e-10, 1e-8, 1e-6):
+            try:
+                L = np.linalg.cholesky(cov + jitter * np.eye(self.dim))
+                break
+            except np.linalg.LinAlgError:
+                continue
+        else:
+            L = np.eye(self.dim) * np.sqrt(max(np.trace(cov) / self.dim, 1e-12))
+        self._chol_cache[arm] = (version, L)
+        return L
+
+
+class BanditLinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BanditLinTS
+        self.alpha = 0.3

@@ -12,6 +12,20 @@ from typing import Any, Callable, Dict, Optional, Union
 
 
 class AlgorithmConfig:
+    @classmethod
+    def coerce(cls, config) -> "AlgorithmConfig":
+        """Normalize None / plain-dict configs (the tune param_space path)
+        into a config object — the ONE copy of the dict-to-config logic
+        every algorithm family shares ('lambda' maps to lambda_)."""
+        if config is None:
+            return cls()
+        if isinstance(config, dict):
+            obj = cls()
+            for k, v in config.items():
+                setattr(obj, "lambda_" if k == "lambda" else k, v)
+            return obj
+        return config
+
     def __init__(self, algo_class=None):
         self.algo_class = algo_class
         # environment

@@ -1,0 +1,224 @@
+"""Evolution Strategies: derivative-free policy search over actor fleets.
+
+Reference parity: rllib/algorithms/es/ (Salimans et al. OpenAI-ES) — the
+population's perturbations are evaluated by PARALLEL rollout actors that
+share nothing but the current parameter vector and per-perturbation noise
+SEEDS (workers regenerate noise locally, so only scalars cross the wire),
+with antithetic pairs and centered-rank fitness shaping.
+
+TPU-first note: ES's per-perturbation work is tiny MLP rollouts — a CPU
+actor-fleet workload by design; the framework contribution here is the
+seed-based scatter/gather over the actor fleet, mirroring the reference's
+shared-noise-table architecture without the 250MB table (seeds regenerate
+slices on demand)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .config import AlgorithmConfig
+from .rollout_worker import _make_env
+from ..tune.trainable import Trainable
+
+
+def _flat_mlp_dims(obs_dim: int, hidden, n_actions: int) -> List[tuple]:
+    dims = []
+    prev = obs_dim
+    for h in tuple(hidden) + (n_actions,):
+        dims.append((prev, h))
+        prev = h
+    return dims
+
+
+def _n_params(dims) -> int:
+    return sum(i * o + o for i, o in dims)
+
+
+def _act(flat: np.ndarray, dims, obs: np.ndarray) -> int:
+    """Deterministic argmax policy over a flat parameter vector."""
+    x = obs
+    off = 0
+    for li, (i, o) in enumerate(dims):
+        w = flat[off:off + i * o].reshape(i, o)
+        off += i * o
+        b = flat[off:off + o]
+        off += o
+        x = x @ w + b
+        if li < len(dims) - 1:
+            x = np.tanh(x)
+    return int(np.argmax(x))
+
+
+class ESEvalWorker:
+    """Evaluates antithetic perturbation pairs: receives (weights, seeds,
+    sigma), regenerates each seed's noise locally, returns one scalar
+    return per direction (reference: es/es.py Worker.do_rollouts)."""
+
+    def __init__(self, env_spec, hidden=(32, 32), seed: int = 0,
+                 episode_limit: int = 500):
+        self.env = _make_env(env_spec)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.dims = _flat_mlp_dims(
+            self.obs_dim, hidden, int(self.env.action_space.n)
+        )
+        self.episode_limit = episode_limit
+        self._reset_seed = seed
+
+    def ready(self) -> bool:
+        return True
+
+    def _episode(self, flat: np.ndarray):
+        obs, _ = self.env.reset(seed=self._reset_seed)
+        self._reset_seed += 1
+        ret, steps = 0.0, 0
+        for _ in range(self.episode_limit):
+            obs = np.asarray(obs, np.float32).reshape(-1)
+            obs2, r, term, trunc, _ = self.env.step(_act(flat, self.dims, obs))
+            ret += float(r)
+            steps += 1
+            obs = obs2
+            if term or trunc:
+                break
+        return ret, steps
+
+    def evaluate(self, weights: np.ndarray, seeds: List[int], sigma: float):
+        """([(ret_plus, ret_minus)] per seed, total env steps) —
+        antithetic pairs."""
+        out, total_steps = [], 0
+        for s in seeds:
+            noise = np.random.default_rng(s).standard_normal(
+                weights.shape[0]
+            ).astype(np.float32)
+            rp, sp = self._episode(weights + sigma * noise)
+            rm, sm = self._episode(weights - sigma * noise)
+            out.append((rp, rm))
+            total_steps += sp + sm
+        return out, total_steps
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping (reference es_utils.compute_centered_ranks)."""
+    ranks = np.empty(x.size, dtype=np.float32)
+    ranks[x.ravel().argsort()] = np.arange(x.size, dtype=np.float32)
+    return (ranks / (x.size - 1) - 0.5).reshape(x.shape)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=ES)
+        self.pop_size: int = 32          # antithetic PAIRS per iteration
+        self.sigma: float = 0.05
+        self.lr = 0.03
+        self.num_rollout_workers = 2
+        self.l2_coeff: float = 0.005
+        self.episode_limit: int = 500
+
+
+class ES(Trainable):
+    _config_class = ESConfig
+
+    def __init__(self, config=None, **kwargs):
+        import ray_tpu
+
+        config = self._config_class.coerce(config)
+        self.algo_config = config
+        cfg = config
+        env = _make_env(cfg.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        n_actions = int(env.action_space.n)
+        env.close()
+        hidden = tuple(cfg.model.get("hidden", (32, 32)))
+        self.dims = _flat_mlp_dims(obs_dim, hidden, n_actions)
+        rng = np.random.default_rng(cfg.seed)
+        self.weights = (0.1 * rng.standard_normal(_n_params(self.dims))).astype(
+            np.float32
+        )
+        self._mom = np.zeros_like(self.weights)
+        self._seed_counter = cfg.seed * 1_000_003
+        Worker = ray_tpu.remote(ESEvalWorker)
+        self.workers = [
+            Worker.remote(cfg.env, hidden=hidden, seed=cfg.seed + 17 * i,
+                          episode_limit=cfg.episode_limit)
+            for i in range(max(1, cfg.num_rollout_workers))
+        ]
+        ray_tpu.get([w.ready.remote() for w in self.workers])
+        self._timesteps_total = 0
+        self.iteration = 0
+        self._recent: List[float] = []
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.algo_config
+        seeds = [self._seed_counter + i for i in range(cfg.pop_size)]
+        self._seed_counter += cfg.pop_size
+        # scatter seed shards over the fleet; only scalars return
+        shards = np.array_split(np.asarray(seeds), len(self.workers))
+        refs = [
+            w.evaluate.remote(self.weights, [int(s) for s in shard], cfg.sigma)
+            for w, shard in zip(self.workers, shards) if len(shard)
+        ]
+        parts = ray_tpu.get(refs)
+        pairs = [p for part, _steps in parts for p in part]
+        self._timesteps_total += sum(steps for _part, steps in parts)
+        returns = np.asarray(pairs, np.float32)        # [pop, 2]
+        ranks = _centered_ranks(returns)
+        deltas = ranks[:, 0] - ranks[:, 1]             # antithetic difference
+        grad = np.zeros_like(self.weights)
+        for s, d in zip(seeds, deltas):
+            noise = np.random.default_rng(s).standard_normal(
+                self.weights.shape[0]
+            ).astype(np.float32)
+            grad += d * noise
+        grad /= 2 * len(seeds) * cfg.sigma
+        grad -= cfg.l2_coeff * self.weights
+        self._mom = 0.9 * self._mom + cfg.lr * grad
+        self.weights = self.weights + self._mom
+        mean_ret = float(returns.mean())
+        self._recent.append(mean_ret)
+        self._recent = self._recent[-20:]
+        return {
+            "episode_reward_mean": float(np.mean(self._recent)),
+            "population_reward_mean": mean_ret,
+            "population_reward_max": float(returns.max()),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def train(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    # tune's TrialRunner drives class trainables via step()
+    step = training_step
+
+    def compute_action(self, obs) -> int:
+        return _act(self.weights, self.dims, np.asarray(obs, np.float32).reshape(-1))
+
+    def save_checkpoint(self) -> Any:
+        # seed counter travels: a restore must CONTINUE the perturbation
+        # sequence, not replay already-consumed noise directions
+        return {"weights": self.weights.copy(), "mom": self._mom.copy(),
+                "seed_counter": self._seed_counter,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.weights = np.asarray(checkpoint["weights"], np.float32)
+        self._mom = np.asarray(checkpoint["mom"], np.float32)
+        self._seed_counter = checkpoint.get("seed_counter", self._seed_counter)
+        self._timesteps_total = checkpoint.get("timesteps_total", 0)
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    cleanup = stop

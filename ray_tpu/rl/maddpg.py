@@ -200,15 +200,7 @@ class MADDPG(Trainable):
     _config_class = MADDPGConfig
 
     def __init__(self, config: Optional[MADDPGConfig] = None, **kwargs):
-        if config is None:
-            config = MADDPGConfig()
-        if isinstance(config, dict):
-            # Tune constructs trainables with plain dicts: apply key-by-key
-            # (Algorithm.__init__'s convention)
-            cfg_obj = MADDPGConfig()
-            for k, v in config.items():
-                setattr(cfg_obj, k, v)
-            config = cfg_obj
+        config = self._config_class.coerce(config)
         self.algo_config = config
         cfg = config
         self.env: MultiAgentEnv = cfg.env()
@@ -289,6 +281,9 @@ class MADDPG(Trainable):
         self.iteration += 1
         result.setdefault("training_iteration", self.iteration)
         return result
+
+    # tune's TrialRunner drives class trainables via step()
+    step = training_step
 
     def save_checkpoint(self) -> Any:
         return {"state": self.learner.get_state(),

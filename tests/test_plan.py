@@ -104,3 +104,34 @@ def test_explain(ray_start_regular):
     text = ds.explain()
     assert "logical: map -> filter" in text
     assert "row_chain[map+filter]" in text
+
+
+def test_register_optimizer_rule():
+    """The rule pipeline is extensible (reference: logical/optimizers.py
+    rule lists): a custom rule slots in, runs in order, and is removable."""
+    from ray_tpu.data import _plan
+    from ray_tpu.data.dataset import _Op
+
+    seen = []
+
+    def tag_rule(ops):
+        seen.append([o.kind for o in ops])
+        return ops
+
+    def drop_all(ops):
+        return []
+
+    baseline = list(_plan._RULES)
+    _plan.register_optimizer_rule(tag_rule)
+    try:
+        out = _plan.optimize([_Op("map", lambda r: r), _Op("map", lambda r: r)])
+        # ran AFTER the built-ins: it saw the fused chain
+        assert seen and seen[-1] == ["row_chain"]
+        assert [o.kind for o in out] == ["row_chain"]
+
+        _plan.register_optimizer_rule(drop_all, before=tag_rule)
+        seen.clear()
+        out = _plan.optimize([_Op("map", lambda r: r)])
+        assert out == [] and seen[-1] == []  # order respected
+    finally:
+        _plan._RULES[:] = baseline  # restore regardless of failure point
