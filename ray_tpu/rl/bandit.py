@@ -104,22 +104,23 @@ class BanditLinUCB(Trainable):
             "timesteps_total": self._timesteps_total,
         }
 
-    def train(self) -> Dict[str, Any]:
-        result = self.training_step()
-        self.iteration += 1
-        result.setdefault("training_iteration", self.iteration)
-        return result
-
-    # tune's TrialRunner drives class trainables via step()
+    # tune's TrialRunner drives class trainables via step(); standalone
+    # callers use the base Trainable.train() wrapper
     step = training_step
 
     def save_checkpoint(self) -> Any:
         return {"A_inv": self.arms.A_inv.copy(), "b": self.arms.b.copy(),
+                "versions": self.arms.versions.copy(),
                 "timesteps_total": self._timesteps_total}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
         self.arms.A_inv = np.asarray(checkpoint["A_inv"])
         self.arms.b = np.asarray(checkpoint["b"])
+        if "versions" in checkpoint:
+            self.arms.versions = np.asarray(checkpoint["versions"]).copy()
+        else:
+            self.arms.versions += 1  # force divergence from any cached keys
+        self._chol_cache = {}  # stale factors must not survive a restore
         self._timesteps_total = checkpoint.get("timesteps_total", 0)
 
     def stop(self) -> None:
@@ -131,9 +132,18 @@ class BanditLinUCB(Trainable):
     cleanup = stop
 
 
+class BanditLinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BanditLinTS  # resolved at call time (defined below)
+        self.alpha = 0.3
+
+
 class BanditLinTS(BanditLinUCB):
     """Linear Thompson sampling: score each arm with a posterior draw
     theta_k ~ N(theta_hat_k, alpha^2 A_k^-1) (reference: BanditLinTS)."""
+
+    _config_class = BanditLinTSConfig
 
     def _scores(self, x: np.ndarray) -> np.ndarray:
         cfg = self.algo_config
@@ -170,8 +180,3 @@ class BanditLinTS(BanditLinUCB):
         return L
 
 
-class BanditLinTSConfig(BanditConfig):
-    def __init__(self):
-        super().__init__()
-        self.algo_class = BanditLinTS
-        self.alpha = 0.3

@@ -187,13 +187,8 @@ class ES(Trainable):
             "timesteps_total": self._timesteps_total,
         }
 
-    def train(self) -> Dict[str, Any]:
-        result = self.training_step()
-        self.iteration += 1
-        result.setdefault("training_iteration", self.iteration)
-        return result
-
-    # tune's TrialRunner drives class trainables via step()
+    # tune's TrialRunner drives class trainables via step(); standalone
+    # callers use the base Trainable.train() wrapper
     step = training_step
 
     def compute_action(self, obs) -> int:

@@ -276,13 +276,8 @@ class MADDPG(Trainable):
         metrics["timesteps_total"] = self._timesteps_total
         return metrics
 
-    def train(self) -> Dict[str, Any]:
-        result = self.training_step()
-        self.iteration += 1
-        result.setdefault("training_iteration", self.iteration)
-        return result
-
-    # tune's TrialRunner drives class trainables via step()
+    # tune's TrialRunner drives class trainables via step(); standalone
+    # callers use the base Trainable.train() wrapper
     step = training_step
 
     def save_checkpoint(self) -> Any:

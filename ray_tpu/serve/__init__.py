@@ -15,11 +15,37 @@ from typing import Any, Dict, Optional, Union
 from .batching import batch  # noqa: F401
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
 from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .drivers import http_adapters  # noqa: F401
 from .http_proxy import Request, Response, StreamingResponse  # noqa: F401
 from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
+
+# DAGDriver is itself a Deployment so `serve.DAGDriver.bind({...})` reads
+# exactly like the reference (serve/drivers.py:30). Each bind() mints a
+# UNIQUELY-NAMED deployment: the controller keys deployments globally by
+# name, so a shared "DAGDriver" name would make two apps' drivers clobber
+# each other on redeploy/delete.
+from .drivers import _DAGDriverImpl as _DAGDriverImpl  # noqa: E402
+
+
+class _DAGDriverFactory(Deployment):
+    _counter = 0
+
+    def bind(self, *args, **kwargs) -> Application:
+        cls = type(self)
+        cls._counter += 1
+        fresh = Deployment(
+            self.func_or_class, f"DAGDriver_{cls._counter}",
+            DeploymentConfig(num_replicas=self.config.num_replicas),
+        )
+        return fresh.bind(*args, **kwargs)
+
+
+DAGDriver = _DAGDriverFactory(
+    _DAGDriverImpl, "DAGDriver", DeploymentConfig(num_replicas=1)
+)
 
 
 def deployment(
@@ -89,6 +115,10 @@ def run(
         def to_handle(a):
             if isinstance(a, Application):
                 return DeploymentHandle(a.deployment.name)
+            if isinstance(a, dict):
+                return {k: to_handle(v) for k, v in a.items()}
+            if isinstance(a, (list, tuple)):
+                return type(a)(to_handle(v) for v in a)
             return a
 
         specs.append(

@@ -69,10 +69,21 @@ class Application:
         self.kwargs = kwargs
 
     def _walk(self, seen: Dict[str, "Application"]):
-        """Collect all Applications in the graph, ingress last."""
-        for a in list(self.args) + list(self.kwargs.values()):
+        """Collect all Applications in the graph, ingress last. Bound args
+        may nest Applications inside dicts/lists/tuples (DAGDriver's
+        route->dag map is the canonical case)."""
+        def visit(a):
             if isinstance(a, Application):
                 a._walk(seen)
+            elif isinstance(a, dict):
+                for v in a.values():
+                    visit(v)
+            elif isinstance(a, (list, tuple)):
+                for v in a:
+                    visit(v)
+
+        for a in list(self.args) + list(self.kwargs.values()):
+            visit(a)
         if self.deployment.name in seen and seen[self.deployment.name] is not self:
             raise ValueError(
                 f"two different deployments named {self.deployment.name!r} in one app"

@@ -1,0 +1,89 @@
+"""DAGDriver: HTTP front door for deployment GRAPHS, with http adapters.
+
+Reference parity: serve/drivers.py:30 (DAGDriver — a driver deployment
+routing HTTP into bound DAGs, one route prefix per dag) +
+serve/http_adapters.py (functions shaping the raw request into the model's
+input). Compose with Deployment.bind graphs:
+
+    @serve.deployment
+    def preprocess(x): ...
+    @serve.deployment
+    class Model:
+        def __call__(self, x): ...
+
+    graph = Model.bind(preprocess.bind())
+    serve.run(
+        serve.DAGDriver.bind({"/classify": graph, "/echo": other},
+                             http_adapter=serve.http_adapters.json_request),
+        route_prefix="/",
+    )
+
+The driver also answers python-side calls: handle.predict.remote(x[,
+route]) hits the dag directly, skipping HTTP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+from .http_proxy import Request, Response
+
+
+class http_adapters:
+    """Request -> model-input shapers (reference: serve/http_adapters.py).
+    Any callable(Request) -> Any works; these are the stock ones."""
+
+    @staticmethod
+    def json_request(request: Request) -> Any:
+        """The parsed JSON (or raw) body — the default adapter."""
+        return request.body
+
+    @staticmethod
+    def query_params(request: Request) -> Dict[str, Any]:
+        return dict(request.query)
+
+    @staticmethod
+    def raw_request(request: Request) -> Request:
+        return request
+
+
+class _DAGDriverImpl:
+    """The deployment body behind serve.DAGDriver."""
+
+    # serve.run flips pass_request for this class (raw Request in)
+    _serve_ingress = True
+
+    def __init__(
+        self,
+        dags: Union[Any, Dict[str, Any]],
+        http_adapter: Optional[Callable[[Request], Any]] = None,
+    ):
+        if not isinstance(dags, dict):
+            dags = {"/": dags}
+        # longest prefix first, "/" normalized
+        self._routes = {
+            ("/" + k.strip("/")).rstrip("/") or "/": v for k, v in dags.items()
+        }
+        self._order = sorted(self._routes, key=len, reverse=True)
+        self._adapter = http_adapter or http_adapters.json_request
+
+    def _match(self, subpath: str):
+        path = "/" + subpath.strip("/")
+        for prefix in self._order:
+            if path == prefix or prefix == "/" or path.startswith(prefix + "/"):
+                return self._routes[prefix]
+        return None
+
+    def __call__(self, request: Request):
+        handle = self._match(request.subpath)
+        if handle is None:
+            return Response(404, {"detail": f"no dag at {request.subpath!r}"})
+        return handle.remote(self._adapter(request)).result()
+
+    def predict(self, value: Any, route: str = "/"):
+        """Python-side entry: run a dag directly (reference:
+        DAGDriver.predict)."""
+        handle = self._routes.get(("/" + route.strip("/")).rstrip("/") or "/")
+        if handle is None:
+            raise ValueError(f"no dag bound at route {route!r}")
+        return handle.remote(value).result()

@@ -48,6 +48,15 @@ class Trainable:
     def step(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def train(self) -> Dict[str, Any]:
+        """One iteration through step() with iteration bookkeeping — the
+        standalone (non-tune) driving convention every algorithm shares
+        (reference: Trainable.train wrapping step)."""
+        result = self.step()
+        self.iteration = getattr(self, "iteration", 0) + 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
     def save_checkpoint(self) -> Any:
         return None
 
