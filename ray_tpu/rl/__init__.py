@@ -30,7 +30,7 @@ from .bandit import (  # noqa: F401
 )
 from .config import AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
-from .es import ES, ESConfig  # noqa: F401
+from .es import ARS, ARSConfig, ES, ESConfig  # noqa: F401
 from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace  # noqa: F401
 from .learner import Learner, LearnerGroup  # noqa: F401
 from .offline_algos import (  # noqa: F401

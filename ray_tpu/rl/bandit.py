@@ -114,8 +114,10 @@ class BanditLinUCB(Trainable):
                 "timesteps_total": self._timesteps_total}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
-        self.arms.A_inv = np.asarray(checkpoint["A_inv"])
-        self.arms.b = np.asarray(checkpoint["b"])
+        # copies: update() mutates in place, and one checkpoint object may
+        # restore several algos (or be reused) — no aliasing
+        self.arms.A_inv = np.array(checkpoint["A_inv"])
+        self.arms.b = np.array(checkpoint["b"])
         if "versions" in checkpoint:
             self.arms.versions = np.asarray(checkpoint["versions"]).copy()
         else:

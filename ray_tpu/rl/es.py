@@ -148,7 +148,10 @@ class ES(Trainable):
         self.iteration = 0
         self._recent: List[float] = []
 
-    def training_step(self) -> Dict[str, Any]:
+    def _evaluate_population(self):
+        """Mint seeds, scatter shards over the fleet, gather antithetic
+        return pairs — the machinery ES and ARS share. Returns
+        (returns [pop, 2], seeds)."""
         import ray_tpu
 
         cfg = self.algo_config
@@ -163,16 +166,26 @@ class ES(Trainable):
         parts = ray_tpu.get(refs)
         pairs = [p for part, _steps in parts for p in part]
         self._timesteps_total += sum(steps for _part, steps in parts)
-        returns = np.asarray(pairs, np.float32)        # [pop, 2]
+        return np.asarray(pairs, np.float32), seeds
+
+    def _noise(self, seed: int) -> np.ndarray:
+        return np.random.default_rng(seed).standard_normal(
+            self.weights.shape[0]
+        ).astype(np.float32)
+
+    def _gradient(self, returns: np.ndarray, seeds) -> np.ndarray:
+        """Centered-rank antithetic gradient (the OpenAI-ES estimator;
+        ARS overrides with top-direction selection)."""
+        cfg = self.algo_config
         ranks = _centered_ranks(returns)
         deltas = ranks[:, 0] - ranks[:, 1]             # antithetic difference
         grad = np.zeros_like(self.weights)
         for s, d in zip(seeds, deltas):
-            noise = np.random.default_rng(s).standard_normal(
-                self.weights.shape[0]
-            ).astype(np.float32)
-            grad += d * noise
-        grad /= 2 * len(seeds) * cfg.sigma
+            grad += d * self._noise(s)
+        return grad / (2 * len(seeds) * cfg.sigma)
+
+    def _apply_update(self, grad: np.ndarray, returns: np.ndarray) -> Dict[str, Any]:
+        cfg = self.algo_config
         grad -= cfg.l2_coeff * self.weights
         self._mom = 0.9 * self._mom + cfg.lr * grad
         self.weights = self.weights + self._mom
@@ -186,6 +199,10 @@ class ES(Trainable):
             "grad_norm": float(np.linalg.norm(grad)),
             "timesteps_total": self._timesteps_total,
         }
+
+    def training_step(self) -> Dict[str, Any]:
+        returns, seeds = self._evaluate_population()
+        return self._apply_update(self._gradient(returns, seeds), returns)
 
     # tune's TrialRunner drives class trainables via step(); standalone
     # callers use the base Trainable.train() wrapper
@@ -217,3 +234,30 @@ class ES(Trainable):
                 pass
 
     cleanup = stop
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ARS
+        self.top_directions: int = 8  # b in Mania et al. (<= pop_size)
+
+
+class ARS(ES):
+    """Augmented Random Search (reference: rllib/algorithms/ars/ — Mania et
+    al. 2018): ES's antithetic machinery, but the update (a) keeps only the
+    top-b directions by max(ret+, ret-) and (b) scales by the std of the
+    SELECTED returns instead of centered-rank shaping — the paper's V2
+    normalization. Shares ES's seed-scatter evaluation fleet wholesale."""
+
+    _config_class = ARSConfig
+
+    def _gradient(self, returns: np.ndarray, seeds) -> np.ndarray:
+        cfg = self.algo_config
+        b = min(cfg.top_directions, len(seeds))
+        order = np.argsort(-returns.max(axis=1))[:b]         # best directions
+        sigma_r = float(returns[order].std()) + 1e-8
+        grad = np.zeros_like(self.weights)
+        for i in order:
+            grad += (returns[i, 0] - returns[i, 1]) * self._noise(seeds[int(i)])
+        return grad / (b * sigma_r)

@@ -31,14 +31,16 @@ from .drivers import _DAGDriverImpl as _DAGDriverImpl  # noqa: E402
 
 
 class _DAGDriverFactory(Deployment):
-    _counter = 0
-
     def bind(self, *args, **kwargs) -> Application:
-        cls = type(self)
-        cls._counter += 1
+        import copy
+        import uuid
+
+        # uuid, not a counter: driver processes sharing one detached
+        # controller must never mint colliding deployment names
         fresh = Deployment(
-            self.func_or_class, f"DAGDriver_{cls._counter}",
-            DeploymentConfig(num_replicas=self.config.num_replicas),
+            self.func_or_class,
+            f"{self.name}_{uuid.uuid4().hex[:8]}",
+            copy.deepcopy(self.config),  # carry the FULL options config
         )
         return fresh.bind(*args, **kwargs)
 

@@ -191,7 +191,26 @@ class ServeController:
         """specs: [{name, func_or_class, init_args, init_kwargs, config}],
         dependencies first (so handles in init args resolve to live replicas)."""
         with self._lock:
-            self._apps[app_name] = {"deployments": [s["name"] for s in specs], "ingress": ingress}
+            prev = self._apps.get(app_name, {}).get("deployments", [])
+            new_names = [s["name"] for s in specs]
+            self._apps[app_name] = {"deployments": new_names, "ingress": ingress}
+            # reap deployments the redeploy dropped (e.g. a fresh uniquely-
+            # named DAGDriver per bind) — otherwise their replicas leak
+            # until full shutdown
+            orphaned = [
+                n for n in prev
+                if n not in new_names
+                and not any(
+                    n in a["deployments"]
+                    for an, a in self._apps.items() if an != app_name
+                )
+            ]
+        for n in orphaned:
+            state = self._deployments.pop(n, None)
+            if state:
+                self._stop_replicas(state.replicas)
+                state.replicas = []
+                self._publish_replicas(state)
         for s in specs:
             with self._lock:
                 state = self._deployments.get(s["name"])

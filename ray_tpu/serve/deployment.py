@@ -50,7 +50,9 @@ class Deployment:
                 setattr(cfg, k, v)
             else:
                 raise ValueError(f"unknown deployment option {k!r}")
-        return Deployment(self.func_or_class, name, cfg)
+        # type(self), not Deployment: subclasses with special bind()
+        # semantics (the DAGDriver unique-name factory) must survive options
+        return type(self)(self.func_or_class, name, cfg)
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)

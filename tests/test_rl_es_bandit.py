@@ -136,3 +136,25 @@ def test_bandit_checkpoint_roundtrip():
     assert algo2.compute_action(x) == a1
     algo.stop()
     algo2.stop()
+
+
+def test_ars_learns_cartpole(ray_cpus):
+    """ARS (top-direction selection + sigma_R normalization) climbs
+    CartPole through the same seed-scatter fleet as ES."""
+    from ray_tpu.rl import ARS, ARSConfig
+
+    cfg = ARSConfig().environment("CartPole-v1")
+    cfg.pop_size = 24
+    cfg.top_directions = 8
+    cfg.sigma = 0.1
+    cfg.lr = 0.06
+    cfg.episode_limit = 200
+    algo = ARS(cfg)
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        best = max(best, r["population_reward_mean"])
+        if best >= 120:
+            break
+    algo.stop()
+    assert best >= 120, f"ARS failed to climb CartPole (best={best})"
