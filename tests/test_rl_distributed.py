@@ -131,15 +131,20 @@ class _Box:
 
 class Rendezvous(MultiAgentEnv):
     """2 agents on a line must meet (cooperative): shared reward
-    -|p0 - p1|; each observes its own position then the other's."""
+    -|p0 - p1|; each observes its own position then the other's.
+    One persistent rng (seeded at construction): the learning test must be
+    DETERMINISTIC run-to-run — unseeded resets made convergence timing
+    load-dependent and flaky under a full-suite run."""
 
-    def __init__(self):
+    def __init__(self, seed: int = 0):
         self.action_space = _Box((1,))
         self._t = 0
+        self._rng = np.random.default_rng(seed)
 
     def reset(self, *, seed=None):
-        rng = np.random.default_rng(seed)
-        self.p = rng.uniform(-1, 1, size=2).astype(np.float32)
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.p = self._rng.uniform(-1, 1, size=2).astype(np.float32)
         self._t = 0
         return self._obs(), {}
 
@@ -164,7 +169,7 @@ def test_maddpg_learns_rendezvous():
     cfg.exploration_noise = 0.3
     algo = MADDPG(cfg)
     best = -1e9
-    for _ in range(160):
+    for _ in range(260):
         r = algo.train()
         rew = r.get("episode_reward_mean")
         if rew is not None:
