@@ -624,41 +624,94 @@ def _run_child(cmd, child_env, timeout):
         except Exception:
             out, err = "", ""
         return None, out or "", err or ""
+    except BaseException:
+        # SIGTERM/budget abort mid-communicate: the child must not outlive
+        # the supervisor (it would hold the chip claim hostage)
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            p.kill()
+        raise
 
 
-def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool):
+_MIN_PHASE_WINDOW_S = 5.0  # a smaller budget slice can't fit any phase
+
+
+def _budget_left(deadline):
+    """Seconds left in the global budget (None = unlimited)."""
+    return None if deadline is None else deadline - time.monotonic()
+
+
+def _emit_row(results_path: str, mode: str, row: dict) -> None:
+    """Append one completed phase row to the results file IMMEDIATELY
+    (VERDICT weak #1b: a later hung phase must degrade to partial results,
+    never lose finished work)."""
+    if not results_path:
+        return
+    try:
+        with open(results_path, "a") as f:
+            f.write(json.dumps({"phase": mode, "row": row}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        print(f"[bench] could not emit {mode} row: {e}", file=sys.stderr)
+
+
+def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool,
+           deadline=None, results_path: str = ""):
     """Run one bench phase in child processes until a JSON line lands.
     Returns the parsed row (dict) or None. When the TPU tunnel is down the
     site hook's plugin registration can block `import jax` forever — the
     child-with-timeout contains that hang, and the tunnel can recover
-    between attempts."""
-    me = os.path.abspath(__file__)
+    between attempts. Every child timeout is clamped to the global budget
+    (`deadline`, monotonic); a completed row is appended to `results_path`
+    the moment it lands."""
+    # test hook: RAY_TPU_BENCH_CHILD_SCRIPT swaps the child for a fake
+    # (e.g. one that sleeps forever) without patching this module
+    me = os.environ.get("RAY_TPU_BENCH_CHILD_SCRIPT") or os.path.abspath(__file__)
     backoffs = [15.0, 30.0]
     env = dict(os.environ, RAY_TPU_BENCH_CHILD=mode)
     for i in range(attempts):
+        left = _budget_left(deadline)
+        if left is not None and left < _MIN_PHASE_WINDOW_S:
+            print(f"[bench] {mode}: global budget exhausted "
+                  f"({left:.0f}s left); skipping", file=sys.stderr)
+            return None
+        child_timeout = timeout if left is None else min(timeout, left)
         t0 = time.perf_counter()
-        rc, out, err = _run_child([sys.executable, me], env, timeout)
+        rc, out, err = _run_child([sys.executable, me], env, child_timeout)
         dt = time.perf_counter() - t0
         row = _last_json(out)
         if rc == 0 and row is not None:
             sys.stderr.write(err)
+            _emit_row(results_path, mode, row)
             return row
         why = "hung (timeout)" if rc is None else f"rc={rc}"
         tail = "\n".join(err.strip().splitlines()[-6:])
         print(f"[bench] {mode} attempt {i + 1}/{attempts} failed ({why}, "
               f"{dt:.0f}s){': ' + tail if tail else ''}", file=sys.stderr)
         if i < attempts - 1:
-            time.sleep(backoffs[min(i, len(backoffs) - 1)])
-    if not cpu_fallback:
+            pause = backoffs[min(i, len(backoffs) - 1)]
+            left = _budget_left(deadline)
+            if left is not None:
+                pause = max(0.0, min(pause, left - _MIN_PHASE_WINDOW_S))
+            time.sleep(pause)
+    left = _budget_left(deadline)
+    if not cpu_fallback or (left is not None and left < _MIN_PHASE_WINDOW_S):
         return None
     print(f"[bench] {mode}: TPU attempts exhausted; CPU fallback", file=sys.stderr)
     from ray_tpu._private.spawn import child_pythonpath
 
     env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
     env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
-    rc, out, err = _run_child([sys.executable, "-S", me], env, 600)
+    rc, out, err = _run_child(
+        [sys.executable, "-S", me], env, 600 if left is None else min(600, left)
+    )
     sys.stderr.write(err)
-    return _last_json(out)
+    row = _last_json(out)
+    if row is not None:
+        _emit_row(results_path, mode, row)
+    return row
 
 
 def _last_json(out: str):
@@ -672,30 +725,60 @@ def _last_json(out: str):
     return None
 
 
+class _BenchAborted(Exception):
+    """SIGTERM landed: stop launching work, emit best-so-far."""
+
+
 def _supervise() -> int:
     # INTERLEAVED raw/trainer reps (VERDICT r4 #5): alternating the two
     # phases puts both under the same slow host drift, so the overhead
     # claim is a mean ± spread over paired runs instead of one pair of
     # single-run numbers minutes apart (which once produced a nonsense
     # negative overhead).
+    #
+    # Global wall-clock budget (VERDICT weak #1b): the worst-case phase
+    # schedule exceeds any sane driver kill-timeout by construction, so the
+    # supervisor clamps itself — phases that don't fit the remaining budget
+    # are SKIPPED and the best-so-far JSON still prints. SIGTERM gets the
+    # same degradation instead of losing finished rows.
+    import signal
+
     reps = max(1, int(os.environ.get("RAY_TPU_BENCH_OVERHEAD_REPS", "2")))
     raw_timeout = float(os.environ.get("RAY_TPU_BENCH_TPU_TIMEOUT_S", "300"))
+    budget_s = float(os.environ.get("RAY_TPU_BENCH_TOTAL_BUDGET_S", "3300"))
+    deadline = time.monotonic() + budget_s if budget_s > 0 else None
+    results_path = os.environ.get("RAY_TPU_BENCH_RESULTS", "")
+
+    def _on_term(signum, frame):
+        raise _BenchAborted()
+
+    old_term = signal.signal(signal.SIGTERM, _on_term)
     raws, trainers, rep_pairs = [], [], []
-    for _ in range(reps):
-        r = _phase("raw", raw_timeout, 3, cpu_fallback=True)
-        if r is not None:
-            raws.append(r)
-        t = _phase("trainer", 600, 2, cpu_fallback=True)
-        if t is not None:
-            trainers.append(t)
-        if r is not None and t is not None:
-            # overhead pairs only from reps where BOTH phases ran — a
-            # failed rep must not pair measurements minutes apart
-            rep_pairs.append((r, t))
+    hbm = rl = None
+    try:
+        for _ in range(reps):
+            r = _phase("raw", raw_timeout, 3, cpu_fallback=True,
+                       deadline=deadline, results_path=results_path)
+            if r is not None:
+                raws.append(r)
+            t = _phase("trainer", 600, 2, cpu_fallback=True,
+                       deadline=deadline, results_path=results_path)
+            if t is not None:
+                trainers.append(t)
+            if r is not None and t is not None:
+                # overhead pairs only from reps where BOTH phases ran — a
+                # failed rep must not pair measurements minutes apart
+                rep_pairs.append((r, t))
+        hbm = _phase("hbm", 600, 2, cpu_fallback=False,
+                     deadline=deadline, results_path=results_path)
+        rl = _phase("rl", 600, 2, cpu_fallback=False,
+                    deadline=deadline, results_path=results_path)
+    except _BenchAborted:
+        print("[bench] SIGTERM: emitting best-so-far results", file=sys.stderr)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
     raw = raws[-1] if raws else None
     trainer = trainers[-1] if trainers else None
-    hbm = _phase("hbm", 600, 2, cpu_fallback=False)
-    rl = _phase("rl", 600, 2, cpu_fallback=False)
 
     if trainer is not None:
         primary = dict(trainer)

@@ -3,7 +3,8 @@
 Reference parity: python/ray/_private/ray_perf.py (the microbenchmark
 definitions behind release/microbenchmark). Prints one JSON line with the
 headline rates; the targets (VERDICT r1 item 4) are >=5k tasks/s submit,
->=2.5k sync actor calls/s, >=10 GB/s 100MB put.
+>=2.5k sync actor calls/s, >=10 GB/s 100MB put, plus an anti-regression
+floor on cross-node 256MB transfer (VERDICT weak #3).
 """
 
 from __future__ import annotations
@@ -343,10 +344,19 @@ def main():
     # minutes-apart drift; medians already absorb per-trial noise)
     put_target = min(10.0, 0.75 * results["host_memcpy_gbps"])
     results["put_target_gbps"] = round(put_target, 2)
+    # cross-node bulk transfer is ~20x below the memcpy floor today
+    # (VERDICT weak #3: 0.31 vs 6.54 GB/s in MICROBENCH_r05) — this gate is
+    # ANTI-REGRESSION, not aspiration: it trips if the direct pull path
+    # gets slower still (e.g. an extra copy/pickle sneaks in), while
+    # leaving the 0.5x-of-floor target to the zero-copy work (VERDICT next
+    # #4). Floor-relative with an absolute cap so slow hosts stay honest.
+    cross_target = min(0.15, 0.02 * results["host_memcpy_gbps"])
+    results["cross_node_target_gbps"] = round(cross_target, 3)
     targets = {
         "task_submit_per_s": 5000.0,
         "actor_calls_sync_per_s": 2500.0,
         "put_100mb_gbps": put_target,
+        "cross_node_256mb_gbps": cross_target,
     }
     results["targets"] = {k: round(v, 2) for k, v in targets.items()}
     results["targets_met"] = all(results[k] >= v for k, v in targets.items())

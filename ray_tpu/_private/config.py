@@ -141,6 +141,40 @@ D("memory_monitor_test_path", str, "",
   "test hook: file holding '<used> <total>' bytes used as the memory sample")
 D("resource_report_period_ms", int, 2000,
   "agent->head node load report period (ray_syncer gossip analogue)")
+# --- serve ingress hardening ---
+# The HTTP proxy reads these at construction in ITS worker process, so set
+# them via RAY_TPU_* env vars (inherited by spawned workers) or per-proxy
+# through HTTPProxyActor kwargs / set_limits(); handle/breaker knobs are
+# read in the calling process, so `init(_system_config=...)` works too.
+D("serve_http_keep_alive_timeout_s", float, 30.0,
+  "deadline for a complete request head to arrive on a connection — covers "
+  "both idle keep-alive waits and slow-loris header trickle; expiry sends "
+  "408 and closes")
+D("serve_http_read_timeout_s", float, 30.0,
+  "deadline for the request BODY (content-length or chunked) to arrive "
+  "after the head; expiry sends 408 and closes")
+D("serve_http_max_header_bytes", int, 64 * 1024,
+  "request head larger than this is rejected with 431")
+D("serve_http_max_body_bytes", int, 32 * 1024 * 1024,
+  "request body larger than this is rejected with 413")
+D("serve_http_max_connections", int, 1024,
+  "open connections per proxy; excess connections get 503 + Retry-After")
+D("serve_http_max_queued_calls", int, 128,
+  "in-flight replica calls per proxy before new requests get 503 + "
+  "Retry-After (backpressure ahead of the bounded call pool)")
+D("serve_http_retry_after_s", float, 1.0,
+  "Retry-After header value on 503 backpressure responses")
+D("serve_handle_retry_attempts", int, 3,
+  "re-route attempts after a replica died/was draining mid-call")
+D("serve_handle_backoff_base_s", float, 0.05,
+  "initial backoff before a replica-death re-route; doubles per attempt")
+D("serve_handle_backoff_max_s", float, 1.0,
+  "cap on the per-attempt re-route backoff (jitter rides below the cap)")
+D("serve_breaker_failure_threshold", int, 5,
+  "consecutive handle-level failures before a deployment's circuit breaker "
+  "opens and calls fail fast with DeploymentUnavailableError")
+D("serve_breaker_reset_s", float, 1.0,
+  "how long an open circuit breaker waits before letting one probe through")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

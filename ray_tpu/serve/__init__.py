@@ -14,11 +14,17 @@ from typing import Any, Dict, Optional, Union
 
 from .batching import batch  # noqa: F401
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
-from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .handle import (  # noqa: F401
+    CONTROLLER_NAME,
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentUnavailableError,
+)
 from .drivers import http_adapters  # noqa: F401
 from .http_proxy import Request, Response, StreamingResponse  # noqa: F401
 from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from .replica import ReplicaDrainingError  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
 
@@ -58,8 +64,15 @@ def deployment(
     max_ongoing_requests: int = 100,
     autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
     ray_actor_options: Optional[Dict[str, Any]] = None,
+    graceful_shutdown_timeout_s: float = 10.0,
+    graceful_shutdown_wait_loop_s: float = 0.1,
 ):
-    """@serve.deployment decorator."""
+    """@serve.deployment decorator.
+
+    graceful_shutdown_timeout_s / graceful_shutdown_wait_loop_s configure
+    the drain lifecycle: replicas leaving the set (redeploy, downscale,
+    delete, shutdown) stop taking new requests and get up to the timeout to
+    finish in-flight ones before being reaped (see serve/README.md)."""
 
     def wrap(func_or_class):
         ac = autoscaling_config
@@ -70,6 +83,8 @@ def deployment(
             max_ongoing_requests=max_ongoing_requests,
             autoscaling_config=ac,
             ray_actor_options=ray_actor_options or {},
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            graceful_shutdown_wait_loop_s=graceful_shutdown_wait_loop_s,
         )
         return Deployment(func_or_class, name or func_or_class.__name__, cfg)
 
@@ -216,9 +231,14 @@ def delete(name: str = "default"):
 def shutdown():
     import ray_tpu
 
+    from .handle import _reset_breakers
     from .long_poll import stop_watchers
 
     stop_watchers()
+    # circuit-breaker state is per (process, deployment): a breaker tripped
+    # by this session's teardown must not fail-fast a later session's
+    # same-named deployment
+    _reset_breakers()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:

@@ -2,7 +2,11 @@
 
 Reference parity: serve/controller.py:79 (ServeController detached actor),
 deployment_state.py:2073 (DeploymentStateManager reconciling target vs live
-replicas), autoscaling decision loop (_private/autoscaling_policy.py:69-141).
+replicas), autoscaling decision loop (_private/autoscaling_policy.py:69-141),
+and the graceful-drain sequencing of deployment_state.py's
+stop_replicas(graceful_shutdown) path: replicas leaving the set (redeploy,
+downscale, delete, shutdown) are DRAINED — new traffic routed away first,
+in-flight requests given a deadline to finish — and only then reaped.
 """
 
 from __future__ import annotations
@@ -24,6 +28,7 @@ class _DeploymentState:
         self.init_kwargs = init_kwargs
         self.config: DeploymentConfig = config
         self.replicas: List[Any] = []  # ActorHandles
+        self.draining = False  # whole deployment slated for removal
         self.target: int = (
             config.autoscaling_config.min_replicas
             if config.autoscaling_config
@@ -46,6 +51,7 @@ class ServeController:
         self._proxies: Dict[str, Any] = {}  # node_id -> handle
         self._proxy_addrs: Dict[str, str] = {}
         self._routes: Dict[str, tuple] = {}  # prefix -> (deployment, pass_req)
+        self._drainers: List[threading.Thread] = []
         self._loop_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._loop_thread.start()
 
@@ -206,29 +212,51 @@ class ServeController:
                 )
             ]
         for n in orphaned:
-            state = self._deployments.pop(n, None)
-            if state:
-                self._stop_replicas(state.replicas)
-                state.replicas = []
-                self._publish_replicas(state)
+            self._retire_deployment(n)
         for s in specs:
             with self._lock:
                 state = self._deployments.get(s["name"])
+                old: List[Any] = []
                 if state is None:
                     state = _DeploymentState(
                         s["name"], s["func_or_class"], s["init_args"], s["init_kwargs"], s["config"]
                     )
                     self._deployments[s["name"]] = state
-                else:  # redeploy: replace code/config, restart replicas
+                else:  # redeploy: replace code/config, then swap replicas
                     state.func_or_class = s["func_or_class"]
                     state.init_args = s["init_args"]
                     state.init_kwargs = s["init_kwargs"]
                     state.config = s["config"]
+                    state.draining = False
                     ac = state.config.autoscaling_config
                     state.target = ac.min_replicas if ac else state.config.num_replicas
-                    self._stop_replicas(state.replicas)
-                    state.replicas = []
-            self._reconcile(state)
+                    # the OLD replica set keeps serving until the new one is
+                    # ready — get_replicas()/the push channel never expose an
+                    # empty set mid-redeploy
+                    old = state.replicas
+            if old:
+                import ray_tpu
+
+                new = []
+                try:
+                    new = [
+                        self._spawn_replica(state)
+                        for _ in range(state.target)
+                    ]
+                    ray_tpu.get([r.ready.remote() for r in new])
+                except Exception:
+                    # failed redeploy must not leak half-built replicas
+                    # (each pins num_cpus) — reap them and keep the OLD set
+                    # serving; the caller sees the deploy error
+                    self._kill_replicas(new)
+                    raise
+                state.replicas = new
+                self._publish_replicas(state)
+                # drain -> reap: old replicas finish their in-flight
+                # requests (up to the deadline) before being killed
+                self._drain_then_stop(old, state.config)
+            else:
+                self._reconcile(state)
         return True
 
     def get_replicas(self, deployment_name: str):
@@ -245,27 +273,39 @@ class ServeController:
             name: {
                 "target": s.target,
                 "live": len(s.replicas),
+                "draining": s.draining,
                 "autoscaling": s.config.autoscaling_config is not None,
             }
             for name, s in self._deployments.items()
         }
+
+    def _retire_deployment(self, name: str, wait: bool = False):
+        """Drain a whole deployment out of existence: broadcast the drain
+        state (handles fail fast with DeploymentUnavailableError -> proxies
+        emit 503), then drain -> reap the replicas."""
+        state = self._deployments.pop(name, None)
+        if state is None:
+            return
+        state.draining = True
+        victims = state.replicas
+        state.replicas = []
+        self._publish_replicas(state)
+        self._drain_then_stop(victims, state.config, wait=wait)
 
     def delete_application(self, app_name: str):
         app = self._apps.pop(app_name, None)
         if not app:
             return False
         for name in app["deployments"]:
-            state = self._deployments.pop(name, None)
-            if state:
-                self._stop_replicas(state.replicas)
-                state.replicas = []
-                self._publish_replicas(state)
+            self._retire_deployment(name)
         return True
 
     def graceful_shutdown(self):
         self._stop.set()
-        for state in self._deployments.values():
-            self._stop_replicas(state.replicas)
+        for name in list(self._deployments):
+            # wait=True: the controller actor dies right after this call
+            # returns, so background drainers would be killed mid-drain
+            self._retire_deployment(name, wait=True)
         self._deployments.clear()
         self._apps.clear()
         import ray_tpu
@@ -286,7 +326,7 @@ class ServeController:
 
     # ------------------------------------------------------- reconciliation
 
-    def _stop_replicas(self, replicas):
+    def _kill_replicas(self, replicas):
         import ray_tpu
 
         for r in replicas:
@@ -295,35 +335,106 @@ class ServeController:
             except Exception:
                 pass
 
+    def _drain_then_stop(self, replicas, config: DeploymentConfig,
+                         wait: bool = False):
+        """Drain -> reap: close each victim's request gate, then kill it as
+        soon as it reports idle — or at the drain deadline, whichever comes
+        first. The caller must already have published a replica set that
+        excludes the victims (no new traffic routes to them)."""
+        if not replicas:
+            return
+        import ray_tpu
+
+        drain_s = float(getattr(config, "graceful_shutdown_timeout_s", 10.0))
+        poll_s = max(
+            0.02, float(getattr(config, "graceful_shutdown_wait_loop_s", 0.1))
+        )
+        # 1) close the gates (best-effort, one shared deadline: a dead
+        # victim must neither stall nor abort the others' drain)
+        refs = []
+        for r in replicas:
+            try:
+                refs.append(r.prepare_to_drain.remote())
+            except Exception:
+                pass  # already dead: the drain worker reaps it
+        try:
+            if refs:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+        except Exception:
+            pass
+
+        def _drain_worker():
+            from ray_tpu.exceptions import GetTimeoutError
+
+            deadline = time.time() + drain_s
+            pending = list(replicas)
+            while pending and time.time() < deadline:
+                still = []
+                for r in pending:
+                    try:
+                        busy = ray_tpu.get(r.num_ongoing.remote(), timeout=2) > 0
+                    except GetTimeoutError:
+                        busy = True  # all actor slots occupied -> in flight
+                    except Exception:
+                        busy = False  # already dead: just reap
+                    if busy:
+                        still.append(r)
+                    else:
+                        self._kill_replicas([r])
+                pending = still
+                if pending:
+                    time.sleep(poll_s)
+            # deadline: force-reap stragglers (bounded drain, never hung)
+            self._kill_replicas(pending)
+
+        t = threading.Thread(target=_drain_worker, daemon=True,
+                             name="serve-drain")
+        t.start()
+        with self._lock:
+            self._drainers = [d for d in self._drainers if d.is_alive()]
+            self._drainers.append(t)
+        if wait:
+            t.join(timeout=drain_s + 10)
+
+    def _spawn_replica(self, state: _DeploymentState):
+        import ray_tpu
+
+        opts = dict(state.config.ray_actor_options)
+        opts.setdefault("num_cpus", 1)
+        ReplicaCls = ray_tpu.remote(Replica)
+        return ReplicaCls.options(max_concurrency=8, **opts).remote(
+            state.name, state.func_or_class, state.init_args, state.init_kwargs
+        )
+
     def _reconcile(self, state: _DeploymentState):
         import ray_tpu
 
         while len(state.replicas) < state.target:
-            opts = dict(state.config.ray_actor_options)
-            opts.setdefault("num_cpus", 1)
-            ReplicaCls = ray_tpu.remote(Replica)
-            h = ReplicaCls.options(max_concurrency=8, **opts).remote(
-                state.name, state.func_or_class, state.init_args, state.init_kwargs
-            )
-            state.replicas.append(h)
+            state.replicas.append(self._spawn_replica(state))
         if len(state.replicas) > state.target:
             victims = state.replicas[state.target :]
             state.replicas = state.replicas[: state.target]
-            self._stop_replicas(victims)
+            # publish the shrunken set FIRST so no new request routes to a
+            # victim, then drain -> reap in the background (downscale must
+            # not drop in-flight requests)
+            self._publish_replicas(state)
+            self._drain_then_stop(victims, state.config)
         # block until new replicas constructed
-        import ray_tpu
-
         ray_tpu.get([r.ready.remote() for r in state.replicas])
         self._publish_replicas(state)
 
     def _publish_replicas(self, state: _DeploymentState):
-        """Push the live replica set to handles/proxies over the long-poll
-        channel (reference: long_poll.py:68 — controller-side broadcast)."""
+        """Push the live replica set + drain state to handles/proxies over
+        the long-poll channel (reference: long_poll.py:68 — controller-side
+        broadcast)."""
         from .long_poll import replica_channel
         from ..util import pubsub
 
         try:
-            pubsub.publish(replica_channel(state.name), list(state.replicas))
+            pubsub.publish(
+                replica_channel(state.name),
+                {"replicas": list(state.replicas), "draining": state.draining},
+            )
         except Exception:
             pass  # handles fall back to their polling refresh
 

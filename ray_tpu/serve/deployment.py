@@ -27,6 +27,13 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     health_check_period_s: float = 2.0
+    # graceful drain (reference: serve/config.py DeploymentConfig
+    # graceful_shutdown_* knobs): a replica slated for removal — redeploy,
+    # downscale, delete, shutdown — stops accepting new requests, gets up
+    # to `graceful_shutdown_timeout_s` to finish in-flight ones (polled
+    # every `graceful_shutdown_wait_loop_s`), and only then is killed
+    graceful_shutdown_timeout_s: float = 10.0
+    graceful_shutdown_wait_loop_s: float = 0.1
 
 
 class Deployment:

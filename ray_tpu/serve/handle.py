@@ -3,11 +3,21 @@
 Reference parity: serve/handle.py (DeploymentHandle/DeploymentResponse) with
 the router's power-of-two-choices replica selection (serve/_private/router.py:370)
 done handle-side over locally-tracked in-flight counts.
+
+Robustness layer (request-lifecycle hardening):
+  - replica-death / replica-draining retries re-route with CAPPED
+    EXPONENTIAL BACKOFF + JITTER instead of hot-looping against a replica
+    set the controller is still rebuilding
+  - a per-deployment CIRCUIT BREAKER trips after consecutive failures and
+    fails calls fast with DeploymentUnavailableError (the HTTP proxy maps
+    it to 503 + Retry-After) while the controller restarts replicas; a
+    half-open probe closes it again once a call succeeds
 """
 
 from __future__ import annotations
 
 import random
+import threading
 import time
 from collections import deque
 from typing import Any, Optional
@@ -15,29 +25,227 @@ from typing import Any, Optional
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+class DeploymentUnavailableError(RuntimeError):
+    """The deployment cannot take requests right now (no live replicas,
+    draining for removal, or its circuit breaker is open). Transient by
+    design: callers should retry after `retry_after_s`; the HTTP proxy
+    translates it to 503 + Retry-After."""
+
+    def __init__(self, deployment_name: str, reason: str,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"deployment {deployment_name!r} unavailable: {reason}"
+        )
+        self.deployment_name = deployment_name
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _CircuitBreaker:
+    """Per-deployment failure gate (reference intent: the router's backoff
+    on UNAVAILABLE replicas; shape follows the classic closed -> open ->
+    half-open machine). Thread-safe: proxy pool threads share one breaker
+    per deployment."""
+
+    def __init__(self, failure_threshold: int, reset_s: float):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_s = float(reset_s)
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._probing_since: Optional[float] = None
+
+    def allow(self) -> bool:
+        """True if a call may proceed (closed, or half-open probe slot)."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            now = time.time()
+            if now - self._opened_at < self.reset_s:
+                return False
+            # half-open: one probe at a time — but a probe slot EXPIRES
+            # after reset_s so a caller that never reports back (fire-and-
+            # forget .remote() with no .result()) can't brick the breaker
+            if (self._probing_since is None
+                    or now - self._probing_since >= self.reset_s):
+                self._probing_since = now
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
+            self._probing_since = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._probing_since is not None:
+                # failed probe re-opens a fresh window
+                self._opened_at = time.time()
+                self._probing_since = None
+            elif (self._opened_at is None
+                  and self._consecutive >= self.failure_threshold):
+                self._opened_at = time.time()
+
+    def release_probe(self) -> None:
+        """Give back a probe slot without judging the deployment either way
+        (e.g. the probe call timed out caller-side): the next allow() may
+        probe again immediately."""
+        with self._lock:
+            if self._probing_since is not None:
+                self._probing_since = None
+                if self._opened_at is not None:
+                    # make the next probe eligible now, not reset_s from now
+                    self._opened_at = time.time() - self.reset_s
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    def seconds_until_probe(self) -> float:
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            return max(0.0, self.reset_s - (time.time() - self._opened_at))
+
+
+_breakers: dict = {}
+_breakers_lock = threading.Lock()
+
+
+def get_breaker(deployment_name: str) -> _CircuitBreaker:
+    """One breaker per (process, deployment) — handles are minted freely
+    (attribute access, options(), unpickling), so breaker state must not
+    live on the handle itself."""
+    with _breakers_lock:
+        b = _breakers.get(deployment_name)
+        if b is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+            b = _breakers[deployment_name] = _CircuitBreaker(
+                cfg.serve_breaker_failure_threshold, cfg.serve_breaker_reset_s
+            )
+        return b
+
+
+def _reset_breakers() -> None:
+    """Test/shutdown hook: forget breaker state between serve sessions."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+def _backoff_s(attempt: int) -> float:
+    """Capped exponential backoff with full jitter (attempt is 0-based)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    cap = min(
+        float(cfg.serve_handle_backoff_max_s),
+        float(cfg.serve_handle_backoff_base_s) * (2 ** attempt),
+    )
+    return random.uniform(cap / 2, cap)
+
+
+def _retryable_errors() -> tuple:
+    from ray_tpu.exceptions import (
+        ActorDiedError,
+        ActorUnavailableError,
+        WorkerCrashedError,
+    )
+
+    from .replica import ReplicaDrainingError
+
+    return (ActorDiedError, ActorUnavailableError, WorkerCrashedError,
+            ReplicaDrainingError)
+
+
 class DeploymentResponse:
     def __init__(self, ref, handle=None, call=None):
         self._ref = ref
         self._handle = handle
         self._call = call  # (args, kwargs) for replica-death retry
+        self.retries = 0   # re-route attempts this response consumed
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.exceptions import GetTimeoutError
+
+        breaker = (
+            get_breaker(self._handle.deployment_name)
+            if self._handle is not None else None
+        )
+        # timeout_s bounds the WHOLE logical call — backoff sleeps and
+        # every retry's get() draw down one shared deadline, so a caller
+        # asking for 5s never blocks (attempts+1) x 5s
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+
+        def _remaining():
+            return (
+                None if deadline is None else deadline - time.monotonic()
+            )
 
         try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
-        except (ActorDiedError, WorkerCrashedError):
-            # the chosen replica died mid-call (e.g. torn down by a
-            # redeploy that raced this request): re-route once against a
-            # refreshed replica set (reference: the router retries system
-            # failures transparently, serve/_private/router.py)
+            out = ray_tpu.get(self._ref, timeout=timeout_s)
+            if breaker is not None:
+                breaker.record_success()
+            return out
+        except GetTimeoutError:
+            # no verdict on the deployment — give any probe slot back so
+            # the breaker can't wedge half-open
+            if breaker is not None:
+                breaker.release_probe()
+            raise
+        except _retryable_errors() as first_exc:
+            # the chosen replica died mid-call or was draining (e.g. torn
+            # down by a redeploy that raced this request): re-route against
+            # a refreshed replica set with spaced, bounded attempts
+            # (reference: the router retries system failures transparently,
+            # serve/_private/router.py — plus backoff so a crash-looping
+            # deployment isn't hammered). The breaker samples the LOGICAL
+            # call once at the end — a transient drain race retried to
+            # success must not march the breaker toward open.
             if self._handle is None or self._call is None:
                 raise
-            self._handle._refresh(force=True)
             args, kwargs = self._call
-            retry = self._handle.remote(*args, **kwargs)
-            return ray_tpu.get(retry.ref, timeout=timeout_s)
+            attempts = max(0, int(cfg.serve_handle_retry_attempts))
+            last_exc = first_exc
+            for attempt in range(attempts):
+                left = _remaining()
+                if left is not None and left <= 0:
+                    break
+                pause = _backoff_s(attempt)
+                time.sleep(pause if left is None else min(pause, left))
+                self.retries += 1
+                try:
+                    self._handle._refresh(force=True)
+                    retry = self._handle.remote(*args, **kwargs)
+                    out = ray_tpu.get(retry.ref, timeout=_remaining())
+                    breaker.record_success()
+                    return out
+                except GetTimeoutError:
+                    breaker.release_probe()
+                    raise
+                except _retryable_errors() as e:
+                    last_exc = e
+                except DeploymentUnavailableError:
+                    # breaker opened (or replicas gone) while we retried:
+                    # fail fast — the proxy turns this into 503
+                    raise
+            breaker.record_failure()
+            raise last_exc
+        except Exception:
+            # the replica answered with a user-code error: the deployment
+            # is SERVING — close/feed the breaker as a success so an open
+            # breaker's probe that reaches user code recovers the circuit
+            if breaker is not None:
+                breaker.record_success()
+            raise
 
     @property
     def ref(self):
@@ -59,6 +267,7 @@ class DeploymentHandle:
         self._inflight: deque = deque()  # (replica_index, ref)
         self._counts: dict = {}
         self._seen_version = -1  # last adopted ReplicaWatcher.version
+        self._deployment_draining = False
         # model affinity: id -> replica actor_id last used (keeps a loaded
         # model's traffic on the replica that holds it — serve/multiplex.py)
         self._model_affinity: dict = {}
@@ -118,10 +327,13 @@ class DeploymentHandle:
         watcher = get_watcher(self.deployment_name)
         if watcher.version != self._seen_version and watcher.replicas is not None:
             self._seen_version = watcher.version
+            self._deployment_draining = watcher.draining
             self._adopt(watcher.replicas)
             # a just-landed push is at least as fresh as a pull started
             # after it — even on the force (error-retry) path
             return
+        if watcher.replicas is not None:
+            self._deployment_draining = watcher.draining
         # push healthy -> the long TTL is safe; push broken/unproven -> the
         # 1s pull keeps routing at most one interval stale
         ttl = 30.0 if watcher.healthy() else 1.0
@@ -129,9 +341,19 @@ class DeploymentHandle:
             return
         import ray_tpu
 
-        self._adopt(
-            ray_tpu.get(self._controller().get_replicas.remote(self.deployment_name))
-        )
+        try:
+            self._adopt(
+                ray_tpu.get(
+                    self._controller().get_replicas.remote(self.deployment_name)
+                )
+            )
+        except ValueError:
+            # the controller no longer knows this deployment (retired, or
+            # this pull raced its removal broadcast): treat as drained-to-
+            # nothing so callers get DeploymentUnavailableError, never a
+            # raw controller error
+            self._deployment_draining = True
+            self._adopt([])
 
     def _prune(self):
         import ray_tpu
@@ -161,11 +383,38 @@ class DeploymentHandle:
         return a if self._counts.get(a, 0) <= self._counts.get(b, 0) else b
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        breaker = get_breaker(self.deployment_name)
+        if not breaker.allow():
+            # fail FAST while the controller restarts replicas — no routing,
+            # no remote call, no hot loop
+            raise DeploymentUnavailableError(
+                self.deployment_name, "circuit breaker open",
+                retry_after_s=max(
+                    breaker.seconds_until_probe(), cfg.serve_http_retry_after_s
+                ),
+            )
         self._refresh()
         self._prune()
-        if not self._replicas:
-            raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
         for attempt in range(2):
+            # re-checked every attempt: a force-refresh after a failed
+            # submit may have adopted an empty/draining set. Failing here is
+            # a breaker FAILURE (not just a fast error): it re-opens the
+            # window cleanly when this call held the half-open probe slot,
+            # so the slot can never leak.
+            if self._deployment_draining:
+                breaker.record_failure()
+                raise DeploymentUnavailableError(
+                    self.deployment_name, "deployment is draining",
+                    retry_after_s=cfg.serve_http_retry_after_s,
+                )
+            if not self._replicas:
+                breaker.record_failure()
+                raise DeploymentUnavailableError(
+                    self.deployment_name, "no live replicas",
+                    retry_after_s=cfg.serve_http_retry_after_s,
+                )
             idx = self._pick_replica()
             try:
                 ref = self._replicas[idx].handle_request.remote(

@@ -6,10 +6,22 @@ HTTPProxyActor, uvicorn+starlette). Rebuilt on an asyncio server (VERDICT
 r2 item 8 — the previous stdlib ThreadingHTTPServer held one THREAD per
 in-flight request, so 100 slow streaming consumers pinned 100 threads):
   - persistent connections (HTTP/1.1 keep-alive): one coroutine per
-    connection loops over requests
+    connection loops over requests, bounded by a connection cap (excess
+    connections get 503 + Retry-After)
+  - request-lifecycle deadlines (slow-loris defense): the request head must
+    arrive within `keep_alive_timeout_s` (covers idle keep-alive waits AND
+    header trickle), the body within `read_timeout_s`; expiry sends 408 and
+    reaps the connection — well-behaved neighbors are untouched because a
+    slow client only ever parks its own coroutine
+  - hard size limits: head > max_header_bytes -> 431, body >
+    max_body_bytes -> 413 (both content-length and chunked)
+  - chunked request bodies are decoded (uvicorn parity); chunked responses
+    unchanged
   - replica calls run on a BOUNDED thread pool (they block on the handle),
-    but response STREAMING happens on the event loop with backpressure
-    (`await writer.drain()`) — slow clients hold a coroutine, not a thread
+    with 503 + Retry-After backpressure once the queued-call cap is hit or
+    the deployment is unavailable (draining, no replicas, circuit breaker
+    open); response STREAMING happens on the event loop with backpressure
+    (`await writer.drain()`)
   - longest-prefix route match (an app at "/app" serves "/app/anything");
     the matched remainder + query string ride along for handlers that want
     them (pass_request=True deployments receive a Request object)
@@ -19,6 +31,10 @@ in-flight request, so 100 slow streaming consumers pinned 100 threads):
     StreamingResponse -> chunked transfer, anything else -> {"result": ...}
     JSON (the v1 wire shape, kept stable)
   - per-proxy configurable request timeout -> 504 on expiry
+
+All limits/deadlines default from the `serve_http_*` config flags
+(_private/config.py, RAY_TPU_* env-overridable) and can be set per proxy via
+constructor kwargs or the set_limits() actor method.
 """
 
 from __future__ import annotations
@@ -31,13 +47,20 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Optional
 from urllib.parse import parse_qs, urlsplit
 
-_MAX_HEADER_BYTES = 64 * 1024
 # Replica-call threads; streaming holds none. KNOWN LIMIT: the pool bounds
 # concurrent REPLICA CALLS, so >pool-size slow calls queue (and their
-# wait_for clocks include queue time) — overload degrades to 504s, which is
-# deliberate backpressure where the old thread-per-request server grew
-# unboundedly instead.
+# wait_for clocks include queue time) — overload degrades to 503s/504s,
+# which is deliberate backpressure where the old thread-per-request server
+# grew unboundedly instead.
 _CALL_POOL_SIZE = 16
+
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    408: "Request Timeout", 411: "Length Required",
+    413: "Payload Too Large", 431: "Request Header Fields Too Large",
+    500: "Internal Server Error", 503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
 
 
 @dataclass
@@ -86,6 +109,18 @@ class _Route:
     pass_request: bool = False
 
 
+class _HttpReject(Exception):
+    """Internal: abort request processing with this status; the connection
+    closes after the reply (its stream state is unknown/hostile)."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.retry_after_s = retry_after_s
+
+
 def _parse_body(raw: bytes, ctype: str):
     ctype = (ctype or "").split(";")[0].strip()
     if not raw:
@@ -106,11 +141,39 @@ class HTTPProxyActor:
         host: str = "127.0.0.1",
         port: int = 8000,
         request_timeout_s: float = 60.0,
+        keep_alive_timeout_s: Optional[float] = None,
+        read_timeout_s: Optional[float] = None,
+        max_header_bytes: Optional[int] = None,
+        max_body_bytes: Optional[int] = None,
+        max_connections: Optional[int] = None,
+        max_queued_calls: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
     ):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        def _knob(value, flag):
+            return cfg.get(flag) if value is None else value
+
         self.host = host
         self.port = port
         self.request_timeout_s = request_timeout_s
+        self.keep_alive_timeout_s = float(
+            _knob(keep_alive_timeout_s, "serve_http_keep_alive_timeout_s"))
+        self.read_timeout_s = float(
+            _knob(read_timeout_s, "serve_http_read_timeout_s"))
+        self.max_header_bytes = int(
+            _knob(max_header_bytes, "serve_http_max_header_bytes"))
+        self.max_body_bytes = int(
+            _knob(max_body_bytes, "serve_http_max_body_bytes"))
+        self.max_connections = int(
+            _knob(max_connections, "serve_http_max_connections"))
+        self.max_queued_calls = int(
+            _knob(max_queued_calls, "serve_http_max_queued_calls"))
+        self.retry_after_s = float(
+            _knob(retry_after_s, "serve_http_retry_after_s"))
         self.routes: Dict[str, _Route] = {}
+        self._nconn = 0
+        self._ncalls = 0  # replica calls submitted but not yet finished
         # replica calls block a pool thread; the loop never blocks
         self._pool = ThreadPoolExecutor(
             max_workers=_CALL_POOL_SIZE, thread_name_prefix="ingress-call"
@@ -118,10 +181,18 @@ class HTTPProxyActor:
         self._loop = asyncio.new_event_loop()
         started = threading.Event()
 
+        # stream limit gates readuntil/readline and is FIXED at server
+        # construction; keep it above the header cap so the explicit 431
+        # check fires first (set_limits clamps later raises against it)
+        self._stream_limit = max(2 * self.max_header_bytes, 256 * 1024)
+
         def _run():
             asyncio.set_event_loop(self._loop)
             self._server = self._loop.run_until_complete(
-                asyncio.start_server(self._on_client, host=host, port=port)
+                asyncio.start_server(
+                    self._on_client, host=host, port=port,
+                    limit=self._stream_limit,
+                )
             )
             self.port = self._server.sockets[0].getsockname()[1]
             started.set()
@@ -145,29 +216,107 @@ class HTTPProxyActor:
                     best = route
         return best
 
+    async def _read_body(self, reader, headers: Dict[str, str]) -> bytes:
+        """Request body under the read deadline and size cap. Raises
+        _HttpReject (408 slow body / 413 oversized / 400 malformed)."""
+        deadline = self._loop.time() + self.read_timeout_s
+
+        async def _timed(coro):
+            remaining = deadline - self._loop.time()
+            if remaining <= 0:
+                raise _HttpReject(408, "request body read timed out")
+            try:
+                return await asyncio.wait_for(coro, timeout=remaining)
+            except asyncio.TimeoutError:
+                raise _HttpReject(408, "request body read timed out")
+
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # chunked request decoding (uvicorn/h11 parity): size-line,
+            # data+CRLF, ... , 0-size line, optional trailers, blank line
+            raw = bytearray()
+            while True:
+                line = await _timed(reader.readline())
+                try:
+                    size = int(line.split(b";")[0].strip() or b"0", 16)
+                except ValueError:
+                    raise _HttpReject(400, "malformed chunk size")
+                if size == 0:
+                    while True:  # drain trailers up to the blank line
+                        tl = await _timed(reader.readline())
+                        if tl in (b"\r\n", b"\n", b""):
+                            break
+                    return bytes(raw)
+                if len(raw) + size > self.max_body_bytes:
+                    raise _HttpReject(413, "request body too large")
+                chunk = await _timed(reader.readexactly(size + 2))
+                if chunk[-2:] != b"\r\n":
+                    raise _HttpReject(400, "malformed chunk terminator")
+                raw += chunk[:-2]
+        try:
+            n = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            raise _HttpReject(400, "malformed content-length")
+        if n > self.max_body_bytes:
+            raise _HttpReject(413, "request body too large")
+        return await _timed(reader.readexactly(n)) if n else b""
+
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter):
-        """One coroutine per connection; loops over keep-alive requests."""
+        """One coroutine per connection; loops over keep-alive requests.
+        Every read is under a deadline, so hostile clients (slow-loris,
+        half-open sockets) cost one bounded coroutine, never a thread."""
+        if self._nconn >= self.max_connections:
+            try:
+                await self._reply(
+                    writer, 503, "application/json",
+                    b'{"error": "connection limit reached"}',
+                    extra_headers=self._retry_after(), close=True,
+                )
+            except Exception:
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            return
+        self._nconn += 1
         try:
             while True:
                 try:
-                    head = await reader.readuntil(b"\r\n\r\n")
+                    head = await asyncio.wait_for(
+                        reader.readuntil(b"\r\n\r\n"),
+                        timeout=self.keep_alive_timeout_s,
+                    )
+                except asyncio.TimeoutError:
+                    # idle keep-alive OR trickling headers (slow-loris):
+                    # 408 best-effort, then reap the connection
+                    try:
+                        await self._reply(writer, 408, "application/json",
+                                          b'{"error": "request timed out"}',
+                                          close=True)
+                    except Exception:
+                        pass
+                    return
                 except (asyncio.IncompleteReadError, ConnectionError):
                     return
                 except asyncio.LimitOverrunError:
                     await self._reply(writer, 431, "application/json",
-                                      b'{"error": "headers too large"}')
+                                      b'{"error": "headers too large"}',
+                                      close=True)
                     return
-                if len(head) > _MAX_HEADER_BYTES:
+                if len(head) > self.max_header_bytes:
                     await self._reply(writer, 431, "application/json",
-                                      b'{"error": "headers too large"}')
+                                      b'{"error": "headers too large"}',
+                                      close=True)
                     return
                 lines = head.decode("latin1").split("\r\n")
                 try:
                     method, target, version = lines[0].split(" ", 2)
                 except ValueError:
                     await self._reply(writer, 400, "application/json",
-                                      b'{"error": "bad request line"}')
+                                      b'{"error": "bad request line"}',
+                                      close=True)
                     return
                 headers = {}
                 for ln in lines[1:]:
@@ -175,13 +324,25 @@ class HTTPProxyActor:
                         continue
                     k, _, v = ln.partition(":")
                     headers[k.strip().lower()] = v.strip()
-                if "chunked" in headers.get("transfer-encoding", "").lower():
-                    await self._reply(writer, 411, "application/json",
-                                      b'{"error": "chunked request bodies '
-                                      b'not supported; send Content-Length"}')
+                try:
+                    raw = await self._read_body(reader, headers)
+                except _HttpReject as rej:
+                    await self._reply(
+                        writer, rej.status, "application/json",
+                        json.dumps({"error": rej.message}).encode(),
+                        extra_headers=self._retry_after(rej.retry_after_s),
+                        close=True,
+                    )
                     return
-                n = int(headers.get("content-length", 0) or 0)
-                raw = await reader.readexactly(n) if n else b""
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return  # client hung up mid-body: nothing to answer
+                except ValueError:
+                    # stream-limit overrun inside a chunked body (readline
+                    # raises ValueError on LimitOverrunError)
+                    await self._reply(writer, 400, "application/json",
+                                      b'{"error": "malformed request body"}',
+                                      close=True)
+                    return
                 keep_alive = (
                     headers.get("connection", "").lower() != "close"
                     and version.upper() != "HTTP/1.0"
@@ -192,21 +353,32 @@ class HTTPProxyActor:
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
+            self._nconn -= 1
             try:
                 writer.close()
             except Exception:
                 pass
 
-    async def _reply(self, writer, status: int, ctype: str, payload: bytes):
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  411: "Length Required", 431: "Headers Too Large",
-                  500: "Internal Server Error",
-                  504: "Gateway Timeout"}.get(status, "")
-        writer.write(
-            f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: {ctype}\r\n"
-            f"Content-Length: {len(payload)}\r\n\r\n".encode("latin1")
-        )
+    def _retry_after(self, retry_after_s: Optional[float] = None):
+        secs = self.retry_after_s if retry_after_s is None else retry_after_s
+        return {"Retry-After": str(max(1, int(round(secs))))}
+
+    async def _reply(self, writer, status: int, ctype: str, payload: bytes,
+                     extra_headers: Optional[Dict[str, str]] = None,
+                     close: bool = False):
+        reason = _REASONS.get(status, "")
+        lines = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(payload)}",
+        ]
+        if status in (503,) and extra_headers is None:
+            extra_headers = self._retry_after()
+        for k, v in (extra_headers or {}).items():
+            lines.append(f"{k}: {v}")
+        if close:
+            lines.append("Connection: close")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode("latin1"))
         writer.write(payload)
         await writer.drain()
 
@@ -233,6 +405,9 @@ class HTTPProxyActor:
 
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], raw: bytes):
+        from .handle import DeploymentUnavailableError
+        from .replica import ReplicaDrainingError
+
         parts = urlsplit(target)
         path = parts.path.rstrip("/") or "/"
         route = self._match(path)
@@ -256,15 +431,59 @@ class HTTPProxyActor:
             args = (arg,)
         else:
             args = () if body is None else (body,)
+        if self._ncalls >= self.max_queued_calls:
+            # saturation backpressure AHEAD of the pool: queueing more work
+            # would only grow tail latency past the 504 deadline anyway
+            await self._reply(
+                writer, 503, "application/json",
+                b'{"error": "proxy saturated"}',
+                extra_headers=self._retry_after(),
+            )
+            return
+        # _ncalls mirrors POOL-THREAD occupancy, not caller waits: a 504'd
+        # request's thread keeps blocking in the replica call, so the slot
+        # is only released by the future's done callback — never by the
+        # timeout path (else saturation undercounts and the cap stops
+        # protecting the pool)
+        self._ncalls += 1
+        fut = self._loop.run_in_executor(
+            self._pool, self._call_route, route, args
+        )
+
+        def _call_done(f):
+            self._ncalls -= 1
+            if not f.cancelled():
+                f.exception()  # retrieved: a post-504 error must not warn
+
+        fut.add_done_callback(_call_done)
         try:
+            # shield: on timeout we abandon the wait, NOT the thread —
+            # wait_for must not try to cancel (and then wait out) a
+            # running executor future
             result = await asyncio.wait_for(
-                self._loop.run_in_executor(self._pool, self._call_route,
-                                           route, args),
-                timeout=self.request_timeout_s + 5.0,
+                asyncio.shield(fut), timeout=self.request_timeout_s + 5.0
             )
         except asyncio.TimeoutError:
             await self._reply(writer, 504, "application/json",
                               b'{"error": "request timed out"}')
+            return
+        except DeploymentUnavailableError as e:
+            # draining / no replicas / circuit breaker open: transient by
+            # construction — tell the client when to come back
+            await self._reply(
+                writer, 503, "application/json",
+                json.dumps({"error": str(e)}).encode(),
+                extra_headers=self._retry_after(
+                    getattr(e, "retry_after_s", None)),
+            )
+            return
+        except ReplicaDrainingError as e:
+            # handle retries exhausted against a still-draining set
+            await self._reply(
+                writer, 503, "application/json",
+                json.dumps({"error": str(e)}).encode(),
+                extra_headers=self._retry_after(),
+            )
             return
         except Exception as e:  # noqa: BLE001
             await self._reply(writer, 500, "application/json",
@@ -340,6 +559,43 @@ class HTTPProxyActor:
     def set_request_timeout(self, timeout_s: float):
         self.request_timeout_s = float(timeout_s)
         return True
+
+    def set_limits(self, **limits):
+        """Tune the hardening knobs on a live proxy (tests, operators).
+        Accepts any of: keep_alive_timeout_s, read_timeout_s,
+        max_header_bytes, max_body_bytes, max_connections,
+        max_queued_calls, retry_after_s, request_timeout_s."""
+        allowed = {
+            "keep_alive_timeout_s": float, "read_timeout_s": float,
+            "max_header_bytes": int, "max_body_bytes": int,
+            "max_connections": int, "max_queued_calls": int,
+            "retry_after_s": float, "request_timeout_s": float,
+        }
+        for k, v in limits.items():
+            if k not in allowed:
+                raise ValueError(f"unknown proxy limit {k!r}")
+            v = allowed[k](v)
+            if k == "max_header_bytes":
+                # the asyncio stream limit is fixed at construction:
+                # readuntil would LimitOverrunError below a larger cap, so
+                # clamp instead of silently advertising headroom that the
+                # transport can't deliver (raising it for real needs a new
+                # proxy constructed with the bigger cap)
+                v = min(v, self._stream_limit // 2)
+            setattr(self, k, v)
+        return True
+
+    def limits(self) -> Dict[str, Any]:
+        return {
+            "keep_alive_timeout_s": self.keep_alive_timeout_s,
+            "read_timeout_s": self.read_timeout_s,
+            "max_header_bytes": self.max_header_bytes,
+            "max_body_bytes": self.max_body_bytes,
+            "max_connections": self.max_connections,
+            "max_queued_calls": self.max_queued_calls,
+            "retry_after_s": self.retry_after_s,
+            "request_timeout_s": self.request_timeout_s,
+        }
 
     def stop(self):
         def _stop():

@@ -5,7 +5,10 @@ reference's controller broadcasts routing tables and replica sets to every
 proxy and handle over a long-poll RPC so the data plane reacts to scale
 events immediately instead of on a polling interval. ray_tpu's version
 rides the head's pubsub channels (util/pubsub.py): the controller publishes
-each deployment's replica list to `serve:replicas:<deployment>`.
+each deployment's replica list plus its drain state to
+`serve:replicas:<deployment>` as {"replicas": [...], "draining": bool}, so
+routing AND request-lifecycle state travel in one atomic push (a deployment
+slated for removal stops taking new requests everywhere at once).
 
 One ReplicaWatcher per (process, deployment) — NOT per handle: handles are
 created freely (`h.method` attribute access, options(), unpickling), so
@@ -38,6 +41,7 @@ class ReplicaWatcher:
     def __init__(self, deployment_name: str):
         self.channel = replica_channel(deployment_name)
         self.replicas: Optional[List[Any]] = None
+        self.draining = False  # deployment slated for removal: fail fast
         self.version = 0
         self.last_data_ts = 0.0
         self._seq = 0
@@ -65,7 +69,14 @@ class ReplicaWatcher:
                 continue  # poll timeout: re-arm
             self.last_data_ts = time.time()
             self._seq, data = result
-            self.replicas = list(data)
+            if isinstance(data, dict):
+                # current wire shape: replica set + deployment drain state
+                # ride one push, so handles adopt both atomically
+                self.draining = bool(data.get("draining", False))
+                self.replicas = list(data.get("replicas", []))
+            else:  # legacy bare-list publishers
+                self.draining = False
+                self.replicas = list(data)
             self.version += 1
 
     def stop(self):

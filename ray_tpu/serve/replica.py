@@ -1,15 +1,35 @@
 """Replica actor: hosts one copy of a deployment's callable.
 
 Reference parity: serve/_private/replica.py:382 (RayServeReplica — wraps the
-user callable, tracks ongoing requests for autoscaling stats).
+user callable, tracks ongoing requests for autoscaling stats) plus the
+graceful-drain protocol (reference: replica.py perform_graceful_shutdown —
+a replica slated for removal stops ACCEPTING requests but finishes the ones
+already in flight; the controller only reaps it once it reports idle or the
+drain deadline passes).
 """
 
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 import time
 from typing import Any, Dict
+
+
+class ReplicaDrainingError(RuntimeError):
+    """Raised by a draining replica for NEW requests. No user code ran, so
+    the handle retries it transparently against a refreshed replica set
+    (the drained replica has already been dropped
+    from the published set; this error only hits requests that raced the
+    drain broadcast)."""
+
+    def __init__(self, deployment_name: str = ""):
+        super().__init__(
+            f"replica of {deployment_name!r} is draining and accepts no new "
+            "requests"
+        )
+        self.deployment_name = deployment_name
 
 
 class Replica:
@@ -17,6 +37,7 @@ class Replica:
         self.deployment_name = deployment_name
         self._ongoing = 0
         self._total = 0
+        self._draining = False
         self._lock = threading.Lock()
         if inspect.isclass(func_or_class):
             self.callable = func_or_class(*init_args, **init_kwargs)
@@ -28,8 +49,14 @@ class Replica:
     def ready(self):
         return True
 
+    def pid(self) -> int:
+        """This replica's worker process id (chaos tests SIGKILL it)."""
+        return os.getpid()
+
     def handle_request(self, method_name: str, args, kwargs, model_id: str = ""):
         with self._lock:
+            if self._draining:
+                raise ReplicaDrainingError(self.deployment_name)
             self._ongoing += 1
             self._total += 1
         if model_id:
@@ -52,8 +79,26 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    # ------------------------------------------------------------- draining
+
+    def prepare_to_drain(self) -> int:
+        """Stop accepting new requests; returns the in-flight count at the
+        moment the gate closed (controller sequencing: drain -> reap)."""
+        with self._lock:
+            self._draining = True
+            return self._ongoing
+
+    def num_ongoing(self) -> int:
+        with self._lock:
+            return self._ongoing
+
     def stats(self) -> Dict[str, Any]:
-        return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "draining": self._draining,
+            "ts": time.time(),
+        }
 
     def check_health(self) -> bool:
         user_check = getattr(self.callable, "check_health", None)

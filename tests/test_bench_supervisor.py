@@ -1,0 +1,127 @@
+"""bench.py supervisor robustness (VERDICT weak #1b): a hung phase child
+must degrade to partial results — global wall-clock budget, per-phase row
+emission as rows complete, best-so-far JSON on SIGTERM — instead of losing
+the work that already finished."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+if REPO not in sys.path:  # bench.py lives at the repo root, not in tests/
+    sys.path.insert(0, REPO)
+
+# fake bench child: the raw phase answers instantly, every other phase
+# sleeps forever (the forced-hang child the supervisor must contain)
+FAKE_CHILD = """\
+import json, os, sys, time
+mode = os.environ.get("RAY_TPU_BENCH_CHILD")
+if mode == "raw":
+    print(json.dumps({
+        "metric": "fake_raw_tokens_per_sec", "value": 123.0,
+        "unit": "tokens/s/chip", "mfu": 0.5, "device": "fake",
+        "vs_baseline": 1.0,
+    }))
+    sys.exit(0)
+time.sleep(3600)
+"""
+
+
+@pytest.fixture
+def fake_child(tmp_path):
+    p = tmp_path / "fake_bench_child.py"
+    p.write_text(FAKE_CHILD)
+    return str(p)
+
+
+def _bench_env(fake_child, results_path, budget_s):
+    env = dict(
+        os.environ,
+        RAY_TPU_BENCH_CHILD_SCRIPT=fake_child,
+        RAY_TPU_BENCH_RESULTS=str(results_path),
+        RAY_TPU_BENCH_TOTAL_BUDGET_S=str(budget_s),
+        RAY_TPU_BENCH_OVERHEAD_REPS="1",
+        RAY_TPU_BENCH_TPU_TIMEOUT_S="300",
+    )
+    env.pop("RAY_TPU_BENCH_CHILD", None)
+    return env
+
+
+def test_run_child_group_kills_hung_child():
+    """_run_child contains a child that sleeps forever: rc=None, bounded
+    wall time, no orphan left holding the pipes."""
+    import bench
+
+    t0 = time.monotonic()
+    rc, out, err = bench._run_child(
+        [sys.executable, "-c", "import time; time.sleep(3600)"],
+        dict(os.environ), timeout=1.5,
+    )
+    assert rc is None
+    assert time.monotonic() - t0 < 30
+
+
+def test_budget_degrades_to_partial_results(fake_child, tmp_path):
+    """With a tiny global budget and a trainer child that hangs forever:
+    the raw row lands in the results file the moment it completes, the hung
+    phase is contained, later phases are skipped, and the final JSON still
+    prints (rc=0) with the raw row instead of nothing (VERDICT weak #1:
+    BENCH_r05 lost a finished 0.490-MFU row to exactly this)."""
+    results = tmp_path / "results.jsonl"
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_bench_env(fake_child, results, 12),
+        capture_output=True, text=True, timeout=120,
+    )
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stderr[-800:]
+    # bounded: budget 12s + child-reap slack, nowhere near the 600s the
+    # hung trainer would have burned per attempt
+    assert wall < 90, f"supervisor ran {wall:.0f}s"
+
+    # the completed phase row was emitted incrementally
+    rows = [json.loads(ln) for ln in results.read_text().splitlines()]
+    assert [r["phase"] for r in rows] == ["raw"]
+    assert rows[0]["row"]["metric"] == "fake_raw_tokens_per_sec"
+
+    # final stdout JSON: best-so-far, raw as primary, trainer flagged
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert final["metric"] == "fake_raw_tokens_per_sec"
+    assert final.get("trainer_row_missing") is True
+    assert "budget exhausted" in proc.stderr
+
+
+def test_sigterm_emits_best_so_far(fake_child, tmp_path):
+    """SIGTERM mid-hung-phase: the supervisor kills the child group and
+    prints the best-so-far JSON instead of dying silently."""
+    results = tmp_path / "results.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], env=_bench_env(fake_child, results, 0),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # wait for the raw row to land (trainer is then hanging)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if results.exists() and results.read_text().strip():
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("raw row never landed")
+        time.sleep(1.0)  # supervisor is now inside the hung trainer phase
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=10)
+    assert proc.returncode == 0, err[-800:]
+    final = json.loads(out.strip().splitlines()[-1])
+    assert final["metric"] == "fake_raw_tokens_per_sec"
+    assert "best-so-far" in err

@@ -129,3 +129,111 @@ def test_wait_for_event(wf_cluster, tmp_path):
 def test_wait_for_event_type_check(wf_cluster):
     with pytest.raises(TypeError):
         workflow.wait_for_event(object)
+
+
+# --------------------------------------------------------------------------
+# durability-sync cost (VERDICT weak #6): dirty-set tracking keeps every
+# durability point O(changed files) — counted against a store that tallies
+# its own walks/transfers
+# --------------------------------------------------------------------------
+
+
+class _CountingStorage:
+    """FileStorage wrapper under a cnt:// scheme that counts every store
+    operation — the regression meter for sync cost."""
+
+    def __init__(self):
+        from collections import Counter
+
+        from ray_tpu.train.storage import FileStorage
+
+        self.counts = Counter()
+        self._fs = FileStorage()
+
+    def __getattr__(self, op):
+        inner = getattr(self._fs, op)
+
+        def counted(*a, **kw):
+            self.counts[op] += 1
+            return inner(*a, **kw)
+
+        return counted
+
+
+@pytest.fixture
+def counting_wf_cluster(tmp_path):
+    from ray_tpu.train import storage as rstorage
+
+    st = _CountingStorage()
+    rstorage.register_storage("cnt", st)
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    workflow.init("cnt://" + str(tmp_path / "store"))
+    yield st
+    workflow.init(str(tmp_path / "local"))  # detach the URI store
+    ray_tpu.shutdown()
+
+
+def _chain(n):
+    dag = add.bind(1, 1)
+    for _ in range(n - 1):
+        dag = add.bind(dag, 1)
+    return dag
+
+
+def test_durability_sync_is_o_changed_files(counting_wf_cluster):
+    """An N-step workflow ships N step files + a constant handful of
+    top-files — no per-step store walk, no dir transfer, and re-syncing an
+    unchanged file is free (dirty-set tracking)."""
+    from ray_tpu.workflow import api
+
+    st = counting_wf_cluster
+    n = 8
+    assert workflow.run(_chain(n), workflow_id="wf_sync") == n + 1
+
+    # durability points never walk or ship directories
+    assert st.counts["upload_dir"] == 0
+    assert st.counts["download_dir"] == 0
+    assert st.counts["list"] == 0
+    # uploads: n step checkpoints + dag/inputs/result + a few meta updates
+    # (status transitions). The O(N)-per-step regression would make this
+    # quadratic (~n*n/2 >= 32 for n=8).
+    uploads = st.counts["upload_file"]
+    assert n <= uploads <= n + 8, dict(st.counts)
+
+    # re-shipping unchanged bytes is free: repeated sync of the same file
+    # does not touch the store
+    before = st.counts["upload_file"]
+    for _ in range(5):
+        api._sync_up("wf_sync", "dag.pkl")
+    assert st.counts["upload_file"] == before
+
+    # warm-mirror resume: top-files refresh, but NO step re-downloads and
+    # no step re-uploads (checkpoints are immutable + already clean)
+    st.counts.clear()
+    assert workflow.resume("wf_sync") == n + 1
+    assert st.counts["download_file"] <= 4, dict(st.counts)
+    assert st.counts["upload_file"] <= 4, dict(st.counts)
+    assert st.counts["list"] <= 2
+
+
+def test_cold_host_resume_still_fetches_everything(counting_wf_cluster):
+    """The dirty-set optimization must NOT break cross-host durability: a
+    host with no local mirror pulls the full checkpoint set and resumes."""
+    import shutil
+
+    from ray_tpu.workflow import api
+
+    st = counting_wf_cluster
+    n = 6
+    assert workflow.run(_chain(n), workflow_id="wf_cold") == n + 1
+
+    # simulate a different host: wipe the local mirror + sync records
+    shutil.rmtree(api._wf_dir("wf_cold"))
+    with api._SYNC_LOCK:
+        api._SYNC_STATE.pop("wf_cold", None)
+    st.counts.clear()
+    assert workflow.resume("wf_cold") == n + 1
+    # every step checkpoint travelled down exactly once; none re-uploaded
+    assert st.counts["download_file"] >= n, dict(st.counts)
+    step_uploads = st.counts["upload_file"]
+    assert step_uploads <= 4, dict(st.counts)  # meta/result only
