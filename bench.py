@@ -233,6 +233,79 @@ def main_hbm():
 
 
 # --------------------------------------------------------------------------
+# decode mode — KV-cache serving fast path (tokens/s/chip at the decode step)
+# --------------------------------------------------------------------------
+
+
+def main_decode():
+    """Batched KV-cache decode throughput: the serving-side counterpart of
+    the training rows. Prefills `batch` slots, then times `new_tokens`
+    continuous decode steps through DecodeEngine (the same loop the serve
+    replica drives), reporting tokens/s/chip. The batched-vs-serial gate
+    lives in microbench.py; this row is the absolute rate."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.decoding import DecodeEngine
+
+    dev = jax.devices()[0]
+    on_tpu = _on_tpu(dev)
+    n_chips = len(jax.devices())
+
+    if on_tpu:
+        cfg = CONFIGS["gpt2_125m"]
+        batch, prompt_len, new_tokens = 8, 128, 128
+    else:
+        cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
+        batch, prompt_len, new_tokens = 4, 16, 32
+
+    engine = DecodeEngine(cfg, max_batch_size=batch, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, size=(batch, prompt_len))
+    slots = list(range(batch))
+
+    t0 = time.perf_counter()
+    for s in slots:
+        engine.admit(s, {"tokens": prompts[s], "max_new_tokens": 10**9})
+    prefill_s = time.perf_counter() - t0
+    engine.step(slots)  # decode compile + warm
+    t0 = time.perf_counter()
+    for _ in range(new_tokens):
+        engine.step(slots)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec_per_chip = batch * new_tokens / dt / n_chips
+    kind = getattr(dev, "device_kind", dev.platform)
+    print(
+        f"[bench:decode] dev={kind} chips={n_chips} batch={batch} "
+        f"prompt={prompt_len} new={new_tokens} "
+        f"prefill={prefill_s * 1000:.0f}ms step={dt / new_tokens * 1000:.2f}ms "
+        f"tok/s/chip={tokens_per_sec_per_chip:.1f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_125m_decode_tokens_per_sec_per_chip"
+                if on_tpu
+                else "tiny_decode_tokens_per_sec_per_chip_cpu",
+                "value": round(tokens_per_sec_per_chip, 1),
+                "unit": "tokens/s/chip",
+                "device": kind,
+                "batch": batch,
+                "prompt_len": prompt_len,
+                "new_tokens": new_tokens,
+                "prefill_ms": round(prefill_s * 1000, 1),
+                "decode_step_ms": round(dt / new_tokens * 1000, 3),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------
 # trainer mode — the framework in the measured loop
 # --------------------------------------------------------------------------
 
@@ -754,7 +827,7 @@ def _supervise() -> int:
 
     old_term = signal.signal(signal.SIGTERM, _on_term)
     raws, trainers, rep_pairs = [], [], []
-    hbm = rl = None
+    hbm = rl = decode = None
     try:
         for _ in range(reps):
             r = _phase("raw", raw_timeout, 3, cpu_fallback=True,
@@ -769,6 +842,11 @@ def _supervise() -> int:
                 # overhead pairs only from reps where BOTH phases ran — a
                 # failed rep must not pair measurements minutes apart
                 rep_pairs.append((r, t))
+        # decode rides early among the satellite rows: it is the cheapest
+        # TPU phase, so a later trainer/hbm hang still leaves the serving
+        # row in the incremental results file
+        decode = _phase("decode", 600, 2, cpu_fallback=True,
+                        deadline=deadline, results_path=results_path)
         hbm = _phase("hbm", 600, 2, cpu_fallback=False,
                      deadline=deadline, results_path=results_path)
         rl = _phase("rl", 600, 2, cpu_fallback=False,
@@ -814,6 +892,8 @@ def _supervise() -> int:
         primary["hbm"] = hbm
     if rl is not None:
         primary["rl"] = rl
+    if decode is not None:
+        primary["decode"] = decode
     print(json.dumps(primary))
     return 0
 
@@ -828,5 +908,7 @@ if __name__ == "__main__":
         main_hbm()
     elif mode == "rl":
         main_rl()
+    elif mode == "decode":
+        main_decode()
     else:
         sys.exit(_supervise())

@@ -175,6 +175,26 @@ D("serve_breaker_failure_threshold", int, 5,
   "opens and calls fail fast with DeploymentUnavailableError")
 D("serve_breaker_reset_s", float, 1.0,
   "how long an open circuit breaker waits before letting one probe through")
+# --- serve continuous batching / token streaming ---
+# Generation knobs are read in the REPLICA process at ContinuousBatcher
+# construction (env vars or explicit constructor args); stream-pull knobs
+# are read in the proxy process per pull.
+D("serve_generation_max_batch_size", int, 8,
+  "decode slots per ContinuousBatcher: the running batch admits new "
+  "requests and retires finished ones at token granularity up to this size")
+D("serve_generation_batch_wait_timeout_s", float, 0.01,
+  "coalescing window when the running batch is EMPTY: wait this long for "
+  "more requests before the first decode step (an active batch admits "
+  "queued requests between steps without waiting)")
+D("serve_stream_pull_max_chunks", int, 64,
+  "max chunks the proxy pulls from a replica stream per stream_next call")
+D("serve_stream_pull_wait_s", float, 0.25,
+  "long-poll wait inside stream_next: block up to this long for the first "
+  "chunk before returning an empty pull (bounds pull-call latency)")
+D("serve_stream_idle_reap_s", float, 120.0,
+  "a registered replica stream nobody has pulled for this long is "
+  "cancelled and dropped — an abandoned consumer must not inflate "
+  "num_ongoing (wedging drain) or hold a decode slot forever")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

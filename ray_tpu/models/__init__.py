@@ -4,8 +4,12 @@ apply fns, logical-axis sharding annotations)."""
 from .transformer import (  # noqa: F401
     TransformerConfig,
     init_params,
+    init_kv_cache,
     param_specs,
+    make_decoder,
     make_forward,
     make_loss_fn,
     CONFIGS,
+    KV_CACHE_AXES,
 )
+from .decoding import DecodeEngine  # noqa: F401

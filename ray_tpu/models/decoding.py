@@ -1,0 +1,197 @@
+"""Slot-based KV-cache decode engine: the model side of continuous batching.
+
+The engine owns one KV cache of `max_batch_size` slots and exposes the two
+operations `ray_tpu.serve.batching.ContinuousBatcher` drives:
+
+  admit(slot, request) -> (token, done)   prefill one request into a free
+                                          slot (B=1 prefill, prompt padded
+                                          to a length bucket so compiles
+                                          are bounded)
+  step(slots)          -> {slot: (token, done)}   ONE cached decode step
+                                          for every active slot together —
+                                          slots at different sequence
+                                          lengths share the batch, which is
+                                          exactly what makes batched decode
+                                          outrun per-request decode
+
+The decode step is jit-compiled once (per cache batch size) and reused for
+the engine's lifetime; per-step host work is two [B] int32 transfers and
+the sampled-token fetch. Not thread-safe: a single loop thread (the
+batcher's) must own admit/step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .transformer import (
+    TransformerConfig,
+    init_kv_cache,
+    init_params,
+    make_decoder,
+)
+
+
+def default_prefill_buckets(max_seq_len: int) -> Tuple[int, ...]:
+    """Powers of two up to max_seq_len (always including it): each bucket
+    costs one prefill compile, padding within a bucket costs only FLOPs."""
+    buckets = []
+    b = 16
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq_len)
+    return tuple(buckets)
+
+
+class DecodeEngine:
+    """KV-cache decode over `max_batch_size` slots (see module docstring)."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params=None,
+        *,
+        max_batch_size: int = 8,
+        rules=None,
+        mesh=None,
+        eos_id: Optional[int] = None,
+        temperature: float = 0.0,
+        default_max_new_tokens: int = 64,
+        max_seq_len: Optional[int] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        seed: int = 0,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.max_batch_size = int(max_batch_size)
+        self.eos_id = eos_id
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        if self.max_seq_len > cfg.max_seq_len:
+            raise ValueError("max_seq_len exceeds the model's rope tables")
+        self.params = (
+            params if params is not None
+            else init_params(jax.random.PRNGKey(seed), cfg)
+        )
+        self.cache = init_kv_cache(
+            cfg, self.max_batch_size, mesh=mesh, rules=rules,
+            max_seq_len=self.max_seq_len,
+        )
+        self._prefill, self._write_cache, self._decode_step = make_decoder(
+            cfg, rules=rules, mesh=mesh, temperature=temperature
+        )
+        self.buckets = tuple(sorted(
+            prefill_buckets or default_prefill_buckets(self.max_seq_len)
+        ))
+        self._key = jax.random.PRNGKey(seed + 1)
+        # host-side slot bookkeeping (the decode step consumes these as [B]
+        # device transfers each step — trivial next to the matmuls)
+        B = self.max_batch_size
+        self._positions = np.zeros(B, np.int32)
+        self._last_tokens = np.zeros(B, np.int32)
+        self._new_counts = np.zeros(B, np.int64)
+        self._max_new = np.full(B, self.default_max_new_tokens, np.int64)
+        # counters (bench/observability)
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.decode_steps = 0
+
+    def _next_key(self):
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, length: int) -> int:
+        for b in self.buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt of {length} tokens exceeds max_seq_len {self.max_seq_len}"
+        )
+
+    def _done(self, slot: int, token: int) -> bool:
+        if self.eos_id is not None and token == self.eos_id:
+            return True
+        if self._new_counts[slot] >= self._max_new[slot]:
+            return True
+        # positions[slot] is the NEXT write position; S-1 is still legal,
+        # so only cut once the next write would fall off the cache
+        return int(self._positions[slot]) >= self.max_seq_len
+
+    # ----------------------------------------------------------- engine API
+
+    def admit(self, slot: int, request: Dict[str, Any]) -> Tuple[int, bool]:
+        """Prefill `request` into `slot`; returns the first generated token.
+
+        request: {"tokens": sequence of int token ids,
+                  "max_new_tokens": optional int (default engine-wide)}
+        """
+        prompt = np.asarray(request["tokens"], np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("request['tokens'] must be a non-empty 1-D seq")
+        length = int(prompt.size)
+        if length >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {length} tokens leaves no room to generate "
+                f"(max_seq_len {self.max_seq_len})"
+            )
+        bucket = self._bucket(length)
+        padded = np.zeros(bucket, np.int32)
+        padded[:length] = prompt
+        next_tok, _, ks, vs = self._prefill(
+            self.params, padded[None], np.asarray([length], np.int32),
+            self._next_key(),
+        )
+        self.cache = self._write_cache(self.cache, ks, vs, slot)
+        tok = int(next_tok[0])
+        self._positions[slot] = length
+        self._last_tokens[slot] = tok
+        self._new_counts[slot] = 1
+        mnt = request.get("max_new_tokens")
+        # admit always emits one token, so the floor is 1 (an explicit 0
+        # must not silently fall back to the engine default)
+        self._max_new[slot] = (
+            self.default_max_new_tokens if mnt is None else max(1, int(mnt))
+        )
+        self.prefills += 1
+        self.tokens_generated += 1
+        return tok, self._done(slot, tok)
+
+    def step(self, slots: List[int]) -> Dict[int, Tuple[int, bool]]:
+        """One cached decode step for every slot in `slots` (inactive slots
+        ride along as padding — their outputs are ignored)."""
+        if not slots:
+            return {}
+        next_toks, _, self.cache = self._decode_step(
+            self.params, self.cache, self._last_tokens, self._positions,
+            self._next_key(),
+        )
+        toks = np.asarray(next_toks)
+        out: Dict[int, Tuple[int, bool]] = {}
+        for slot in slots:
+            tok = int(toks[slot])
+            self._positions[slot] += 1
+            self._last_tokens[slot] = tok
+            self._new_counts[slot] += 1
+            out[slot] = (tok, self._done(slot, tok))
+        self.decode_steps += 1
+        self.tokens_generated += len(slots)
+        return out
+
+    def release(self, slot: int) -> None:
+        """Free a slot (bookkeeping only — the cache row is overwritten by
+        the next admit)."""
+        self._new_counts[slot] = 0
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "max_batch_size": self.max_batch_size,
+        }

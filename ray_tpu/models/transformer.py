@@ -13,6 +13,15 @@ model-parallel story is external Alpa, release/alpa_tests/):
   - attention impl is selectable: dense (small L), ring (sequence-parallel
     over `sp` via ppermute ring), ulysses (all-to-all head scatter).
   - bf16 compute, f32 params/accumulators.
+
+Decode fast path (serving): `make_decoder` builds prefill + cached
+single-token decode — a per-layer KV cache allocated at `max_seq_len`,
+written at each sequence's current position and sharded by the same
+partition rules as activations, so every generated token pays O(L)
+attention reads instead of the O(L^2) full-sequence forward. The decode
+step is jit-compiled once (per cache batch size) and reused; see
+`ray_tpu/models/decoding.py` for the slot-based engine continuous
+batching drives.
 """
 
 from __future__ import annotations
@@ -26,7 +35,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import causal_attention, causal_attention_bhsd
+from ..ops.attention import (
+    NEG_INF,
+    _repeat_kv,
+    causal_attention,
+    causal_attention_bhsd,
+)
 from ..ops.norm import rms_norm
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import apply_rope, apply_rope_bhsd, rope_frequencies
@@ -314,6 +328,21 @@ def _moe_dispatch(h, lp, cfg: TransformerConfig, constrain_fn):
     return out.reshape(B, S, E)
 
 
+_MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router")
+
+
+def _cast_matmul_params(cfg: TransformerConfig, params):
+    """Cast the stacked matmul weights to compute dtype ONCE — otherwise
+    XLA re-converts the f32 masters on every scan iteration and again per
+    remat pass (~5% of step time on the 125M bench); norm scales stay f32
+    (rms_norm computes in f32 anyway)."""
+    layers = dict(params["layers"])
+    for key in _MATMUL_KEYS:
+        if key in layers:
+            layers[key] = layers[key].astype(cfg.dtype)
+    return {**params, "layers": layers}
+
+
 def _mlp(h, lp, cfg: TransformerConfig, constrain_fn):
     if cfg.n_experts:
         if cfg.moe_impl == "dense":
@@ -361,14 +390,11 @@ def make_forward(
         if inner_attn is not None and mesh is not None:
             from jax.sharding import PartitionSpec as P
 
+            from ..parallel.sharding import shard_map_compat
+
             spec = P(None, "sp", None, None)
-            return jax.shard_map(
-                inner_attn,
-                mesh=mesh,
-                in_specs=(spec, spec, spec),
-                out_specs=spec,
-                check_vma=False,
-                axis_names=frozenset({"sp"}),
+            return shard_map_compat(
+                inner_attn, mesh, (spec, spec, spec), spec, {"sp"}
             )(q, k, v)
         if head_major:
             if cfg.attention == "flash":
@@ -482,23 +508,13 @@ def make_forward(
         x, _ = lax.scan(step, x, params["layers"])
         return x
 
-    _MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router")
-
     def backbone(params, tokens):
         """Everything up to (and including) the final norm; returns the
         final hidden states plus the compute-dtype unembed matrix so the
         loss can choose how to project them (dense vs blockwise)."""
         x = params["embed"].astype(cfg.dtype)[tokens]
         x = _constrain(x, "batch", "seq", "embed")
-        # cast the stacked matmul weights to compute dtype ONCE — otherwise
-        # XLA re-converts the f32 masters on every scan iteration and again
-        # per remat pass (~5% of step time on the 125M bench); norm scales
-        # stay f32 (rms_norm computes in f32 anyway)
-        layers = dict(params["layers"])
-        for key in _MATMUL_KEYS:
-            if key in layers:
-                layers[key] = layers[key].astype(cfg.dtype)
-        params = {**params, "layers": layers}
+        params = _cast_matmul_params(cfg, params)
         x = _apply_layers(params, x)
         x = rms_norm(x, params["final_norm"])
         unembed = params.get("unembed")
@@ -515,6 +531,181 @@ def make_forward(
     if _return_backbone:
         return forward, backbone, _constrain
     return forward
+
+
+# --------------------------------------------------------------------------
+# autoregressive decode (KV cache)
+# --------------------------------------------------------------------------
+
+# cache leaves are [n_layers, batch, max_seq_len, kv_heads, head_dim]; the
+# logical axes reuse the activation rules, so the cache shards exactly like
+# activations under every existing mesh preset (dp/fsdp shard the slot dim,
+# tp shards kv_heads; kv_seq stays unsharded outside sp presets — decode
+# scatters at dynamic positions, which sp sharding would turn into
+# collectives per token)
+KV_CACHE_AXES = ("layers", "batch", "kv_seq", "kv_heads", "head_dim")
+
+
+def init_kv_cache(
+    cfg: TransformerConfig,
+    batch_size: int,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+    max_seq_len: Optional[int] = None,
+):
+    """Allocate the per-layer KV cache for `batch_size` decode slots."""
+    S = max_seq_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch_size, S, cfg.n_kv_heads, cfg.d_head)
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    if mesh is not None and rules is not None:
+        from ..parallel.sharding import logical_sharding
+
+        sh = logical_sharding(mesh, rules, *KV_CACHE_AXES)
+        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+    return {"k": k, "v": v}
+
+
+def make_decoder(
+    cfg: TransformerConfig,
+    rules: Optional[ShardingRules] = None,
+    mesh=None,
+    temperature: float = 0.0,
+):
+    """Build the autoregressive fast path: (prefill, write_cache, decode_step).
+
+    prefill(params, tokens[B,Sp], lengths[B], key)
+        -> (next_tokens[B], logits[B,V], ks, vs)
+      Full forward over the (padded) prompt; logits are read at position
+      lengths-1 and ks/vs are the per-layer K/V stacks [L,B,Sp,KV,D] ready
+      to be written into a cache. Compiled per (B, Sp) shape — callers pad
+      prompts to a small set of buckets.
+
+    write_cache(cache, ks, vs, slot) -> cache
+      Scatter a prefill's K/V stack into cache rows [slot, slot+B).
+
+    decode_step(params, cache, tokens[B], positions[B], key)
+        -> (next_tokens[B], logits[B,V], cache)
+      One cached decode step for every slot: the new K/V is written at each
+      slot's own position, attention reads kpos <= position, so slots at
+      different sequence lengths decode together in one batch (the
+      continuous-batching contract). Jit-compiled once per cache batch
+      size, cache donated.
+
+    temperature=0 is greedy argmax; >0 samples categorically with `key`.
+    Decode is dense-attention only (the cache read is one [B,S] row per
+    slot); ring/ulysses and pp_stages>1 configs must decode with a
+    non-sp/pp rules table.
+    """
+    if cfg.pp_stages > 1:
+        raise NotImplementedError("decode does not support pp_stages > 1")
+    cos, sin = rope_frequencies(cfg.d_head, cfg.max_seq_len, cfg.rope_theta)
+    scale = cfg.d_head**-0.5
+
+    def _constrain(x, *axes):
+        if rules is None or mesh is None:
+            return x
+        return constrain(x, rules, *axes, mesh=mesh)
+
+    def _sample(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature, axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _unembed(params):
+        u = params.get("unembed")
+        if u is None:
+            u = params["embed"].T
+        return u.astype(cfg.dtype)
+
+    def _prefill(params, tokens, lengths, key):
+        params = _cast_matmul_params(cfg, params)
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = _constrain(x, "batch", "seq", "embed")
+
+        def layer_prefill(x, lp):
+            h = rms_norm(x, lp["attn_norm"])
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            q = _constrain(q, "batch", "seq", "heads", "head_dim")
+            attn = causal_attention(q, k, v)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"])
+            x = x + _mlp(h2, lp, cfg, _constrain)
+            x = _constrain(x, "batch", "seq", "embed")
+            return x, (k, v)
+
+        x, (ks, vs) = lax.scan(layer_prefill, x, params["layers"])
+        x = rms_norm(x, params["final_norm"])
+        # logits only at each sequence's last real token (padding beyond
+        # lengths-1 produces garbage states that are never read)
+        B = tokens.shape[0]
+        x_last = x[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
+        logits = jnp.einsum("be,ev->bv", x_last, _unembed(params))
+        logits = _constrain(logits, "batch", "vocab")
+        return _sample(logits, key), logits, ks, vs
+
+    def _write_cache(cache, ks, vs, slot):
+        k = lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
+                                     (0, slot, 0, 0, 0))
+        v = lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype),
+                                     (0, slot, 0, 0, 0))
+        return {"k": k, "v": v}
+
+    def _decode_step(params, cache, tokens, positions, key):
+        params = _cast_matmul_params(cfg, params)
+        B = tokens.shape[0]
+        S = cache["k"].shape[2]
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # [B,1,E]
+        x = _constrain(x, "batch", "seq", "embed")
+        pos2 = positions[:, None]  # [B,1]
+        rows = jnp.arange(B)[:, None]
+        kvalid = jnp.arange(S)[None, :] <= pos2  # [B,S] incl. this token
+
+        def layer_decode(x, per_layer):
+            lp, kc, vc = per_layer
+            h = rms_norm(x, lp["attn_norm"])
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])  # [B,1,H,D]
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])  # [B,1,KV,D]
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
+            q = apply_rope(q, cos, sin, positions=pos2)
+            k = apply_rope(k, cos, sin, positions=pos2)
+            # write this token's K/V at each slot's own position
+            kc = kc.at[rows, pos2].set(k.astype(kc.dtype))
+            vc = vc.at[rows, pos2].set(v.astype(vc.dtype))
+            kr = _repeat_kv(kc, n_rep)
+            vr = _repeat_kv(vc, n_rep)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+            ) * scale  # [B,H,1,S]
+            logits = jnp.where(kvalid[:, None, None, :], logits, NEG_INF)
+            probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+            probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vr.dtype), vr)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"])
+            x = x + _mlp(h2, lp, cfg, _constrain)
+            x = _constrain(x, "batch", "seq", "embed")
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            layer_decode, x, (params["layers"], cache["k"], cache["v"])
+        )
+        x = rms_norm(x, params["final_norm"])
+        logits = jnp.einsum("be,ev->bv", x[:, 0], _unembed(params))
+        logits = _constrain(logits, "batch", "vocab")
+        return _sample(logits, key), logits, {"k": k_new, "v": v_new}
+
+    prefill = jax.jit(_prefill)
+    write_cache = jax.jit(_write_cache, donate_argnums=(0,))
+    decode_step = jax.jit(_decode_step, donate_argnums=(1,))
+    return prefill, write_cache, decode_step
 
 
 def make_loss_fn(cfg: TransformerConfig, rules=None, mesh=None):

@@ -89,12 +89,7 @@ def make_sharded_ring_attention(mesh, axis_name: str = "sp", causal: bool = True
 
     spec = P(None, axis_name, None, None)
 
+    from ..parallel.sharding import shard_map_compat
+
     fn = partial(ring_attention, axis_name=axis_name, causal=causal)
-    return jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-        axis_names=frozenset({axis_name}),
-    )
+    return shard_map_compat(fn, mesh, (spec, spec, spec), spec, {axis_name})

@@ -98,12 +98,9 @@ def pipeline_apply(
     fn = partial(
         _pipeline_local, stage_fn, axis_name=axis_name, n_microbatches=n_microbatches
     )
-    out_mb = jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
-        check_vma=False,
-        axis_names=frozenset({axis_name}),
+    from .sharding import shard_map_compat
+
+    out_mb = shard_map_compat(
+        fn, mesh, (pspec, P()), P(), {axis_name}
     )(stage_params, x_mb)
     return out_mb.reshape((b,) + out_mb.shape[2:])

@@ -12,7 +12,11 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional, Union
 
-from .batching import batch  # noqa: F401
+from .batching import (  # noqa: F401
+    ContinuousBatcher,
+    GenerationStream,
+    batch,
+)
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
 from .handle import (  # noqa: F401
     CONTROLLER_NAME,
@@ -21,10 +25,15 @@ from .handle import (  # noqa: F401
     DeploymentUnavailableError,
 )
 from .drivers import http_adapters  # noqa: F401
-from .http_proxy import Request, Response, StreamingResponse  # noqa: F401
+from .http_proxy import (  # noqa: F401
+    Request,
+    Response,
+    StreamingResponse,
+    sse_stream,
+)
 from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
-from .replica import ReplicaDrainingError  # noqa: F401
+from .replica import ReplicaDrainingError, ReplicaStreamHandle  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
 

@@ -1,43 +1,91 @@
-"""@serve.batch: transparent request batching inside a replica.
+"""Request batching inside a replica.
 
-Reference parity: serve/batching.py (_BatchQueue: collect up to
-max_batch_size requests or batch_wait_timeout_s, call the wrapped fn once
-with the list, scatter results). Implemented with a flusher thread because
-replica methods execute on a thread pool (see _private/worker_main.py).
+Two batching models live here:
+
+  @serve.batch — request-level coalescing (reference parity:
+  serve/batching.py _BatchQueue: collect up to max_batch_size requests or
+  batch_wait_timeout_s, call the wrapped fn once with the list, scatter
+  results). Implemented with a flusher thread because replica methods
+  execute on a thread pool (see _private/worker_main.py).
+
+  ContinuousBatcher — TOKEN-level batching for autoregressive generation
+  (the Orca/vLLM iteration-level scheduling shape): one loop thread owns an
+  engine with `max_batch_size` decode slots, admits queued requests into
+  the RUNNING batch between decode steps and retires finished sequences at
+  token granularity — no stop-the-world between generations. Emitted
+  tokens stream to per-request GenerationStreams (the replica exposes them
+  to the proxy via stream_next pulls; see serve/README.md).
+
+Both compose with graceful draining: `drain(deadline_s)` stops admissions,
+bounces queued-but-unadmitted work with ReplicaDrainingError (the handle
+retries it transparently on a live replica) and lets in-flight work finish
+— a running generation keeps decoding until done or the drain deadline, at
+which point it is CUT (its stream ends, marked `cut`), never orphaned.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 import queue
 import threading
+import time
 from concurrent.futures import Future
-from typing import Any, Callable, List
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class _BatchQueue:
+    _serve_drainable = True
+
     def __init__(self, fn: Callable, max_batch_size: int, timeout_s: float):
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_s
         self.q: "queue.Queue" = queue.Queue()
+        self._draining = False
         self._thread = threading.Thread(target=self._flush_loop, daemon=True)
         self._thread.start()
 
     def submit(self, self_arg, item) -> Future:
         fut: Future = Future()
+        if self._draining:
+            fut.set_exception(self._drain_error())
+            return fut
         self.q.put((self_arg, item, fut))
+        if self._draining:
+            # raced drain(): make sure nothing lingers in the queue
+            self._bounce_queued()
         return fut
+
+    @staticmethod
+    def _drain_error():
+        from .replica import ReplicaDrainingError
+
+        return ReplicaDrainingError()
+
+    def _bounce_queued(self):
+        while True:
+            try:
+                *_, fut = self.q.get_nowait()
+            except queue.Empty:
+                return
+            if not fut.done():
+                fut.set_exception(self._drain_error())
+
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """Stop batching: queued-but-unadmitted items fail with
+        ReplicaDrainingError (no user code ran — the handle re-routes them
+        to a live replica); the batch currently executing completes."""
+        self._draining = True
+        self._bounce_queued()
 
     def _flush_loop(self):
         while True:
             first = self.q.get()
             batch = [first]
             deadline = self.timeout_s
-            import time
-
             t0 = time.monotonic()
-            while len(batch) < self.max_batch_size:
+            while len(batch) < self.max_batch_size and not self._draining:
                 remaining = deadline - (time.monotonic() - t0)
                 if remaining <= 0:
                     break
@@ -45,6 +93,12 @@ class _BatchQueue:
                     batch.append(self.q.get(timeout=remaining))
                 except queue.Empty:
                     break
+            if self._draining:
+                # collected but user code never ran: bounce for retry
+                for *_, f in batch:
+                    if not f.done():
+                        f.set_exception(self._drain_error())
+                continue
             self_arg = batch[0][0]
             items = [b[1] for b in batch]
             futs = [b[2] for b in batch]
@@ -90,3 +144,326 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
     if _fn is not None:
         return decorator(_fn)
     return decorator
+
+
+# --------------------------------------------------------------------------
+# continuous batching (token-granularity admission/retirement)
+# --------------------------------------------------------------------------
+
+
+class GenerationStream:
+    """Per-request token stream: the batcher pushes, one consumer pulls.
+
+    Iterable in-process; `next_batch` is the long-poll pull the replica's
+    stream_next uses (block up to wait_s for the first item, then drain
+    whatever else is ready)."""
+
+    _END = object()
+
+    def __init__(self, request_id: int, request: Dict[str, Any]):
+        self.request_id = request_id
+        self.request = request
+        self.cut = False        # drain deadline truncated this generation
+        self.cancelled = False  # consumer went away
+        self._q: "queue.Queue" = queue.Queue()
+        self._finished = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._drained = False   # END consumed; only the error (if any) left
+
+    # -- producer side (batcher loop thread)
+
+    def _push(self, token) -> None:
+        self._q.put(token)
+
+    def _finish(self, error: Optional[BaseException] = None,
+                cut: bool = False) -> None:
+        self._error = error
+        self.cut = cut or self.cut
+        self._finished.set()
+        self._q.put(self._END)
+
+    # -- consumer side
+
+    def cancel(self) -> None:
+        """Consumer gone: the batcher retires the slot at the next step."""
+        self.cancelled = True
+
+    @property
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+    def next_batch(self, max_items: int = 64,
+                   wait_s: float = 0.25) -> Tuple[List[Any], bool]:
+        """Pull up to max_items; returns (items, done). Blocks up to wait_s
+        for the first item; raises the stream's error (e.g.
+        ReplicaDrainingError for a never-admitted request, an engine fault
+        mid-generation) once all produced items have been delivered — a
+        faulted stream must never end looking like a clean completion, so
+        when tokens and the END marker land in one pull the items go out
+        with done=False and the NEXT pull raises."""
+        if self._drained:
+            if self._error is not None:
+                raise self._error
+            return [], True
+        items: List[Any] = []
+        try:
+            first = self._q.get(timeout=max(0.0, wait_s))
+        except queue.Empty:
+            return items, False
+        ended = first is self._END
+        if not ended:
+            items.append(first)
+            while len(items) < max_items:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is self._END:
+                    ended = True
+                    break
+                items.append(nxt)
+        if ended:
+            self._drained = True
+            if self._error is not None:
+                if items:
+                    return items, False  # error surfaces on the next pull
+                raise self._error
+        return items, ended
+
+    def __iter__(self):
+        while True:
+            items, done = self.next_batch(max_items=64, wait_s=5.0)
+            yield from items
+            if done:
+                return
+
+
+class ContinuousBatcher:
+    """Token-granularity continuous batching over a slot-based engine.
+
+    engine contract (see ray_tpu.models.decoding.DecodeEngine):
+      admit(slot, request) -> (token, done)
+      step(slots)          -> {slot: (token, done)}
+      release(slot)          optional
+
+    One loop thread owns the engine. Requests submitted while the batch is
+    full wait in a queue and are admitted the moment a slot retires —
+    mid-generation of everyone else (that is the whole point). The
+    per-step occupancy log (`occupancy_log()`) records which requests
+    shared each engine step; tests use it to prove interleaving.
+    """
+
+    _serve_drainable = True
+
+    def __init__(
+        self,
+        engine,
+        max_batch_size: Optional[int] = None,
+        batch_wait_timeout_s: Optional[float] = None,
+    ):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        self.engine = engine
+        engine_cap = getattr(engine, "max_batch_size", None)
+        self.max_batch_size = int(
+            max_batch_size
+            or engine_cap
+            or cfg.serve_generation_max_batch_size
+        )
+        if engine_cap is not None and self.max_batch_size > engine_cap:
+            raise ValueError(
+                f"max_batch_size {self.max_batch_size} exceeds the engine's "
+                f"{engine_cap} slots"
+            )
+        self.batch_wait_timeout_s = float(
+            cfg.serve_generation_batch_wait_timeout_s
+            if batch_wait_timeout_s is None else batch_wait_timeout_s
+        )
+        self._pending: "queue.Queue[GenerationStream]" = queue.Queue()
+        self._free = list(range(self.max_batch_size))
+        self._active: Dict[int, GenerationStream] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._shutdown = False
+        self._steps = 0
+        # bounded: observability for tests/operators, not a flight recorder
+        from collections import deque
+
+        self._occupancy: "deque" = deque(maxlen=65536)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="continuous-batcher"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ public API
+
+    def submit(self, **request) -> GenerationStream:
+        """Queue a generation request; returns its token stream. Raises
+        ReplicaDrainingError while draining (nothing ran — retryable)."""
+        from .replica import ReplicaDrainingError
+
+        with self._lock:
+            if self._draining or self._shutdown:
+                raise ReplicaDrainingError()
+            stream = GenerationStream(next(self._ids), request)
+            self._pending.put(stream)
+        return stream
+
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """Stop admissions; bounce queued-but-unadmitted requests for
+        handle-side retry; let running generations finish until
+        `deadline_s` from now, then cut them."""
+        with self._lock:
+            self._draining = True
+            # explicit None check: deadline_s=0 means cut NOW, not never
+            self._drain_deadline = (
+                None if deadline_s is None else time.monotonic() + deadline_s
+            )
+        self._bounce_pending()
+
+    def close(self) -> None:
+        """Terminal stop: bounce queued requests AND cut active streams so
+        no consumer is left blocking on a loop thread that exited."""
+        self._shutdown = True
+        self._bounce_pending()
+        with self._lock:
+            active = list(self._active.values())
+            self._active.clear()
+        for stream in active:
+            stream._finish(cut=True)
+
+    def occupancy_log(self) -> List[Tuple[int, int, Tuple[int, ...]]]:
+        """[(step, n_active, request_ids active that step), ...]"""
+        return list(self._occupancy)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active": len(self._active),
+                "free_slots": len(self._free),
+                "queued": self._pending.qsize(),
+                "steps": self._steps,
+                "draining": self._draining,
+                "max_batch_size": self.max_batch_size,
+            }
+
+    def num_ongoing(self) -> int:
+        with self._lock:
+            return len(self._active) + self._pending.qsize()
+
+    # -------------------------------------------------------------- internals
+
+    def _bounce_pending(self) -> None:
+        from .replica import ReplicaDrainingError
+
+        while True:
+            try:
+                stream = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            stream._finish(error=ReplicaDrainingError())
+
+    def _admit_one(self, stream: GenerationStream) -> None:
+        if stream.cancelled:
+            stream._finish()
+            return
+        with self._lock:
+            slot = self._free.pop()
+            self._active[slot] = stream
+        try:
+            tok, done = self.engine.admit(slot, stream.request)
+        except Exception as e:  # noqa: BLE001 — bad request must not kill the loop
+            stream._finish(error=e)
+            self._retire(slot)
+            return
+        stream._push(tok)
+        if done:
+            stream._finish()
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        with self._lock:
+            self._active.pop(slot, None)
+            self._free.append(slot)
+        release = getattr(self.engine, "release", None)
+        if release is not None:
+            release(slot)
+
+    def _gather(self, first_timeout: float) -> None:
+        """Admit pending requests into free slots: block up to
+        first_timeout for the first one (idle parking / coalescing),
+        then take whatever else is already queued."""
+        block = first_timeout
+        while self._free and not self._shutdown:
+            try:
+                stream = self._pending.get(timeout=block)
+            except queue.Empty:
+                return
+            block = 0.0
+            self._admit_one(stream)
+
+    def _loop(self) -> None:
+        while not self._shutdown:
+            if not self._active:
+                if self._draining:
+                    self._bounce_pending()
+                    if not self._active:
+                        time.sleep(0.01)
+                        continue
+                # idle: park on the queue; once the first request lands,
+                # hold the batch open for the coalescing window so
+                # near-simultaneous requests share the first step
+                self._gather(first_timeout=0.05)
+                if self._active and self.batch_wait_timeout_s > 0:
+                    deadline = time.monotonic() + self.batch_wait_timeout_s
+                    while (len(self._free) > 0
+                           and time.monotonic() < deadline):
+                        self._gather(
+                            first_timeout=max(0.0, deadline - time.monotonic())
+                        )
+                        if not self._free:
+                            break
+                if not self._active:
+                    continue
+            else:
+                # running batch: admit whatever is queued, no waiting
+                self._gather(first_timeout=0.0)
+
+            with self._lock:
+                slots = sorted(self._active)
+                ids = tuple(self._active[s].request_id for s in slots)
+            if not slots:
+                continue
+            try:
+                results = self.engine.step(slots)
+            except Exception as e:  # noqa: BLE001 — engine fault fails the batch
+                for slot in slots:
+                    stream = self._active.get(slot)
+                    if stream is not None:
+                        stream._finish(error=e)
+                    self._retire(slot)
+                continue
+            self._steps += 1
+            self._occupancy.append((self._steps, len(slots), ids))
+            for slot, (tok, done) in results.items():
+                stream = self._active.get(slot)
+                if stream is None:
+                    continue
+                if stream.cancelled:
+                    stream._finish()
+                    self._retire(slot)
+                    continue
+                stream._push(tok)
+                if done:
+                    stream._finish()
+                    self._retire(slot)
+            # drain deadline: cut whatever is still running
+            if (self._draining and self._drain_deadline is not None
+                    and time.monotonic() >= self._drain_deadline):
+                with self._lock:
+                    leftover = dict(self._active)
+                for slot, stream in leftover.items():
+                    stream._finish(cut=True)
+                    self._retire(slot)

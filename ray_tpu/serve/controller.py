@@ -354,7 +354,10 @@ class ServeController:
         refs = []
         for r in replicas:
             try:
-                refs.append(r.prepare_to_drain.remote())
+                # the deadline rides along so replica-side batchers
+                # (@serve.batch queues, ContinuousBatchers) can bounce
+                # queued work for retry and cut running generations in time
+                refs.append(r.prepare_to_drain.remote(drain_s))
             except Exception:
                 pass  # already dead: the drain worker reaps it
         try:

@@ -167,6 +167,10 @@ class DeploymentResponse:
         self._handle = handle
         self._call = call  # (args, kwargs) for replica-death retry
         self.retries = 0   # re-route attempts this response consumed
+        # the replica actor that served this call: streaming results
+        # (ReplicaStreamHandle) must be pulled from the replica that holds
+        # the live stream, not re-routed
+        self.replica = None
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
@@ -226,6 +230,7 @@ class DeploymentResponse:
                     self._handle._refresh(force=True)
                     retry = self._handle.remote(*args, **kwargs)
                     out = ray_tpu.get(retry.ref, timeout=_remaining())
+                    self.replica = retry.replica
                     breaker.record_success()
                     return out
                 except GetTimeoutError:
@@ -250,6 +255,68 @@ class DeploymentResponse:
     @property
     def ref(self):
         return self._ref
+
+    def iter_stream(self, timeout_s: Optional[float] = None,
+                    pull_max_chunks: Optional[int] = None,
+                    pull_wait_s: Optional[float] = None):
+        """Iterate a streaming result without going through HTTP: resolves
+        the call to its ReplicaStreamHandle, then pulls chunks from the
+        serving replica as they are produced. Raises TypeError if the
+        deployment returned a non-streaming result."""
+        import ray_tpu
+
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        from .replica import ReplicaStreamHandle
+
+        sh = self.result(timeout_s=timeout_s)
+        if not isinstance(sh, ReplicaStreamHandle):
+            raise TypeError(
+                f"deployment returned {type(sh).__name__}, not a stream — "
+                "iter_stream needs a non-buffered StreamingResponse"
+            )
+        n = int(cfg.serve_stream_pull_max_chunks
+                if pull_max_chunks is None else pull_max_chunks)
+        wait = float(cfg.serve_stream_pull_wait_s
+                     if pull_wait_s is None else pull_wait_s)
+        done = False
+        try:
+            # timeout_s bounds PROGRESS, not just one pull: a request
+            # parked behind a full batch yields empty pulls forever — the
+            # idle deadline turns that into GetTimeoutError like any other
+            # stalled call
+            idle_deadline = (
+                None if timeout_s is None
+                else time.monotonic() + float(timeout_s)
+            )
+            while True:
+                chunks, done = ray_tpu.get(
+                    self.replica.stream_next.remote(sh.stream_id, n, wait),
+                    timeout=timeout_s,
+                )
+                yield from chunks
+                if done:
+                    return
+                if chunks:
+                    idle_deadline = (
+                        None if timeout_s is None
+                        else time.monotonic() + float(timeout_s)
+                    )
+                elif (idle_deadline is not None
+                      and time.monotonic() >= idle_deadline):
+                    from ray_tpu.exceptions import GetTimeoutError
+
+                    raise GetTimeoutError(
+                        f"stream produced nothing for {timeout_s}s"
+                    )
+        finally:
+            if not done:
+                # consumer stopped early (break / GC): free the replica's
+                # decode slot instead of generating into the void
+                try:
+                    self.replica.stream_cancel.remote(sh.stream_id)
+                except Exception:
+                    pass
 
 
 class DeploymentHandle:
@@ -432,4 +499,6 @@ class DeploymentHandle:
             )
         self._counts[idx] = self._counts.get(idx, 0) + 1
         self._inflight.append((idx, ref))
-        return DeploymentResponse(ref, handle=self, call=(args, kwargs))
+        resp = DeploymentResponse(ref, handle=self, call=(args, kwargs))
+        resp.replica = self._replicas[idx]
+        return resp

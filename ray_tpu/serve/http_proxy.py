@@ -80,15 +80,71 @@ class Request:
 class StreamingResponse:
     """Chunked-transfer response: iterable of str/bytes chunks.
 
-    The iterable is materialized at construction (generators included) so
-    the response pickles across the replica->proxy actor boundary — actor
-    results are single messages; the streaming happens proxy->client."""
+    buffered=True (default): the iterable is materialized at construction
+    (generators included) so the response pickles across the replica->proxy
+    actor boundary — actor results are single messages; the streaming
+    happens proxy->client.
+
+    buffered=False: the chunks are still being PRODUCED (e.g. a
+    ContinuousBatcher generation). The replica registers the live stream
+    and hands the proxy a ReplicaStreamHandle; the proxy pulls chunks with
+    stream_next() and forwards each to the client as it arrives — true
+    incremental delivery, one chunked frame per chunk."""
 
     chunks: Iterable[Any]
     content_type: str = "text/plain; charset=utf-8"
+    buffered: bool = True
 
     def __post_init__(self):
-        self.chunks = list(self.chunks)
+        if self.buffered:
+            self.chunks = list(self.chunks)
+
+
+class _SSEStream:
+    """Format a pull-style token stream (GenerationStream) as server-sent
+    events while PRESERVING its long-poll next_batch surface, so replica
+    stream_next pulls stay batched and timeout-bounded. The terminal event
+    is `data: [DONE]` — preceded by `event: cut` when the generation was
+    truncated at a drain deadline."""
+
+    def __init__(self, inner, encode=str):
+        self._inner = inner
+        self._encode = encode
+
+    def next_batch(self, max_items: int, wait_s: float):
+        items, done = self._inner.next_batch(max_items, wait_s)
+        out = [f"data: {self._encode(i)}\n\n" for i in items]
+        if done:
+            if getattr(self._inner, "cut", False):
+                out.append("event: cut\ndata: [DONE]\n\n")
+            else:
+                out.append("data: [DONE]\n\n")
+        return out, done
+
+    def cancel(self):
+        cancel = getattr(self._inner, "cancel", None)
+        if cancel is not None:
+            cancel()
+
+
+def sse_stream(stream, encode=str) -> StreamingResponse:
+    """Wrap a token stream as a non-buffered text/event-stream response:
+    every token becomes its own SSE `data:` event delivered per-token over
+    chunked transfer. `stream` is ideally pull-style (has next_batch, e.g.
+    ContinuousBatcher.submit()'s GenerationStream); plain iterables work
+    but pull one chunk per stream_next round-trip."""
+    if hasattr(stream, "next_batch"):
+        chunks: Any = _SSEStream(stream, encode)
+    else:
+        def _gen():
+            for item in stream:
+                yield f"data: {encode(item)}\n\n"
+            yield "data: [DONE]\n\n"
+
+        chunks = _gen()
+    return StreamingResponse(
+        chunks, content_type="text/event-stream", buffered=False
+    )
 
 
 @dataclass
@@ -398,10 +454,11 @@ class HTTPProxyActor:
         await writer.drain()
 
     def _call_route(self, route: _Route, args: tuple):
-        """Blocking replica call; runs on the bounded pool."""
-        return route.handle.remote(*args).result(
-            timeout_s=self.request_timeout_s
-        )
+        """Blocking replica call; runs on the bounded pool. Returns the
+        DeploymentResponse too: a streaming result must be pulled from the
+        exact replica that holds the live stream (replica affinity)."""
+        resp = route.handle.remote(*args)
+        return resp, resp.result(timeout_s=self.request_timeout_s)
 
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], raw: bytes):
@@ -460,7 +517,7 @@ class HTTPProxyActor:
             # shield: on timeout we abandon the wait, NOT the thread —
             # wait_for must not try to cancel (and then wait out) a
             # running executor future
-            result = await asyncio.wait_for(
+            dresp, result = await asyncio.wait_for(
                 asyncio.shield(fut), timeout=self.request_timeout_s + 5.0
             )
         except asyncio.TimeoutError:
@@ -489,6 +546,14 @@ class HTTPProxyActor:
             await self._reply(writer, 500, "application/json",
                               json.dumps({"error": repr(e)}).encode())
             return
+        from .replica import ReplicaStreamHandle
+
+        if isinstance(result, ReplicaStreamHandle):
+            await self._stream_replica_pull(writer, route, args, dresp, result)
+            return
+        await self._write_result(writer, result)
+
+    async def _write_result(self, writer, result):
         status = 200
         bare = isinstance(result, Response)  # Response bodies serialize bare
         ctype_override = None
@@ -526,6 +591,181 @@ class HTTPProxyActor:
                               json.dumps({"error": repr(e)}).encode())
             return
         await self._reply(writer, status, "application/json", payload)
+
+    # ------------------------------------------------------ live streaming
+
+    def _stream_cancel(self, replica, stream_id: int) -> None:
+        """Fire-and-forget: tell the replica its consumer went away so the
+        batcher can retire the slot instead of generating into the void."""
+        try:
+            replica.stream_cancel.remote(stream_id)
+        except Exception:
+            pass
+
+    async def _stream_replica_pull(self, writer, route: _Route, args: tuple,
+                                   dresp, sh) -> None:
+        """Forward a live replica stream: pull chunk batches with
+        stream_next (long-poll on the replica) and write each chunk as its
+        own chunked frame with backpressure.
+
+        The response head is written only after the FIRST successful pull:
+        a generation that was never admitted (its submit raced a drain —
+        stream_next raises ReplicaDrainingError) is re-dispatched ONCE
+        against the refreshed replica set, or answered 503 — never a dead
+        200. Once streaming has started, errors can only end the
+        connection (chunked truncation); the replica-side drain cut keeps
+        that path bounded."""
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+            GetTimeoutError,
+            WorkerCrashedError,
+        )
+
+        from .handle import DeploymentUnavailableError
+        from .replica import ReplicaDrainingError, ReplicaStreamHandle
+
+        max_chunks = int(cfg.serve_stream_pull_max_chunks)
+        pull_wait = float(cfg.serve_stream_pull_wait_s)
+        replica = getattr(dresp, "replica", None)
+        head_written = False
+        retried = False
+        idle_deadline = self._loop.time() + self.request_timeout_s
+
+        def _pull(rep, sid):
+            import ray_tpu
+
+            return ray_tpu.get(
+                rep.stream_next.remote(sid, max_chunks, pull_wait),
+                timeout=self.request_timeout_s,
+            )
+
+        def _pull_done(f):
+            self._ncalls -= 1
+            if not f.cancelled():
+                f.exception()
+
+        while True:
+            if replica is None:
+                if not head_written:
+                    await self._reply(
+                        writer, 500, "application/json",
+                        b'{"error": "stream lost its serving replica"}')
+                return
+            self._ncalls += 1
+            fut = self._loop.run_in_executor(
+                self._pool, _pull, replica, sh.stream_id
+            )
+            fut.add_done_callback(_pull_done)
+            try:
+                chunks, done = await asyncio.wait_for(
+                    asyncio.shield(fut), timeout=self.request_timeout_s + 5.0
+                )
+            except (asyncio.TimeoutError, GetTimeoutError):
+                # GetTimeoutError is the common spelling (the blocking
+                # ray_tpu.get inside _pull times out first); the asyncio
+                # guard only fires if the pool thread itself wedges
+                if not head_written:
+                    await self._reply(writer, 504, "application/json",
+                                      b'{"error": "stream pull timed out"}')
+                self._stream_cancel(replica, sh.stream_id)
+                writer.close()
+                return
+            except (ReplicaDrainingError, ActorDiedError,
+                    ActorUnavailableError, WorkerCrashedError) as e:
+                # the generation was never admitted (drain raced the call)
+                # or the replica died before the first token
+                if head_written:
+                    writer.close()  # mid-stream: truncate, client retries
+                    return
+                if retried:
+                    await self._reply(
+                        writer, 503, "application/json",
+                        json.dumps({"error": str(e)}).encode(),
+                        extra_headers=self._retry_after())
+                    return
+                retried = True
+                try:
+                    # same occupancy accounting as every other pool
+                    # submission: the retry call can block a pool thread
+                    # for up to request_timeout_s and must be visible to
+                    # the saturation gate
+                    self._ncalls += 1
+                    refut = self._loop.run_in_executor(
+                        self._pool, self._call_route, route, args
+                    )
+                    refut.add_done_callback(_pull_done)
+                    dresp, result = await asyncio.wait_for(
+                        asyncio.shield(refut),
+                        timeout=self.request_timeout_s + 5.0,
+                    )
+                except asyncio.TimeoutError:
+                    await self._reply(writer, 504, "application/json",
+                                      b'{"error": "request timed out"}')
+                    return
+                except (DeploymentUnavailableError, ReplicaDrainingError) as e2:
+                    await self._reply(
+                        writer, 503, "application/json",
+                        json.dumps({"error": str(e2)}).encode(),
+                        extra_headers=self._retry_after(
+                            getattr(e2, "retry_after_s", None)))
+                    return
+                except Exception as e2:  # noqa: BLE001
+                    await self._reply(writer, 500, "application/json",
+                                      json.dumps({"error": repr(e2)}).encode())
+                    return
+                if not isinstance(result, ReplicaStreamHandle):
+                    await self._write_result(writer, result)
+                    return
+                replica = getattr(dresp, "replica", None)
+                sh = result
+                idle_deadline = self._loop.time() + self.request_timeout_s
+                continue
+            except Exception as e:  # noqa: BLE001 — producer raised
+                if not head_written:
+                    await self._reply(writer, 500, "application/json",
+                                      json.dumps({"error": repr(e)}).encode())
+                else:
+                    writer.close()
+                return
+            try:
+                if not head_written:
+                    writer.write(
+                        f"HTTP/1.1 200 OK\r\nContent-Type: {sh.content_type}"
+                        "\r\nTransfer-Encoding: chunked\r\n\r\n".encode("latin1")
+                    )
+                    head_written = True
+                for chunk in chunks:
+                    data = (chunk.encode() if isinstance(chunk, str)
+                            else bytes(chunk))
+                    if not data:
+                        continue
+                    writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                # backpressure: a slow client parks THIS coroutine only
+                await writer.drain()
+                if done:
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                    return
+            except (ConnectionError, asyncio.CancelledError):
+                self._stream_cancel(replica, sh.stream_id)
+                raise
+            now = self._loop.time()
+            if chunks:
+                idle_deadline = now + self.request_timeout_s
+            elif now >= idle_deadline:
+                self._stream_cancel(replica, sh.stream_id)
+                if not head_written:
+                    # nothing sent yet (e.g. parked behind a full batch
+                    # past the deadline): a proper 504, not a dead socket
+                    await self._reply(writer, 504, "application/json",
+                                      b'{"error": "stream timed out"}')
+                    return
+                # mid-stream there is no status code left — cut the
+                # connection (chunked truncation tells the client)
+                writer.close()
+                return
 
     # ---------------------------------------------------------- actor API
 

@@ -6,15 +6,25 @@ graceful-drain protocol (reference: replica.py perform_graceful_shutdown —
 a replica slated for removal stops ACCEPTING requests but finishes the ones
 already in flight; the controller only reaps it once it reports idle or the
 drain deadline passes).
+
+Token streaming: a handler that returns a NON-buffered StreamingResponse
+(chunks still being produced — e.g. a ContinuousBatcher generation) cannot
+ship the chunks in the actor result (results are single pickled messages).
+Instead the replica registers the live stream and returns a
+ReplicaStreamHandle; the proxy (or a handle caller via
+DeploymentResponse.iter_stream) pulls chunks with stream_next() as they are
+produced. Open streams count as ongoing work for drain/autoscaling.
 """
 
 from __future__ import annotations
 
 import inspect
+import itertools
 import os
 import threading
 import time
-from typing import Any, Dict
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class ReplicaDrainingError(RuntimeError):
@@ -32,6 +42,36 @@ class ReplicaDrainingError(RuntimeError):
         self.deployment_name = deployment_name
 
 
+@dataclass
+class ReplicaStreamHandle:
+    """Marker a replica returns in place of a live (non-buffered) stream:
+    the consumer pulls the chunks from the SAME replica via stream_next."""
+
+    stream_id: int
+    content_type: str = "text/plain; charset=utf-8"
+
+
+class _IterStream:
+    """Adapter giving plain iterables the GenerationStream pull surface.
+    next() can block arbitrarily (generators have no timeout), so generic
+    lazy streams pull ONE chunk per call — queue-backed GenerationStreams
+    use their native batched long-poll instead."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+
+    def next_batch(self, max_items: int, wait_s: float):
+        try:
+            return [next(self._it)], False
+        except StopIteration:
+            return [], True
+
+    def cancel(self):
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
 class Replica:
     def __init__(self, deployment_name: str, func_or_class, init_args, init_kwargs):
         self.deployment_name = deployment_name
@@ -39,6 +79,11 @@ class Replica:
         self._total = 0
         self._draining = False
         self._lock = threading.Lock()
+        self._streams: Dict[int, Any] = {}
+        self._stream_ids = itertools.count(1)
+        # sid -> why it was closed early (reaped/cancelled): a later pull
+        # must surface the truncation, not fake a clean completion
+        self._closed_early: Dict[int, str] = {}
         if inspect.isclass(func_or_class):
             self.callable = func_or_class(*init_args, **init_kwargs)
             self.is_function = False
@@ -64,13 +109,11 @@ class Replica:
 
             _set_model_id(model_id)
         try:
-            if self.is_function:
-                return self.callable(*args, **kwargs)
-            if method_name == "__call__":
-                fn = self.callable
+            if self.is_function or method_name == "__call__":
+                result = self.callable(*args, **kwargs)
             else:
-                fn = getattr(self.callable, method_name)
-            return fn(*args, **kwargs)
+                result = getattr(self.callable, method_name)(*args, **kwargs)
+            return self._maybe_register_stream(result)
         finally:
             if model_id:
                 from .multiplex import _set_model_id
@@ -79,22 +122,138 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    # ------------------------------------------------------------- streaming
+
+    def _maybe_register_stream(self, result):
+        from .http_proxy import StreamingResponse
+
+        if not (isinstance(result, StreamingResponse) and not result.buffered):
+            return result
+        chunks = result.chunks
+        if not hasattr(chunks, "next_batch"):
+            chunks = _IterStream(chunks)
+        self._reap_idle_streams()
+        with self._lock:
+            sid = next(self._stream_ids)
+            self._streams[sid] = [chunks, time.monotonic()]
+        return ReplicaStreamHandle(sid, result.content_type)
+
+    def _reap_idle_streams(self) -> None:
+        """Drop streams nobody has pulled for serve_stream_idle_reap_s: an
+        abandoned consumer (handle caller that never iterated, proxy that
+        errored without cancelling) must not count as ongoing work forever.
+        Runs on every registry touch — including num_ongoing/stats, which
+        the drain loop and autoscaler poll."""
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        ttl = float(cfg.serve_stream_idle_reap_s)
+        now = time.monotonic()
+        with self._lock:
+            dead = [sid for sid, (_, ts) in self._streams.items()
+                    if now - ts > ttl]
+            victims = [(sid, self._streams.pop(sid)[0]) for sid in dead]
+            for sid in dead:
+                self._mark_closed_early(sid, "idle-reaped")
+        for _, stream in victims:
+            cancel = getattr(stream, "cancel", None)
+            if cancel is not None:
+                try:
+                    cancel()
+                except Exception:
+                    pass
+
+    def stream_next(self, stream_id: int, max_items: int = 64,
+                    wait_s: float = 0.25) -> Tuple[List[Any], bool]:
+        """Long-poll pull: up to max_items chunks from a registered stream,
+        waiting up to wait_s for the first. Returns (chunks, done); the
+        stream unregisters itself on done. Unknown ids are already-finished
+        streams: ([], True)."""
+        self._reap_idle_streams()
+        with self._lock:
+            entry = self._streams.get(stream_id)
+            if entry is not None:
+                entry[1] = time.monotonic()
+            reason = self._closed_early.get(stream_id)
+        if entry is None:
+            if reason is not None:
+                # a truncated stream must never read as a clean completion
+                raise RuntimeError(
+                    f"stream {stream_id} was {reason} before its consumer "
+                    "finished pulling"
+                )
+            return [], True
+        stream = entry[0]
+        try:
+            items, done = stream.next_batch(max_items, wait_s)
+        except Exception:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+            raise
+        if done:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+        else:
+            with self._lock:
+                if stream_id in self._streams:
+                    self._streams[stream_id][1] = time.monotonic()
+        return items, done
+
+    def _mark_closed_early(self, sid: int, reason: str) -> None:
+        """Record why a stream went away (bounded; caller holds the lock)."""
+        self._closed_early[sid] = reason
+        while len(self._closed_early) > 512:
+            self._closed_early.pop(next(iter(self._closed_early)))
+
+    def stream_cancel(self, stream_id: int) -> bool:
+        """Consumer disconnected: drop the stream and tell its producer."""
+        with self._lock:
+            entry = self._streams.pop(stream_id, None)
+            if entry is not None:
+                self._mark_closed_early(stream_id, "cancelled")
+        if entry is None:
+            return False
+        stream = entry[0]
+        cancel = getattr(stream, "cancel", None)
+        if cancel is not None:
+            try:
+                cancel()
+            except Exception:
+                pass
+        return True
+
     # ------------------------------------------------------------- draining
 
-    def prepare_to_drain(self) -> int:
+    def prepare_to_drain(self, deadline_s: Optional[float] = None) -> int:
         """Stop accepting new requests; returns the in-flight count at the
-        moment the gate closed (controller sequencing: drain -> reap)."""
+        moment the gate closed (controller sequencing: drain -> reap).
+
+        deadline_s (the deployment's graceful_shutdown_timeout_s) is
+        propagated to any drainable batchers hanging off the user callable
+        (@serve.batch queues, ContinuousBatchers): they bounce queued-but-
+        unadmitted work for handle-side retry and cut still-running
+        generations at the deadline."""
         with self._lock:
             self._draining = True
-            return self._ongoing
+            ongoing = self._ongoing + len(self._streams)
+        attrs = getattr(self.callable, "__dict__", None) or {}
+        for v in list(attrs.values()):
+            if getattr(v, "_serve_drainable", False):
+                try:
+                    v.drain(deadline_s)
+                except Exception:
+                    pass
+        return ongoing
 
     def num_ongoing(self) -> int:
+        self._reap_idle_streams()
         with self._lock:
-            return self._ongoing
+            return self._ongoing + len(self._streams)
 
     def stats(self) -> Dict[str, Any]:
+        self._reap_idle_streams()
         return {
-            "ongoing": self._ongoing,
+            "ongoing": self._ongoing + len(self._streams),
+            "streams": len(self._streams),
             "total": self._total,
             "draining": self._draining,
             "ts": time.time(),

@@ -163,7 +163,11 @@ def test_send_recv(group):
 def test_in_graph_collectives():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax: only the experimental spelling
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from ray_tpu.util.collective import in_graph as cg
