@@ -671,12 +671,67 @@ def main_rl():
 # --------------------------------------------------------------------------
 
 
-def _run_child(cmd, child_env, timeout):
+def _install_stack_dumper():
+    """Child-side half of the hang watchdog: register a faulthandler that
+    dumps EVERY thread's stack to $RAY_TPU_BENCH_STACKDUMP on SIGUSR2. The
+    supervisor fires the signal right before group-killing a hung phase, so
+    the dump lands in the phase row and a TPU hang (VERDICT weak #1a) shows
+    WHERE the child was wedged — inside a collective, the PJRT plugin's
+    import, the feed pipeline — instead of evaporating with the process."""
+    path = os.environ.get("RAY_TPU_BENCH_STACKDUMP")
+    if not path:
+        return
+    import faulthandler
+    import signal
+
+    try:
+        f = open(path, "w")
+        faulthandler.register(signal.SIGUSR2, file=f, all_threads=True)
+    except Exception as e:  # never let observability break the phase
+        print(f"[bench] stack dumper not installed: {e}", file=sys.stderr)
+
+
+def _collect_stack_dump(pid, dump_path, wait_s=3.0):
+    """Supervisor-side half: SIGUSR2 the hung child and wait for its
+    faulthandler to finish writing dump_path (the caller reads the file).
+    A child that never installed the handler dies to SIGUSR2's default
+    disposition — detected via signal-0 probe so the wait ends early
+    instead of burning the full wait_s (the group SIGKILL was coming
+    anyway)."""
+    import signal
+
+    try:
+        os.kill(pid, signal.SIGUSR2)
+    except OSError:
+        return
+    deadline = time.monotonic() + wait_s
+    last = -1
+    while time.monotonic() < deadline:
+        try:
+            size = os.path.getsize(dump_path)
+        except OSError:
+            size = 0
+        if size > 0 and size == last:
+            return  # dump finished growing
+        last = size
+        if size == 0:
+            try:
+                os.kill(pid, 0)  # still alive?
+            except OSError:
+                return  # died without a handler: no dump is coming
+        time.sleep(0.15)
+
+
+def _run_child(cmd, child_env, timeout, stack_dump_path=None):
     """Returns (rc|None, stdout, stderr); rc None = hung/timed out.
 
     Own session + group-kill on timeout: a wedged child may have forked
     helpers (tunnel processes) that inherit the pipes — killing only the
-    child would leave communicate() blocked short of EOF forever."""
+    child would leave communicate() blocked short of EOF forever.
+
+    stack_dump_path: when set, a timed-out child gets SIGUSR2 first so its
+    faulthandler (see _install_stack_dumper) can write thread stacks there
+    before the SIGKILL lands; the caller reads the file afterwards."""
     import signal
     import subprocess
 
@@ -688,6 +743,8 @@ def _run_child(cmd, child_env, timeout):
         out, err = p.communicate(timeout=timeout)
         return p.returncode, out or "", err or ""
     except subprocess.TimeoutExpired:
+        if stack_dump_path:
+            _collect_stack_dump(p.pid, stack_dump_path)
         try:
             os.killpg(p.pid, signal.SIGKILL)
         except OSError:
@@ -718,7 +775,8 @@ def _budget_left(deadline):
 def _emit_row(results_path: str, mode: str, row: dict) -> None:
     """Append one completed phase row to the results file IMMEDIATELY
     (VERDICT weak #1b: a later hung phase must degrade to partial results,
-    never lose finished work)."""
+    never lose finished work). __graft_entry__._emit_result_row mirrors
+    this jsonl contract for the MULTICHIP two_slice row — keep in lockstep."""
     if not results_path:
         return
     try:
@@ -751,9 +809,32 @@ def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool,
                   f"({left:.0f}s left); skipping", file=sys.stderr)
             return None
         child_timeout = timeout if left is None else min(timeout, left)
+        # hang watchdog: the child registers a SIGUSR2 faulthandler on this
+        # path; a timed-out child dumps its thread stacks here before dying
+        import tempfile
+
+        fd, dump_path = tempfile.mkstemp(prefix=f"bench_{mode}_stacks_")
+        os.close(fd)
+        env["RAY_TPU_BENCH_STACKDUMP"] = dump_path
         t0 = time.perf_counter()
-        rc, out, err = _run_child([sys.executable, me], env, child_timeout)
-        dt = time.perf_counter() - t0
+        try:
+            rc, out, err = _run_child(
+                [sys.executable, me], env, child_timeout,
+                stack_dump_path=dump_path,
+            )
+            dt = time.perf_counter() - t0
+            stacks = ""
+            if rc is None:
+                try:
+                    with open(dump_path) as f:
+                        stacks = f.read()
+                except OSError:
+                    pass
+        finally:
+            try:
+                os.unlink(dump_path)
+            except OSError:
+                pass
         row = _last_json(out)
         if rc == 0 and row is not None:
             sys.stderr.write(err)
@@ -763,6 +844,18 @@ def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool,
         tail = "\n".join(err.strip().splitlines()[-6:])
         print(f"[bench] {mode} attempt {i + 1}/{attempts} failed ({why}, "
               f"{dt:.0f}s){': ' + tail if tail else ''}", file=sys.stderr)
+        if stacks:
+            # the whole point of the watchdog: the hang site rides the
+            # incremental results file as a phase row, so a wedged trainer
+            # phase can finally be root-caused from the round artifacts
+            print(f"[bench] {mode} hung-child thread stacks:\n{stacks}",
+                  file=sys.stderr)
+            _emit_row(results_path, mode, {
+                "hung": True,
+                "attempt": i + 1,
+                "timeout_s": child_timeout,
+                "stack_dump": stacks,
+            })
         if i < attempts - 1:
             pause = backoffs[min(i, len(backoffs) - 1)]
             left = _budget_left(deadline)
@@ -775,6 +868,7 @@ def _phase(mode: str, timeout: float, attempts: int, cpu_fallback: bool,
     print(f"[bench] {mode}: TPU attempts exhausted; CPU fallback", file=sys.stderr)
     from ray_tpu._private.spawn import child_pythonpath
 
+    env.pop("RAY_TPU_BENCH_STACKDUMP", None)  # per-attempt path was deleted
     env["JAX_PLATFORMS"] = "cpu"  # -S skips the blocking site hook
     env["PYTHONPATH"] = child_pythonpath(inherited=env.get("PYTHONPATH"))
     rc, out, err = _run_child(
@@ -900,6 +994,8 @@ def _supervise() -> int:
 
 if __name__ == "__main__":
     mode = os.environ.get("RAY_TPU_BENCH_CHILD")
+    if mode:
+        _install_stack_dumper()
     if mode == "raw" or mode == "1":  # "1" = old envvar spelling
         main_raw()
     elif mode == "trainer":

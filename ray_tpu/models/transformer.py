@@ -493,12 +493,19 @@ def make_forward(
                 ys, _ = lax.scan(step, xs, stage_layers)
                 return ys
 
+            # stage placement comes from the rule table: "stage" -> "pp"
+            # (flat ICI pipeline) or ("dcn", "pp") (multislice pp-outer:
+            # stage-groups mapped one per slice, boundary hops over DCN)
+            stage_axes = rules.mesh_axes("stage") if rules is not None else None
+            batch_axes = rules.mesh_axes("batch") if rules is not None else None
             return pipeline_apply(
                 stage_fn,
                 params["layers"],
                 x,
                 mesh=mesh,
                 n_microbatches=cfg.pp_microbatches,
+                axis_name=stage_axes or "pp",
+                batch_axes=batch_axes if batch_axes is not None else ("dp", "fsdp"),
             )
         if not cfg.scan_layers:
             for i in range(cfg.n_layers):

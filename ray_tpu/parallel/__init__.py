@@ -14,4 +14,16 @@ from .sharding import (  # noqa: F401
     logical_spec,
     logical_sharding,
     constrain,
+    make_rules,
+)
+from .multislice import (  # noqa: F401
+    DCN_AXIS,
+    ICI_ONLY_AXES,
+    MULTISLICE_PRESETS,
+    SliceTopology,
+    build_multislice_mesh,
+    dp_outer,
+    group_devices_by_slice,
+    multislice_rules,
+    pp_outer,
 )

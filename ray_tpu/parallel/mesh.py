@@ -58,9 +58,26 @@ class MeshSpec:
                 if v != -1:
                     known *= v
             if n_devices % known:
-                raise ValueError(f"{n_devices} devices not divisible by {known}")
+                fixed = {a: v for a, v in d.items() if v not in (-1, 1)}
+                raise ValueError(
+                    f"cannot infer mesh axis {wild[0]!r}: the fixed axes "
+                    f"{fixed} use {known} devices, which does not divide "
+                    f"the {n_devices} available"
+                )
             d[wild[0]] = n_devices // known
         if math.prod(d.values()) != n_devices:
+            # name the first axis that fails to divide what remains, so the
+            # user sees WHICH degree is wrong instead of an opaque
+            # reshape/product error downstream
+            rem = n_devices
+            for a, v in d.items():
+                if v > 1 and (rem % v or v > rem):
+                    raise ValueError(
+                        f"mesh axis {a!r}={v} does not divide the remaining "
+                        f"{rem} of {n_devices} devices (requested degrees "
+                        f"{ {k: x for k, x in d.items() if x > 1} })"
+                    )
+                rem //= max(v, 1)
             raise ValueError(
                 f"Mesh degrees {d} use {math.prod(d.values())} devices, have {n_devices}"
             )

@@ -1,0 +1,252 @@
+"""Multi-slice DCN meshes: slice-aware topology + cross-slice presets.
+
+Real TPU fleets are not one flat ICI torus: they are multiple slices joined
+by data-center network (DCN), and the slice boundary is orders of magnitude
+slower than ICI (SURVEY §7 M5).  This module makes that boundary a
+first-class mesh axis:
+
+    mesh axes = ("dcn",) + AXIS_ORDER      # dcn outermost, slice-major
+
+`SliceTopology` extends `MeshSpec` with the outer `dcn` axis (slice count x
+per-slice ICI shape) and validates that the bandwidth-hungry axes — tp, sp,
+ep, whose collectives are per-layer all-reduce/ppermute/all-to-all traffic —
+stay INSIDE a slice.  Two presets cover the cross-slice parallelisms that
+tolerate DCN latency:
+
+  dp-outer  batch sharded over ("dcn", "dp", "fsdp"): the only DCN traffic
+            is the gradient all-reduce, once per step (the multi-slice v5e
+            fine-tuning configuration, arXiv:2605.25645).
+  pp-outer  pipeline stages mapped one stage-group per slice ("stage" ->
+            ("dcn", "pp")): ppermute activation traffic crosses DCN exactly
+            at stage boundaries, everything else stays on ICI (MPMD
+            pipeline over slow inter-group links, arXiv:2412.14374).
+
+The split is observable: `ray_tpu.util.collective.collective_byte_report`
+classifies every collective in a compiled step as ICI or DCN by its replica
+groups, so tests (and the MULTICHIP two_slice harness row) can PROVE tp/sp/
+ep bytes never cross a slice boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .mesh import AXIS_ORDER, MeshSpec
+from .sharding import ShardingRules, make_rules
+
+# the canonical slow-axis name; everything downstream (byte counters,
+# sharding rules, pipeline placement) keys off this string
+DCN_AXIS = "dcn"
+
+# mesh axes whose collectives are per-layer bandwidth (Megatron all-reduce,
+# ring ppermute, MoE all-to-all): they must never span a slice boundary
+ICI_ONLY_AXES: Tuple[str, ...] = ("tp", "sp", "ep")
+
+# logical axes that map to ICI-only mesh axes in every sane rule table
+_ICI_ONLY_LOGICAL = (
+    "heads", "kv_heads", "mlp", "vocab",   # tp family
+    "seq", "kv_seq",                        # sp family
+    "expert",                               # ep family
+)
+
+MULTISLICE_AXIS_ORDER: Tuple[str, ...] = (DCN_AXIS,) + AXIS_ORDER
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """num_slices x per-slice ICI mesh. `slice_spec` axes all live inside
+    one slice; the dcn axis is implicit (size = num_slices, outermost)."""
+
+    num_slices: int
+    slice_spec: MeshSpec = MeshSpec()
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {self.num_slices}")
+
+    def axis_order(self) -> Tuple[str, ...]:
+        return MULTISLICE_AXIS_ORDER
+
+    def total(self) -> int:
+        if any(v == -1 for v in self.slice_spec.degrees().values()):
+            raise ValueError(
+                "slice_spec contains a -1 wildcard; call resolve(n_devices) "
+                "before total()/device_slice_ids()"
+            )
+        return self.num_slices * self.slice_spec.total()
+
+    def resolve(self, n_devices: int) -> "SliceTopology":
+        """Fix -1 axes against the PER-SLICE device count and validate that
+        every ICI-hungry axis fits inside one slice, raising errors that
+        name the offending axis (not an opaque reshape failure)."""
+        if n_devices % self.num_slices:
+            raise ValueError(
+                f"{n_devices} devices do not split into {self.num_slices} "
+                f"equal slices"
+            )
+        per_slice = n_devices // self.num_slices
+        for ax in ICI_ONLY_AXES:
+            deg = getattr(self.slice_spec, ax)
+            if deg > per_slice:
+                raise ValueError(
+                    f"mesh axis {ax!r}={deg} does not fit inside one slice "
+                    f"of {per_slice} devices ({self.num_slices} slices x "
+                    f"{per_slice}); bandwidth-hungry axes "
+                    f"{ICI_ONLY_AXES} must never cross the DCN slice "
+                    f"boundary — shrink {ax!r} or use fewer slices"
+                )
+        try:
+            spec = self.slice_spec.resolve(per_slice)
+        except ValueError as e:
+            raise ValueError(
+                f"per-slice mesh spec does not fit one slice of "
+                f"{per_slice} devices ({self.num_slices} slices over "
+                f"{n_devices}): {e}"
+            ) from None
+        return SliceTopology(self.num_slices, spec)
+
+    def device_slice_ids(self, n_devices: Optional[int] = None) -> np.ndarray:
+        """slice id of each FLAT mesh-device index (dcn-major layout)."""
+        total = n_devices if n_devices is not None else self.total()
+        per_slice = total // self.num_slices
+        return np.arange(total) // per_slice
+
+
+def check_rules(rules: ShardingRules, dcn_axis: str = DCN_AXIS) -> None:
+    """Reject rule tables that route ICI-only logical axes over DCN."""
+    for logical in _ICI_ONLY_LOGICAL:
+        mapped = rules.mesh_axes(logical)
+        axes = mapped if isinstance(mapped, tuple) else (mapped,)
+        if dcn_axis in axes:
+            raise ValueError(
+                f"logical axis {logical!r} is mapped to {mapped!r}: "
+                f"tensor/sequence/expert-parallel traffic is per-layer "
+                f"bandwidth and must never cross the {dcn_axis!r} slice "
+                "boundary"
+            )
+
+
+def group_devices_by_slice(devices: Sequence, num_slices: int) -> List[list]:
+    """Partition devices into per-slice blocks.
+
+    Real multi-slice TPUs expose `device.slice_index`; when present and
+    consistent it is authoritative.  Otherwise (CPU virtual meshes,
+    single-slice TPUs carved logically) devices are grouped contiguously in
+    (process_index, id) order — the gang's host topology: hosts of one
+    slice hold consecutive ranks."""
+    devices = list(devices)
+    if num_slices == 1:
+        return [devices]
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {num_slices} slices"
+        )
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) == num_slices:
+        blocks = {s: [] for s in sorted(slice_ids)}
+        for d in devices:
+            blocks[d.slice_index].append(d)
+        sizes = {len(b) for b in blocks.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"uneven slices: per-slice device counts "
+                f"{ {s: len(b) for s, b in blocks.items()} }"
+            )
+        return [blocks[s] for s in sorted(blocks)]
+    per = len(devices) // num_slices
+    devices = sorted(
+        devices, key=lambda d: (getattr(d, "process_index", 0), d.id)
+    )
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def build_multislice_mesh(topology: SliceTopology, devices: Optional[Sequence] = None):
+    """Build the two-level Mesh: axes ("dcn",) + AXIS_ORDER, device array
+    stacked slice-major so flat index // devices_per_slice == slice id (the
+    invariant the collective byte counters classify against).  Within each
+    slice the usual topology-aware assignment applies."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    topo = topology.resolve(len(devices))
+    inner_shape = tuple(topo.slice_spec.degrees()[a] for a in AXIS_ORDER)
+    blocks = []
+    for block in group_devices_by_slice(devices, topo.num_slices):
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(inner_shape, devices=block)
+        except Exception:
+            arr = np.array(block).reshape(inner_shape)
+        blocks.append(arr)
+    return Mesh(np.stack(blocks), topo.axis_order())
+
+
+# --- presets ---------------------------------------------------------------
+
+MULTISLICE_PRESETS = ("dp_outer", "pp_outer")
+
+
+def multislice_rules(preset: str, **make_rules_kwargs) -> ShardingRules:
+    """Slice-aware rule tables.
+
+    dp_outer: batch additionally sharded over dcn — DCN carries ONLY the
+              once-per-step gradient all-reduce.
+    pp_outer: pipeline stage dim sharded over ("dcn", "pp") — DCN carries
+              ONLY the stage-boundary activation ppermutes.
+    """
+    if preset == "dp_outer":
+        rules = make_rules(dcn="dp", **make_rules_kwargs)
+    elif preset == "pp_outer":
+        # vocab stays unsharded: embed/unembed live OUTSIDE the pipeline
+        # stages (stage-replicated), and a tp-sharded vocab dim invites
+        # GSPMD to reshard the table over the equal-sized dcn axis for the
+        # token gather — a data-movement collective across DCN the byte
+        # counters rightly flag. Override with .with_overrides(vocab="tp")
+        # if the table dominates HBM and the gather cost is acceptable.
+        rules = make_rules(dcn="pp", **make_rules_kwargs).with_overrides(
+            vocab=None
+        )
+    else:
+        raise ValueError(
+            f"unknown multislice preset {preset!r}; choose from "
+            f"{MULTISLICE_PRESETS}"
+        )
+    check_rules(rules)
+    return rules
+
+
+def dp_outer(
+    num_slices: int, slice_spec: MeshSpec = MeshSpec(), **make_rules_kwargs
+) -> Tuple[SliceTopology, ShardingRules]:
+    """Data parallelism across slices: every slice holds a full model
+    replica group; gradients all-reduce over DCN once per step.  The right
+    preset when the model fits one slice and you are scaling batch."""
+    return (
+        SliceTopology(num_slices, slice_spec),
+        multislice_rules("dp_outer", **make_rules_kwargs),
+    )
+
+
+def pp_outer(
+    num_slices: int,
+    slice_spec: MeshSpec = MeshSpec(),
+    *,
+    stages_per_slice: int = 1,
+    **make_rules_kwargs,
+) -> Tuple[SliceTopology, ShardingRules]:
+    """Pipeline stages across slices: stage i lives on slice
+    i // stages_per_slice; only microbatch activations cross DCN, at stage
+    boundaries.  The right preset when one slice cannot hold the model and
+    activations are small relative to gradients."""
+    if stages_per_slice < 1:
+        raise ValueError(f"stages_per_slice must be >= 1, got {stages_per_slice}")
+    spec = dataclasses.replace(slice_spec, pp=stages_per_slice)
+    return (
+        SliceTopology(num_slices, spec),
+        multislice_rules("pp_outer", **make_rules_kwargs),
+    )

@@ -9,24 +9,47 @@ permutation), so one combinator serves training and inference.
 
 Runs inside shard_map manual over `pp` only — dp/fsdp/tp/sp stay auto, so
 GSPMD still shards each stage's internals from the sharding table.
+
+Multi-slice placement (parallel/multislice.py pp-outer): `axis_name` may be
+a PAIR ("dcn", "pp") — slice-major stage→slice placement where global stage
+s = slice_index * stages_per_slice + local_stage. The stage-to-stage hop is
+then two-tier: intra-slice hops ride a `pp` ppermute (ICI) and the slice-
+boundary hop rides ONE `dcn` ppermute (DCN) plus an intra-slice wrap to the
+next slice's first stage — with stages_per_slice=1 (the preset default) DCN
+therefore carries exactly the boundary activation per tick and nothing
+else. Caveat for stages_per_slice>1: the SPMD program is uniform, so the
+`dcn` ppermute runs at EVERY inner-stage coordinate and ships
+stages_per_slice copies of the microbatch activation across DCN per tick
+(only the last inner stage's copy is consumed; the byte counters report
+the real, inflated figure). Keep stages_per_slice=1 when DCN bandwidth is
+the constraint.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatches: int):
+def _pipeline_local(
+    stage_fn, stage_params, x_mb, *, axis_names: Tuple[str, ...], n_microbatches: int
+):
     """Runs on one stage (inside shard_map). x_mb: [n_mb, mb, ...] full input
     (only stage 0 reads it); returns [n_mb, mb, ...] outputs (valid on the
-    last stage, zeros elsewhere — caller psums over pp to broadcast)."""
-    pp = lax.psum(1, axis_name)
-    stage = lax.axis_index(axis_name)
+    last stage, zeros elsewhere — caller psums over the stage axes to
+    broadcast). axis_names is ("pp",) or ("dcn", "pp") — outer axis first."""
+    inner = axis_names[-1]
+    outer = axis_names[0] if len(axis_names) == 2 else None
+    pp_in = lax.psum(1, inner)
+    n_outer = lax.psum(1, outer) if outer is not None else 1
+    pp = n_outer * pp_in
+    stage = lax.axis_index(inner)
+    if outer is not None:
+        stage = lax.axis_index(outer) * pp_in + stage
     n_mb = n_microbatches
     total_ticks = n_mb + pp - 1
     mb_shape = x_mb.shape[1:]
@@ -44,7 +67,24 @@ def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatc
 
     fwd = jax.checkpoint(_fwd)
 
-    send_perm = [(i, i + 1) for i in range(pp - 1)]
+    intra_perm = [(i, i + 1) for i in range(pp_in - 1)]
+    cross_perm = [(s, s + 1) for s in range(n_outer - 1)]
+    wrap_perm = [(pp_in - 1, 0)]
+
+    def hop(y):
+        """Pass activations one stage downstream. Single-axis: one ppermute.
+        Two-tier: intra-slice neighbors over `inner` (ICI); the slice
+        boundary crosses `outer` (DCN) once, then wraps to the next slice's
+        stage 0 over `inner` (ICI again). Devices without an upstream
+        receive zeros (masked by the stage-0 ingest select)."""
+        if outer is None:
+            return lax.ppermute(y, inner, intra_perm)
+        cross = lax.ppermute(y, outer, cross_perm)
+        if pp_in == 1:
+            return cross
+        intra = lax.ppermute(y, inner, intra_perm)
+        cross = lax.ppermute(cross, inner, wrap_perm)
+        return jnp.where(lax.axis_index(inner) == 0, cross, intra)
 
     def tick(carry, t):
         recv, out_buf = carry
@@ -54,7 +94,7 @@ def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatc
         x_in = jnp.where(stage == 0, x0, recv)
         y = fwd(x_in)
         # pass activations downstream for the next tick
-        new_recv = lax.ppermute(y, axis_name, send_perm)
+        new_recv = hop(y)
         # last stage stores its (active) output at t - (pp - 1)
         is_active_last = jnp.logical_and(stage == pp - 1, t >= pp - 1)
         store_idx = jnp.clip(t - (pp - 1), 0, n_mb - 1)
@@ -70,7 +110,8 @@ def _pipeline_local(stage_fn, stage_params, x_mb, *, axis_name: str, n_microbatc
     # broadcast. psum in f32: bf16 all-reduce hits an XLA CHECK on the CPU
     # backend (hlo_instruction.cc "Invalid binary instruction opcode copy").
     out_buf = jnp.where(stage == pp - 1, out_buf, jnp.zeros_like(out_buf))
-    return lax.psum(out_buf.astype(jnp.float32), axis_name).astype(out_buf.dtype)
+    bcast_axes = axis_names if len(axis_names) > 1 else axis_names[0]
+    return lax.psum(out_buf.astype(jnp.float32), bcast_axes).astype(out_buf.dtype)
 
 
 def pipeline_apply(
@@ -80,27 +121,75 @@ def pipeline_apply(
     *,
     mesh,
     n_microbatches: int,
-    axis_name: str = "pp",
+    axis_name: Union[str, Tuple[str, ...]] = "pp",
+    batch_axes: Union[None, str, Tuple[str, ...]] = ("dp", "fsdp"),
 ):
-    """Apply a pp-stage pipeline to x: [B, ...].
+    """Apply a pipelined stage stack to x: [B, ...].
 
-    stage_params: pytree whose leaves have leading dim pp (sharded on `pp`).
-    stage_fn(params_one_stage, x_mb) -> y_mb with matching shapes.
+    stage_params: pytree whose leaves have leading dim = total stages
+    (sharded on the stage axes). stage_fn(params_one_stage, x_mb) -> y_mb
+    with matching shapes.
+
+    axis_name: mesh axis the stages live on, or a ("dcn", "pp") pair for
+    multi-slice stage→slice placement — stages are laid out slice-major
+    (dcn-major), so stage s lives on slice s // stages_per_slice.
+
+    batch_axes: mesh axes the batch dim is sharded over (the rule table's
+    "batch" mapping). Only used by the jax-0.4.x fully-manual fallback,
+    which would otherwise all-gather the batch to full replication at the
+    region boundary — a gather GSPMD is then free to route over the slow
+    `dcn` axis. Keeping the batch sharded through the region keeps every
+    non-pipeline byte on ICI (the multislice byte-counter tests assert
+    exactly this).
     """
     from jax.sharding import PartitionSpec as P
+
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if not 1 <= len(axes) <= 2:
+        raise ValueError(
+            f"axis_name must be one mesh axis or an (outer, inner) pair, "
+            f"got {axis_name!r}"
+        )
+    n_stage_devices = 1
+    for a in axes:
+        if a not in mesh.shape:
+            raise ValueError(f"pipeline axis {a!r} not in mesh axes {tuple(mesh.shape)}")
+        n_stage_devices *= mesh.shape[a]
+    lead = jax.tree.leaves(stage_params)[0].shape[0]
+    if lead % n_stage_devices:
+        raise ValueError(
+            f"stage_params leading dim {lead} does not divide over the "
+            f"{n_stage_devices} stage devices of mesh axes {axes} "
+            f"({ {a: mesh.shape[a] for a in axes} })"
+        )
 
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by n_microbatches {n_microbatches}")
-    x_mb = x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+    mb = b // n_microbatches
+    x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
 
-    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    x_spec = P()
+    if not hasattr(jax, "shard_map"):
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        bax = tuple(
+            a for a in (batch_axes or ()) if a in mesh.shape and a not in axes
+        )
+        n_bax = 1
+        for a in bax:
+            n_bax *= mesh.shape[a]
+        if n_bax > 1 and mb % n_bax == 0:
+            x_spec = P(None, bax)
+
+    stage_spec = P(axes if len(axes) > 1 else axes[0])
+    pspec = jax.tree.map(lambda _: stage_spec, stage_params)
     fn = partial(
-        _pipeline_local, stage_fn, axis_name=axis_name, n_microbatches=n_microbatches
+        _pipeline_local, stage_fn, axis_names=axes, n_microbatches=n_microbatches
     )
     from .sharding import shard_map_compat
 
     out_mb = shard_map_compat(
-        fn, mesh, (pspec, P()), P(), {axis_name}
+        fn, mesh, (pspec, x_spec), x_spec, set(axes)
     )(stage_params, x_mb)
     return out_mb.reshape((b,) + out_mb.shape[2:])

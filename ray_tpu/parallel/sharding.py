@@ -87,9 +87,25 @@ def make_rules(
     tensor_parallel: bool = True,
     sequence_parallel: bool = False,
     expert_parallel: bool = False,
+    dcn: Optional[str] = None,
 ) -> ShardingRules:
+    """`dcn` places ONE parallelism across the slow slice boundary of a
+    multi-slice mesh (parallel/multislice.py):
+
+      dcn="dp"  batch -> ("dcn", "dp", "fsdp"): data-parallel outer loop,
+                gradient all-reduce crosses DCN once per step.
+      dcn="pp"  stage -> ("dcn", "pp"): pipeline stage-groups mapped one
+                per slice, boundary ppermutes cross DCN.
+
+    Bandwidth-hungry axes (tp/sp/ep) are never offered a dcn mapping."""
+    if dcn not in (None, "dp", "pp"):
+        raise ValueError(
+            f"dcn must be None, 'dp' or 'pp' (got {dcn!r}); tp/sp/ep "
+            "traffic is per-layer bandwidth and cannot cross the slice "
+            "boundary"
+        )
     rules: Dict[str, MeshAxes] = {
-        "batch": ("dp", "fsdp"),
+        "batch": ("dcn", "dp", "fsdp") if dcn == "dp" else ("dp", "fsdp"),
         "seq": "sp" if sequence_parallel else None,
         "kv_seq": "sp" if sequence_parallel else None,
         "embed": "fsdp" if fsdp_params else None,
@@ -100,7 +116,7 @@ def make_rules(
         "vocab": "tp" if tensor_parallel else None,
         "layers": None,
         "expert": "ep" if expert_parallel else None,
-        "stage": "pp",
+        "stage": ("dcn", "pp") if dcn == "pp" else "pp",
     }
     return ShardingRules(rules)
 

@@ -1,8 +1,16 @@
-"""Queue-depth autoscaling policy.
+"""Queue-depth + batch-saturation autoscaling policy.
 
 Reference parity: serve/_private/autoscaling_policy.py:9
 (calculate_desired_num_replicas: desired = ongoing / target_per_replica,
 clamped to [min, max]).
+
+Decode-aware extension (ROADMAP serving remainder): replicas hosting a
+`serve.ContinuousBatcher` report generation-slot occupancy next to queue
+depth (Replica.stats "batch_*" keys). A generation-bound deployment whose
+slots are saturated is at capacity even while its request queue is still
+shallow — per-token streaming means ongoing-request counts understate load
+until latency has already degraded. The desired replica count is the max of
+the queue-depth target and the slot-occupancy target.
 """
 
 from __future__ import annotations
@@ -13,9 +21,25 @@ from .deployment import AutoscalingConfig
 
 
 def calculate_desired_num_replicas(
-    config: AutoscalingConfig, total_ongoing_requests: float, current_replicas: int
+    config: AutoscalingConfig,
+    total_ongoing_requests: float,
+    current_replicas: int,
+    *,
+    batch_slots: float = 0.0,
+    batch_load: float = 0.0,
 ) -> int:
+    """batch_slots: total generation slots across the deployment's current
+    replicas; batch_load: active + queued generations against those slots.
+    Both default to 0 (no batcher -> pure queue-depth policy)."""
     if current_replicas == 0:
         return config.min_replicas
     desired = math.ceil(total_ongoing_requests / max(config.target_ongoing_requests, 1e-9))
+    if batch_slots > 0:
+        # scale so the per-replica slot load lands at target occupancy:
+        # slots_per_replica stays constant, so desired_batch satisfies
+        # batch_load / (desired_batch * slots_per_replica) <= target
+        slots_per_replica = batch_slots / current_replicas
+        target = max(config.target_batch_occupancy, 1e-9)
+        desired_batch = math.ceil(batch_load / (slots_per_replica * target))
+        desired = max(desired, desired_batch)
     return max(config.min_replicas, min(config.max_replicas, desired))

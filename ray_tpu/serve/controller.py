@@ -452,7 +452,16 @@ class ServeController:
         except Exception:
             return
         total_ongoing = sum(s["ongoing"] for s in stats)
-        desired = calculate_desired_num_replicas(ac, total_ongoing, len(state.replicas))
+        # decode-aware signal: generation slots + their load, when replicas
+        # host ContinuousBatchers (0 otherwise -> pure queue-depth policy)
+        batch_slots = sum(s.get("batch_slots", 0) for s in stats)
+        batch_load = sum(
+            s.get("batch_active", 0) + s.get("batch_queued", 0) for s in stats
+        )
+        desired = calculate_desired_num_replicas(
+            ac, total_ongoing, len(state.replicas),
+            batch_slots=batch_slots, batch_load=batch_load,
+        )
         now = time.time()
         delay = ac.upscale_delay_s if desired > state.target else ac.downscale_delay_s
         if desired != state.target and now - state.last_scale_ts >= delay:

@@ -18,6 +18,12 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # decode-aware signal: generation-bound deployments (ContinuousBatcher
+    # replicas) scale on SLOT SATURATION, not just queued calls — a batcher
+    # running all slots full is at capacity even when nothing queues yet.
+    # Desired replicas also satisfies: load_fraction <= target_batch_occupancy,
+    # where load_fraction = (active + queued generations) / total slots.
+    target_batch_occupancy: float = 0.8
 
 
 @dataclass

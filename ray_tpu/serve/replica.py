@@ -223,25 +223,30 @@ class Replica:
 
     # ------------------------------------------------------------- draining
 
+    def _drainables(self) -> List[Any]:
+        """Drainable batchers hanging off the user callable (@serve.batch
+        queues, ContinuousBatchers) — the single discovery point shared by
+        the drain path and the autoscaling stats."""
+        attrs = getattr(self.callable, "__dict__", None) or {}
+        return [v for v in list(attrs.values())
+                if getattr(v, "_serve_drainable", False)]
+
     def prepare_to_drain(self, deadline_s: Optional[float] = None) -> int:
         """Stop accepting new requests; returns the in-flight count at the
         moment the gate closed (controller sequencing: drain -> reap).
 
         deadline_s (the deployment's graceful_shutdown_timeout_s) is
-        propagated to any drainable batchers hanging off the user callable
-        (@serve.batch queues, ContinuousBatchers): they bounce queued-but-
-        unadmitted work for handle-side retry and cut still-running
-        generations at the deadline."""
+        propagated to any drainable batchers hanging off the user callable:
+        they bounce queued-but-unadmitted work for handle-side retry and
+        cut still-running generations at the deadline."""
         with self._lock:
             self._draining = True
             ongoing = self._ongoing + len(self._streams)
-        attrs = getattr(self.callable, "__dict__", None) or {}
-        for v in list(attrs.values()):
-            if getattr(v, "_serve_drainable", False):
-                try:
-                    v.drain(deadline_s)
-                except Exception:
-                    pass
+        for v in self._drainables():
+            try:
+                v.drain(deadline_s)
+            except Exception:
+                pass
         return ongoing
 
     def num_ongoing(self) -> int:
@@ -249,15 +254,39 @@ class Replica:
         with self._lock:
             return self._ongoing + len(self._streams)
 
+    def _batcher_stats(self) -> Dict[str, int]:
+        """Aggregate generation-slot occupancy over any drainable batchers
+        hanging off the user callable (serve.ContinuousBatcher instances) —
+        the decode-aware autoscaling signal: a generation-bound replica is
+        saturated when its SLOTS are, long before queued-call counts say so."""
+        slots = active = queued = 0
+        for v in self._drainables():
+            get_stats = getattr(v, "stats", None)
+            if get_stats is None:
+                continue
+            try:
+                s = get_stats()
+            except Exception:
+                continue
+            if not isinstance(s, dict) or "max_batch_size" not in s:
+                continue
+            slots += int(s.get("max_batch_size", 0))
+            active += int(s.get("active", 0))
+            queued += int(s.get("queued", 0))
+        return {"batch_slots": slots, "batch_active": active,
+                "batch_queued": queued}
+
     def stats(self) -> Dict[str, Any]:
         self._reap_idle_streams()
-        return {
+        out = {
             "ongoing": self._ongoing + len(self._streams),
             "streams": len(self._streams),
             "total": self._total,
             "draining": self._draining,
             "ts": time.time(),
         }
+        out.update(self._batcher_stats())
+        return out
 
     def check_health(self) -> bool:
         user_check = getattr(self.callable, "check_health", None)

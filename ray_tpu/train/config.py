@@ -26,6 +26,21 @@ class ScalingConfig:
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
     placement_strategy: str = "SPREAD"
     env_vars: Dict[str, str] = field(default_factory=dict)
+    # multi-slice DCN topology (parallel/multislice.py): the gang's hosts
+    # split into this many equal slices; workers of one slice hold
+    # consecutive ranks. Each worker's train loop can then build the
+    # two-level (dcn x ICI) mesh with session.build_multislice_mesh.
+    num_slices: int = 1
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {self.num_slices}")
+        if self.num_workers % self.num_slices:
+            raise ValueError(
+                f"num_workers={self.num_workers} does not split into "
+                f"{self.num_slices} equal slices; slices must hold the same "
+                "number of hosts"
+            )
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)

@@ -24,8 +24,14 @@ class TrainContext:
     config: Dict[str, Any] = field(default_factory=dict)
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
     checkpoint: Optional[Any] = None
+    # how many DCN slices the gang's hosts form (ScalingConfig.num_slices);
+    # this worker belongs to slice world_rank // (world_size // num_slices)
+    num_slices: int = 1
     results: "queue.Queue" = field(default_factory=queue.Queue)
     done: threading.Event = field(default_factory=threading.Event)
+
+    def slice_rank(self) -> int:
+        return self.world_rank // max(1, self.world_size // self.num_slices)
 
 
 _ctx = threading.local()
@@ -71,3 +77,29 @@ def get_world_size() -> int:
 
 def get_local_rank() -> int:
     return get_context().local_rank
+
+
+def get_num_slices() -> int:
+    return get_context().num_slices
+
+
+def build_multislice_mesh(slice_spec=None, preset: str = "dp_outer"):
+    """Build the gang's two-level (dcn x ICI) mesh + slice-aware rule table
+    from the trainer's host topology (ScalingConfig.num_slices).
+
+    Returns (mesh, rules). With num_slices=1 the dcn axis has size 1, so
+    the same train loop runs single-slice and multi-slice unchanged.
+    slice_spec is the PER-SLICE MeshSpec (tp/sp/ep must fit one slice);
+    preset is "dp_outer" or "pp_outer" (parallel/multislice.py for the
+    selection guidance)."""
+    from ..parallel.mesh import MeshSpec
+    from ..parallel.multislice import (
+        SliceTopology,
+        build_multislice_mesh as _build,
+        multislice_rules,
+    )
+
+    ctx = get_context()
+    topo = SliceTopology(ctx.num_slices, slice_spec or MeshSpec(dp=-1))
+    rules = multislice_rules(preset)
+    return _build(topo), rules

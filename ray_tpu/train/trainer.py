@@ -75,6 +75,7 @@ class TrainWorker:
         datasets=None,
         checkpoint=None,
         coordinator: Optional[str] = None,
+        num_slices: int = 1,
     ):
         dist_inited = False
         if self.world_size > 1 and coordinator:
@@ -93,6 +94,7 @@ class TrainWorker:
             config=config or {},
             dataset_shards=datasets or {},
             checkpoint=checkpoint,
+            num_slices=num_slices,
         )
         _set_context(self.ctx)
         try:
@@ -254,7 +256,7 @@ class JaxTrainer:
         run_refs = [
             w.run.remote(
                 self._train_fn, self._config, shard_for(i), resume_checkpoint,
-                coordinator,
+                coordinator, sc.num_slices,
             )
             for i, w in enumerate(workers)
         ]

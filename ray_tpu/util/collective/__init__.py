@@ -30,3 +30,9 @@ from .collective import (  # noqa: F401
     send,
 )
 from . import in_graph  # noqa: F401
+from .bytes import (  # noqa: F401
+    CollectiveOp,
+    assert_no_cross_slice,
+    collective_byte_report,
+    mesh_collective_report,
+)

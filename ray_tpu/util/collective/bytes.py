@@ -1,0 +1,283 @@
+"""Per-axis (ICI vs DCN) collective byte counters.
+
+GSPMD inserts the collectives, so the only honest accounting of what
+crosses the slow slice boundary is the COMPILED program: this module walks
+the optimized HLO of a jitted step, finds every collective op, maps its
+replica groups back to mesh coordinates, and classifies the op by the mesh
+axes its groups span.  An op whose groups vary along the `dcn` axis moves
+bytes over DCN; everything else stays on ICI.
+
+This is what lets the multi-slice presets (parallel/multislice.py) PROVE
+their contract — e.g. "tp/sp/ep traffic never crosses a slice boundary" is
+`assert_no_cross_slice(report)`, not a comment.
+
+Byte convention: each op is charged its per-participant payload (the HLO
+output shape), recorded once per replica group member-set; collective-
+permute is charged per source→target pair.  The numbers are therefore a
+consistent basis for ICI:DCN ratios and zero/nonzero assertions, not a
+wire-level byte count (which would fold in algorithm choice — ring vs tree
+— that XLA owns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DCN_AXES_DEFAULT: Tuple[str, ...] = ("dcn",)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+_OP_RE = re.compile(
+    r"=\s*(?P<out>\([^)]*\)|[a-z0-9]+\[[^\]]*\]\S*)\s+"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute|collective-broadcast)(?P<start>-start)?\("
+)
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,]+\}(?:,\{[\d,]+\})*)\}")
+_IOTA_RE = re.compile(
+    r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[\d,]+\}(?:,\{[\d,]+\})*)\}")
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    kind: str
+    payload_bytes: int                 # one participant's output payload
+    axes: Tuple[str, ...]              # mesh axes the op communicates over
+    group_size: int
+    crosses_dcn: bool
+    dcn_bytes: int                     # payload charged to the slow axes
+    ici_bytes: int
+    # True when every group is a full cartesian product of per-axis member
+    # sets: the runtime can decompose the op hierarchically (reduce/gather
+    # intra-slice on ICI first, then one inter-slice exchange over DCN) —
+    # e.g. a gradient all-reduce over ("dcn", "dp"). False means the op
+    # irreducibly MIXES axes in one exchange.
+    separable: bool = True
+
+
+def _shape_bytes(out: str, async_start: bool = False) -> int:
+    """Payload bytes of an HLO output type. For async `-start` forms the
+    tuple carries BOTH the operand and result buffers (plus u32 context
+    scalars), so summing would double-charge: take the largest single
+    shape instead — the actual payload."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(out):
+        if dtype == "token":
+            continue
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            m = re.fullmatch(r"[a-z]+?(\d+)", dtype)
+            size = max(1, int(m.group(1)) // 8) if m else 4
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * size)
+    if not sizes:
+        return 0
+    return max(sizes) if async_start else sum(sizes)
+
+
+def _parse_brace_groups(body: str) -> List[Tuple[int, ...]]:
+    return [
+        tuple(int(x) for x in g.split(",") if x)
+        for g in re.findall(r"\{([\d,]+)\}", "{" + body + "}")
+    ]
+
+
+def _parse_iota_groups(dims_s: str, reshape_s: str, perm_s: Optional[str]):
+    """XLA iota replica-group list: iota over prod(reshape) dims, reshaped,
+    transposed by perm, then reshaped to [n_groups, group_size]."""
+    out_dims = [int(x) for x in dims_s.split(",")]
+    reshape = [int(x) for x in reshape_s.split(",")]
+    arr = np.arange(int(np.prod(reshape))).reshape(reshape)
+    if perm_s:
+        arr = arr.transpose([int(x) for x in perm_s.split(",")])
+    arr = arr.reshape(out_dims)
+    return [tuple(int(v) for v in row) for row in arr]
+
+
+def _extract_groups(line: str, n_devices: int) -> Optional[List[Tuple[int, ...]]]:
+    m = _GROUPS_RE.search(line)
+    if m:
+        return _parse_brace_groups(m.group(1))
+    m = _IOTA_RE.search(line)
+    if m:
+        return _parse_iota_groups(m.group(1), m.group(2), m.group(3))
+    if re.search(r"replica_groups=\{\}", line):
+        # XLA shorthand: one group spanning every participant
+        return [tuple(range(n_devices))]
+    return None
+
+
+def _spanned_axes(
+    members: Sequence[int], shape: Sequence[int], names: Sequence[str]
+) -> Tuple[str, ...]:
+    coords = np.array([np.unravel_index(i, shape) for i in members])
+    return tuple(
+        names[d] for d in range(len(names)) if len(set(coords[:, d])) > 1
+    )
+
+
+def _is_separable(members: Sequence[int], shape: Sequence[int]) -> bool:
+    """True iff the member set is a full cartesian product of its per-axis
+    coordinate sets — the condition for hierarchical (per-axis, ICI-then-
+    DCN) decomposition of the op."""
+    coords = np.array([np.unravel_index(i, shape) for i in members])
+    expect = 1
+    for d in range(coords.shape[1]):
+        expect *= len(set(coords[:, d]))
+    return expect == len(set(members))
+
+
+def collective_byte_report(
+    hlo_text: str,
+    *,
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    dcn_axes: Sequence[str] = DCN_AXES_DEFAULT,
+) -> Dict:
+    """Classify every collective in optimized HLO text by the mesh axes its
+    replica groups span.  Group/pair member ids are positions in the mesh's
+    flattened device array (row-major over `axis_sizes`), which is how both
+    GSPMD partition ids and `build_multislice_mesh`'s slice-major layout
+    are defined."""
+    names, shape = list(axis_names), list(axis_sizes)
+    n_devices = int(np.prod(shape))
+    ops: List[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group("kind")
+        payload = _shape_bytes(m.group("out"), async_start=bool(m.group("start")))
+        if kind == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pairs = _parse_brace_groups(pm.group(1)) if pm else []
+            pairs = [p for p in pairs if len(p) == 2 and p[0] != p[1]]
+            if not pairs:
+                continue
+            spanned: set = set()
+            dcn_b = ici_b = 0
+            separable = True
+            for src, dst in pairs:
+                axes = _spanned_axes((src, dst), shape, names)
+                spanned.update(axes)
+                separable = separable and len(axes) <= 1
+                if any(a in dcn_axes for a in axes):
+                    dcn_b += payload
+                else:
+                    ici_b += payload
+            ops.append(CollectiveOp(
+                kind=kind, payload_bytes=payload, axes=tuple(sorted(spanned)),
+                group_size=2, crosses_dcn=dcn_b > 0,
+                dcn_bytes=dcn_b, ici_bytes=ici_b, separable=separable,
+            ))
+            continue
+        groups = _extract_groups(line, n_devices)
+        if not groups:
+            continue
+        # groups are symmetric partitions of the mesh: one is enough to
+        # classify, but span the union in case XLA merged unequal groups
+        spanned = set()
+        separable = True
+        for g in groups:
+            if len(g) > 1:
+                spanned.update(_spanned_axes(g, shape, names))
+                separable = separable and _is_separable(g, shape)
+        if not spanned:
+            continue
+        crosses = any(a in dcn_axes for a in spanned)
+        ops.append(CollectiveOp(
+            kind=kind, payload_bytes=payload, axes=tuple(sorted(spanned)),
+            group_size=max(len(g) for g in groups), crosses_dcn=crosses,
+            dcn_bytes=payload if crosses else 0,
+            ici_bytes=0 if crosses else payload,
+            separable=separable,
+        ))
+
+    per_axis: Dict[str, int] = {}
+    for op in ops:
+        for a in op.axes:
+            per_axis[a] = per_axis.get(a, 0) + op.payload_bytes
+    return {
+        "ops": ops,
+        "per_axis_bytes": per_axis,
+        "dcn_bytes": sum(op.dcn_bytes for op in ops),
+        "ici_bytes": sum(op.ici_bytes for op in ops),
+        "total_bytes": sum(op.payload_bytes for op in ops),
+    }
+
+
+def mesh_collective_report(
+    compiled_or_text, mesh=None, dcn_axes: Sequence[str] = DCN_AXES_DEFAULT
+) -> Dict:
+    """Convenience wrapper: accepts a jax Compiled/Lowered object (or HLO
+    text) plus the Mesh the program was jitted over."""
+    # a jax Lowered must be COMPILED first: its own as_text() is the
+    # pre-partitioning StableHLO, which contains no collectives at all
+    if hasattr(compiled_or_text, "compile"):
+        compiled_or_text = compiled_or_text.compile()
+    if hasattr(compiled_or_text, "as_text"):
+        text = compiled_or_text.as_text()
+    else:
+        text = compiled_or_text
+    if mesh is None:
+        raise ValueError("mesh_collective_report requires the mesh")
+    names = list(mesh.shape.keys())
+    sizes = [mesh.shape[n] for n in names]
+    return collective_byte_report(
+        text, axis_names=names, axis_sizes=sizes, dcn_axes=dcn_axes
+    )
+
+
+_DATA_MOVEMENT_KINDS = (
+    "all-gather", "all-to-all", "collective-permute", "collective-broadcast"
+)
+
+
+def assert_no_cross_slice(
+    report: Dict, ici_axes: Sequence[str] = ("tp", "sp", "ep")
+) -> None:
+    """Fail if any collective moves ICI-only-axis traffic over DCN.
+
+    Flagged: (a) DATA-MOVEMENT ops (all-gather / all-to-all / collective-
+    permute / broadcast) whose groups span both a bandwidth-hungry axis and
+    a dcn axis — tp/sp/ep-sharded payload is being shipped across slices;
+    (b) reductions whose dcn-crossing groups are NOT separable cartesian
+    products — they cannot be decomposed into intra-slice-then-DCN stages.
+
+    NOT flagged: separable reductions spanning dcn x other axes (e.g. the
+    gradient all-reduce over ("dcn", "dp"), or a region-boundary cotangent
+    psum over ("dcn", "tp")) — the runtime reduces those hierarchically,
+    so the DCN leg carries only the once-per-step inter-slice exchange."""
+    bad = []
+    for op in report["ops"]:
+        if not op.crosses_dcn:
+            continue
+        mixes_ici = any(a in ici_axes for a in op.axes)
+        if op.kind in _DATA_MOVEMENT_KINDS and mixes_ici:
+            bad.append(op)
+        elif mixes_ici and not op.separable:
+            bad.append(op)
+    if bad:
+        lines = ", ".join(
+            f"{op.kind}[{'/'.join(op.axes)}]={op.payload_bytes}B" for op in bad
+        )
+        raise AssertionError(
+            f"{len(bad)} collective(s) carry {ici_axes} traffic across the "
+            f"DCN slice boundary: {lines}"
+        )

@@ -18,6 +18,13 @@ except ImportError:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests excluded from the tier-1 `-m 'not slow'` run",
+    )
+
+
 @pytest.fixture
 def ray_start_regular():
     """Fixture ladder rung 1 (reference: python/ray/tests/conftest.py:351)."""

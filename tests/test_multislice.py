@@ -1,0 +1,381 @@
+"""Multi-slice DCN mesh subsystem: topology validation, dp-outer/pp-outer
+dryrun loss parity vs the single-device oracle, and ICI/DCN byte-counter
+proofs that tp/sp/ep traffic never crosses the slice boundary.
+
+All on the virtual two-slice 2x4 CPU mesh (8 devices from conftest's
+XLA_FLAGS)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import CONFIGS
+from ray_tpu.parallel import (
+    MeshSpec,
+    PRESET_RULES,
+    SliceTopology,
+    build_mesh,
+    build_multislice_mesh,
+    dp_outer,
+    group_devices_by_slice,
+    multislice_rules,
+    pp_outer,
+)
+from ray_tpu.parallel.multislice import check_rules
+from ray_tpu.parallel.sharding import make_rules
+from ray_tpu.util.collective import (
+    assert_no_cross_slice,
+    collective_byte_report,
+    mesh_collective_report,
+)
+
+
+@pytest.fixture
+def sharding_invariant_rng():
+    """Partitionable threefry makes jax.random values independent of the
+    output sharding, so a sharded init and its single-device oracle start
+    from bit-identical params (the default counter-mode threefry lowering
+    can produce different bits under different GSPMD partitionings)."""
+    old = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    yield
+    jax.config.update("jax_threefry_partitionable", old)
+
+
+def _token_batch(cfg, batch_size, seed=8):
+    rng = np.random.default_rng(seed)
+    return {
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch_size, 33)), jnp.int32
+        ),
+        "mask": jnp.ones((batch_size, 33), jnp.int32),
+    }
+
+
+def _train_one_step(cfg, mesh, rules, batch):
+    """One real sharded train step; returns (loss, optimized HLO text)."""
+    from ray_tpu.train.step import (
+        default_optimizer, make_sharded_init, make_train_step,
+    )
+
+    opt = default_optimizer(lr=1e-3, warmup=1)
+    init_fn, shardings = make_sharded_init(cfg, mesh, rules, opt)
+    state = init_fn(jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, rules, opt, shardings)
+    hlo = step.lower(state, batch).compile().as_text()
+    _, metrics = step(state, batch)
+    return float(metrics["loss"]), hlo
+
+
+def _oracle(cfg, batch):
+    mesh1 = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    loss, _ = _train_one_step(cfg, mesh1, PRESET_RULES["dp"], batch)
+    return loss
+
+
+# --------------------------------------------------------------- topology
+
+
+def test_topology_rejects_tp_crossing_slice_boundary():
+    """tp=8 over 2 slices of 4 devices must fail loudly, naming the axis."""
+    with pytest.raises(ValueError, match="'tp'=8.*slice"):
+        SliceTopology(2, MeshSpec(tp=8)).resolve(8)
+    for ax in ("sp", "ep"):
+        with pytest.raises(ValueError, match=f"'{ax}'"):
+            SliceTopology(2, MeshSpec(**{ax: 8})).resolve(8)
+
+
+def test_topology_rejects_uneven_slices():
+    with pytest.raises(ValueError, match="equal slices"):
+        SliceTopology(3, MeshSpec()).resolve(8)
+    with pytest.raises(ValueError, match="num_slices"):
+        SliceTopology(0, MeshSpec())
+    # unresolved wildcard specs must refuse to produce device counts
+    with pytest.raises(ValueError, match="resolve"):
+        SliceTopology(2, MeshSpec(dp=-1)).total()
+    with pytest.raises(ValueError, match="resolve"):
+        SliceTopology(2, MeshSpec(dp=-1)).device_slice_ids()
+
+
+def test_topology_resolves_wildcard_per_slice():
+    topo = SliceTopology(2, MeshSpec(dp=-1, tp=2)).resolve(8)
+    assert topo.slice_spec.dp == 2 and topo.slice_spec.tp == 2
+    assert list(topo.device_slice_ids()) == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_check_rules_rejects_dcn_on_ici_logical_axes():
+    bad = make_rules().with_overrides(heads=("dcn", "tp"))
+    with pytest.raises(ValueError, match="heads"):
+        check_rules(bad)
+    with pytest.raises(ValueError, match="dcn must be"):
+        make_rules(dcn="tp")
+    with pytest.raises(ValueError, match="unknown multislice preset"):
+        multislice_rules("tp_outer")
+
+
+def test_mesh_spec_resolve_names_offending_axis():
+    """Satellite: a non-dividing shape raises a ValueError naming the axis
+    and the device count instead of an opaque downstream reshape error."""
+    with pytest.raises(ValueError, match=r"'tp'=3.*8"):
+        MeshSpec(tp=3).resolve(8)
+    with pytest.raises(ValueError, match=r"'fsdp'=4"):
+        MeshSpec(dp=4, fsdp=4).resolve(8)
+    with pytest.raises(ValueError, match=r"cannot infer mesh axis 'dp'.*3"):
+        MeshSpec(dp=-1, tp=3).resolve(8)
+    # valid specs still resolve
+    assert MeshSpec(dp=-1, tp=2).resolve(8).dp == 4
+
+
+def test_group_devices_contiguous_fallback():
+    devs = jax.devices()
+    blocks = group_devices_by_slice(devs, 2)
+    assert [len(b) for b in blocks] == [4, 4]
+    assert blocks[0] + blocks[1] == sorted(
+        devs, key=lambda d: (getattr(d, "process_index", 0), d.id)
+    )
+    with pytest.raises(ValueError, match="split into 3"):
+        group_devices_by_slice(devs, 3)
+
+
+def test_multislice_mesh_layout_is_slice_major():
+    mesh = build_multislice_mesh(SliceTopology(2, MeshSpec(dp=2, tp=2)))
+    assert tuple(mesh.shape.keys())[0] == "dcn"
+    assert mesh.shape["dcn"] == 2 and mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    flat = list(mesh.devices.flatten())
+    blocks = group_devices_by_slice(jax.devices(), 2)
+    assert set(flat[:4]) == set(blocks[0])
+    assert set(flat[4:]) == set(blocks[1])
+
+
+# --------------------------------------------------- dryrun loss parity
+
+
+def test_dp_outer_two_slice_matches_oracle(sharding_invariant_rng):
+    """Virtual 2-slice (2x4) dp-outer: tp+ep inside each slice, batch over
+    ("dcn","dp","fsdp"); composite loss == single-device oracle, gradient
+    all-reduce is the only thing crossing DCN."""
+    cfg = dataclasses.replace(
+        CONFIGS["tiny_moe"], n_layers=2, dtype=jnp.float32
+    )
+    batch = _token_batch(cfg, 8)
+    topo, rules = dp_outer(2, MeshSpec(tp=2, ep=2), expert_parallel=True)
+    mesh = build_multislice_mesh(topo)
+    loss, hlo = _train_one_step(cfg, mesh, rules, batch)
+    oracle = _oracle(cfg, batch)
+    assert abs(loss - oracle) < 5e-3, (loss, oracle)
+
+    report = mesh_collective_report(hlo, mesh)
+    assert_no_cross_slice(report)
+    assert report["dcn_bytes"] > 0     # the gradient all-reduce
+    assert report["ici_bytes"] > 0     # tp/ep per-layer traffic
+    # tp and ep collectives exist and every one stays on ICI
+    for ax in ("tp", "ep"):
+        ax_ops = [op for op in report["ops"] if ax in op.axes]
+        assert ax_ops, f"no {ax} collectives found"
+        movement = [
+            op for op in ax_ops
+            if op.crosses_dcn and op.kind != "all-reduce"
+        ]
+        assert not movement, movement
+
+
+def test_pp_outer_two_slice_matches_oracle(sharding_invariant_rng):
+    """Virtual 2-slice (2x4) pp-outer: one pipeline stage-group per slice,
+    tp inside each slice. Dense model: loss matches the single-device
+    pipeline oracle bit-tight; DCN carries collective-permutes exactly at
+    the stage boundary."""
+    cfg = dataclasses.replace(
+        CONFIGS["tiny"], n_layers=2, dtype=jnp.float32,
+        pp_stages=2, pp_microbatches=2,
+    )
+    batch = _token_batch(cfg, 8)
+    topo, rules = pp_outer(2, MeshSpec(dp=2, tp=2))
+    mesh = build_multislice_mesh(topo)
+    loss, hlo = _train_one_step(cfg, mesh, rules, batch)
+    # single-device run of the SAME pp_stages=2 config applies the stages
+    # sequentially with identical microbatch windows -> exact oracle
+    oracle = _oracle(cfg, batch)
+    assert abs(loss - oracle) < 5e-3, (loss, oracle)
+
+    report = mesh_collective_report(hlo, mesh)
+    assert_no_cross_slice(report)
+    crossing = [op for op in report["ops"] if op.crosses_dcn]
+    assert any(op.kind == "collective-permute" for op in crossing), crossing
+    # every DCN-crossing permute is a pure dcn hop (the stage boundary)
+    for op in crossing:
+        if op.kind == "collective-permute":
+            assert op.axes == ("dcn",), op
+    # tp collectives all stay on ICI
+    tp_ops = [op for op in report["ops"] if "tp" in op.axes]
+    assert tp_ops
+    assert all(
+        op.kind == "all-reduce" or not op.crosses_dcn for op in tp_ops
+    ), tp_ops
+
+
+@pytest.mark.slow
+def test_pp_outer_moe_within_dryrun_tolerance(sharding_invariant_rng):
+    """MoE pp-outer: capacity-based dispatch computes its drop capacity
+    from the LOCAL batch shard (per-shard EP capacity semantics), so the
+    sharded loss tracks the oracle at the dryrun tolerance, not bit-tight.
+    (slow: the tier-1 coverage is the dense pp-outer + dp-outer MoE pair;
+    the MULTICHIP two_slice row exercises cross-slice MoE every round.)"""
+    cfg = dataclasses.replace(
+        CONFIGS["tiny_moe"], n_layers=2, dtype=jnp.float32,
+        pp_stages=2, pp_microbatches=2,
+    )
+    batch = _token_batch(cfg, 8)
+    topo, rules = pp_outer(2, MeshSpec(dp=2, tp=2), expert_parallel=True)
+    mesh = build_multislice_mesh(topo)
+    loss, hlo = _train_one_step(cfg, mesh, rules, batch)
+    oracle = _oracle(cfg, batch)
+    assert abs(loss - oracle) < 5e-2, (loss, oracle)
+    assert_no_cross_slice(mesh_collective_report(hlo, mesh))
+
+
+def test_pipeline_combinator_stage_to_slice_placement():
+    """Direct combinator over ("dcn", "pp"): 2 slices x 2 local stages = 4
+    global stages, slice-major placement, exact match vs sequential apply
+    (fwd and grads)."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    arr = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(arr, ("dcn", "pp", "dp"))
+    pp_total = 4
+    ws = jax.random.normal(jax.random.PRNGKey(0), (pp_total, 16, 16)) / 4.0
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+
+    def stage_fn(w, xs):
+        return jnp.tanh(xs @ w)
+
+    def pipe(w, xv):
+        return pipeline_apply(
+            stage_fn, w, xv, mesh=mesh, n_microbatches=2,
+            axis_name=("dcn", "pp"),
+        )
+
+    out = jax.jit(pipe)(ws, x)
+    ref = x
+    for i in range(pp_total):
+        ref = jnp.tanh(ref @ ws[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    g = jax.jit(jax.grad(lambda w: jnp.sum(pipe(w, x) ** 2)))(ws)
+    g_ref = jax.grad(
+        lambda w: jnp.sum(
+            jnp.tanh(jnp.tanh(jnp.tanh(jnp.tanh(x @ w[0]) @ w[1]) @ w[2]) @ w[3]) ** 2
+        )
+    )(ws)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+    # stage count that does not divide over the stage devices fails loudly
+    with pytest.raises(ValueError, match="leading dim 3"):
+        pipeline_apply(
+            stage_fn, ws[:3], x, mesh=mesh, n_microbatches=2,
+            axis_name=("dcn", "pp"),
+        )
+
+
+# ------------------------------------------------------- byte counters
+
+
+def test_byte_report_parses_explicit_iota_and_pairs():
+    """Pure-text unit: both HLO replica-group encodings plus permute pairs
+    classify against a (dcn=2, dp=2, tp=2) mesh layout."""
+    hlo = "\n".join([
+        # pure-dcn all-reduce (gradients): groups {0,4},{1,5}...
+        '%ar1 = f32[128]{0} all-reduce(f32[128]{0} %x), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add',
+        # tp all-reduce via iota form [4,2]<=[8]: contiguous pairs
+        # {0,1},{2,3},{4,5},{6,7} vary only the innermost (tp) coordinate
+        '%ar2 = bf16[64,64]{1,0} all-reduce(bf16[64,64]{1,0} %y), replica_groups=[4,2]<=[8], to_apply=%add',
+        # iota transpose form [4,2]<=[4,2]T(1,0) decodes to {0,2},{4,6},
+        # {1,3},{5,7}: groups over the middle (dp) coordinate
+        '%ar3 = f32[8]{0} all-reduce(f32[8]{0} %v), replica_groups=[4,2]<=[4,2]T(1,0), to_apply=%add',
+        # boundary permute crossing dcn only
+        '%cp = f32[32]{0} collective-permute(f32[32]{0} %z), source_target_pairs={{0,4},{1,5}}',
+        # intra-slice all-gather over dp: {0,2},{1,3},{4,6},{5,7}
+        '%ag = f32[16]{0} all-gather(f32[16]{0} %w), replica_groups={{0,2},{1,3},{4,6},{5,7}}, dimensions={0}',
+        # async TPU form: the -start tuple holds operand AND result buffers
+        # (plus u32 context) — must be charged its max shape, not the sum
+        '%cps = (f32[32]{0}, f32[32]{0}, u32[], u32[]) collective-permute-start(f32[32]{0} %z), source_target_pairs={{2,3}}',
+    ])
+    rep = collective_byte_report(
+        hlo, axis_names=("dcn", "dp", "tp"), axis_sizes=(2, 2, 2)
+    )
+    permutes = [op for op in rep["ops"] if op.kind == "collective-permute"]
+    sync_cp = next(op for op in permutes if op.crosses_dcn)
+    assert sync_cp.axes == ("dcn",)
+    assert sync_cp.dcn_bytes == 2 * 32 * 4
+    async_cp = next(op for op in permutes if not op.crosses_dcn)
+    assert async_cp.payload_bytes == 32 * 4  # max shape, not tuple sum
+    assert async_cp.axes == ("tp",)
+    ag = next(op for op in rep["ops"] if op.kind == "all-gather")
+    assert ag.axes == ("dp",)
+    assert not ag.crosses_dcn
+    ar1 = [op for op in rep["ops"] if op.kind == "all-reduce"]
+    assert {op.axes for op in ar1} == {("dcn",), ("tp",), ("dp",)}
+    tp_ar = next(op for op in ar1 if op.axes == ("tp",))
+    assert tp_ar.payload_bytes == 64 * 64 * 2
+    assert rep["dcn_bytes"] == 128 * 4 + 2 * 32 * 4
+    assert rep["total_bytes"] > rep["dcn_bytes"]
+
+
+def test_byte_report_flags_leaked_tp_across_slices():
+    """A data-movement op whose groups mix tp with dcn is exactly the leak
+    assert_no_cross_slice exists to catch."""
+    hlo = '%ag = f32[64]{0} all-gather(f32[64]{0} %w), replica_groups={{0,1,4,5},{2,3,6,7}}, dimensions={0}'
+    rep = collective_byte_report(
+        hlo, axis_names=("dcn", "dp", "tp"), axis_sizes=(2, 2, 2)
+    )
+    assert rep["ops"][0].axes == ("dcn", "tp")
+    with pytest.raises(AssertionError, match="all-gather"):
+        assert_no_cross_slice(rep)
+    # the same span on a reduction is a separable hierarchical reduce: ok
+    hlo_ar = hlo.replace("all-gather", "all-reduce")
+    assert_no_cross_slice(collective_byte_report(
+        hlo_ar, axis_names=("dcn", "dp", "tp"), axis_sizes=(2, 2, 2)
+    ))
+
+
+# ------------------------------------------------------- trainer plumbing
+
+
+def test_scaling_config_validates_num_slices():
+    from ray_tpu.train import ScalingConfig
+
+    with pytest.raises(ValueError, match="equal slices"):
+        ScalingConfig(num_workers=3, num_slices=2)
+    with pytest.raises(ValueError, match="num_slices"):
+        ScalingConfig(num_workers=2, num_slices=0)
+    assert ScalingConfig(num_workers=4, num_slices=2).num_slices == 2
+
+
+def test_session_builds_two_level_mesh_from_context():
+    """The worker-side helper builds the (dcn x ICI) mesh + slice-aware
+    rules from TrainContext.num_slices — the seam JaxTrainer plumbs
+    ScalingConfig.num_slices through."""
+    from ray_tpu.train import session as S
+
+    ctx = S.TrainContext(world_rank=1, world_size=2, num_slices=2)
+    S._set_context(ctx)
+    try:
+        mesh, rules = S.build_multislice_mesh(
+            MeshSpec(dp=-1, tp=2), preset="dp_outer"
+        )
+        assert mesh.shape["dcn"] == 2
+        assert mesh.shape["tp"] == 2 and mesh.shape["dp"] == 2
+        assert rules.mesh_axes("batch") == ("dcn", "dp", "fsdp")
+        assert ctx.slice_rank() == 1
+        # default preset + spec also works (dp fills the slice)
+        mesh2, rules2 = S.build_multislice_mesh()
+        assert mesh2.shape["dcn"] == 2 and mesh2.shape["dp"] == 4
+        # pp_outer rules route the stage dim over (dcn, pp)
+        _, rules3 = S.build_multislice_mesh(MeshSpec(dp=-1), preset="pp_outer")
+        assert rules3.mesh_axes("stage") == ("dcn", "pp")
+    finally:
+        S._set_context(None)

@@ -90,6 +90,73 @@ def test_autoscaling_policy_math():
     assert calculate_desired_num_replicas(ac, 0, 0) == 1
 
 
+def test_autoscaling_batch_occupancy_signal():
+    """Decode-aware scaling: a generation-bound replica whose batcher slots
+    are saturated upscales even while the queued-call count alone would not
+    (ROADMAP serving remainder: scale on batch saturation, not just queue)."""
+    from ray_tpu.serve.autoscaling import calculate_desired_num_replicas
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    ac = AutoscalingConfig(
+        min_replicas=1, max_replicas=10, target_ongoing_requests=100,
+        target_batch_occupancy=0.8,
+    )
+    # queue depth says 1 replica (8 << 100), but all 8 slots are running:
+    # occupancy 1.0 > 0.8 target -> 2 replicas
+    assert calculate_desired_num_replicas(
+        ac, 8, 1, batch_slots=8, batch_load=8) == 2
+    # half-busy slots: occupancy 0.5 <= 0.8 -> stay
+    assert calculate_desired_num_replicas(
+        ac, 4, 1, batch_slots=8, batch_load=4) == 1
+    # queued generations count toward load: 8 active + 8 waiting on 8 slots
+    # needs 2x capacity at full occupancy, 3 replicas at 0.8 target
+    assert calculate_desired_num_replicas(
+        ac, 16, 1, batch_slots=8, batch_load=16) == 3
+    # no batcher -> pure queue-depth policy, unchanged
+    assert calculate_desired_num_replicas(ac, 16, 1) == 1
+    # idle batcher never pins replicas up (downscale still possible)
+    assert calculate_desired_num_replicas(
+        ac, 0, 4, batch_slots=32, batch_load=0) == 1
+
+
+def test_replica_stats_surface_batcher_occupancy():
+    """Replica.stats() aggregates ContinuousBatcher-shaped drainable
+    attributes into batch_slots/active/queued for the controller's
+    autoscale loop."""
+    from ray_tpu.serve.replica import Replica
+
+    class FakeBatcher:
+        _serve_drainable = True
+
+        def __init__(self, slots, active, queued):
+            self._s = {"max_batch_size": slots, "active": active,
+                       "queued": queued}
+
+        def stats(self):
+            return dict(self._s)
+
+        def drain(self, deadline_s=None):
+            pass
+
+    class Deployment:
+        def __init__(self):
+            self.batcher = FakeBatcher(8, 5, 3)
+            self.other = FakeBatcher(4, 1, 0)
+
+        def __call__(self):
+            return "ok"
+
+    r = Replica("gen", Deployment, (), {})
+    s = r.stats()
+    assert s["batch_slots"] == 12
+    assert s["batch_active"] == 6
+    assert s["batch_queued"] == 3
+    # a plain replica reports zeros (queue-depth-only policy)
+    r2 = Replica("plain", lambda: "ok", (), {})
+    s2 = r2.stats()
+    assert (s2["batch_slots"], s2["batch_active"], s2["batch_queued"]) == (0, 0, 0)
+
+
 def test_autoscaling_e2e_upscale(serve_cluster):
     @serve.deployment(
         autoscaling_config={
