@@ -240,16 +240,18 @@ def main_hbm():
 def main_decode():
     """Batched KV-cache decode throughput: the serving-side counterpart of
     the training rows. Prefills `batch` slots, then times `new_tokens`
-    continuous decode steps through DecodeEngine (the same loop the serve
-    replica drives), reporting tokens/s/chip. The batched-vs-serial gate
-    lives in microbench.py; this row is the absolute rate."""
+    continuous decode steps through the PAGED engine (the same loop the
+    serve replica drives — block-table gather attention, so the row also
+    tracks the paging overhead), reporting tokens/s/chip plus block-pool
+    utilization and preemptions. The batched-vs-serial and prefix-hit
+    gates live in microbench.py; this row is the absolute rate."""
     import dataclasses
 
     import jax
     import numpy as np
 
     from ray_tpu.models import CONFIGS
-    from ray_tpu.models.decoding import DecodeEngine
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
 
     dev = jax.devices()[0]
     on_tpu = _on_tpu(dev)
@@ -262,7 +264,7 @@ def main_decode():
         cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
         batch, prompt_len, new_tokens = 4, 16, 32
 
-    engine = DecodeEngine(cfg, max_batch_size=batch, seed=0)
+    engine = PagedDecodeEngine(cfg, max_batch_size=batch, seed=0)
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, cfg.vocab_size, size=(batch, prompt_len))
     slots = list(range(batch))
@@ -278,12 +280,14 @@ def main_decode():
     dt = time.perf_counter() - t0
 
     tokens_per_sec_per_chip = batch * new_tokens / dt / n_chips
+    estats = engine.stats()
     kind = getattr(dev, "device_kind", dev.platform)
     print(
         f"[bench:decode] dev={kind} chips={n_chips} batch={batch} "
         f"prompt={prompt_len} new={new_tokens} "
         f"prefill={prefill_s * 1000:.0f}ms step={dt / new_tokens * 1000:.2f}ms "
-        f"tok/s/chip={tokens_per_sec_per_chip:.1f}",
+        f"tok/s/chip={tokens_per_sec_per_chip:.1f} "
+        f"kv_util={estats['kv_block_utilization']}",
         file=sys.stderr,
     )
     print(
@@ -300,6 +304,11 @@ def main_decode():
                 "new_tokens": new_tokens,
                 "prefill_ms": round(prefill_s * 1000, 1),
                 "decode_step_ms": round(dt / new_tokens * 1000, 3),
+                # paged-KV observability: live fraction of the block pool
+                # at the end of the timed run + preemptions (nonzero means
+                # the pool was undersized for this batch/length mix)
+                "kv_block_utilization": estats["kv_block_utilization"],
+                "preemptions": estats["preemptions"],
             }
         )
     )

@@ -195,6 +195,23 @@ D("serve_stream_idle_reap_s", float, 120.0,
   "a registered replica stream nobody has pulled for this long is "
   "cancelled and dropped — an abandoned consumer must not inflate "
   "num_ongoing (wedging drain) or hold a decode slot forever")
+# --- paged KV cache (models/kv_paging.py) ---
+# Read in the replica process at PagedDecodeEngine construction (env vars
+# or explicit constructor args).
+D("serve_kv_block_tokens", int, 64,
+  "tokens per physical KV-cache block: the paging granularity — smaller "
+  "blocks waste less tail memory and share finer prefixes but grow the "
+  "block tables; 64 keeps the minor gather dim MXU/lane aligned")
+D("serve_kv_cache_blocks", int, 0,
+  "total physical blocks in a PagedDecodeEngine's pool (0 = dense "
+  "equivalent: max_batch_size * ceil(max_seq_len/block_tokens), + the "
+  "reserved null block); set below dense to oversubscribe HBM — prefix "
+  "reuse and preemption keep oversubscription safe")
+D("serve_kv_prefix_cache", bool, True,
+  "keep full prompt blocks in a hash-trie after release so identical "
+  "prompt prefixes (system prompts, few-shot headers) share physical "
+  "blocks and skip prefill for the shared span; cache-held blocks are "
+  "evicted LRU under pool pressure")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

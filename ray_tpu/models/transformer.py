@@ -22,6 +22,12 @@ attention reads instead of the O(L^2) full-sequence forward. The decode
 step is jit-compiled once (per cache batch size) and reused; see
 `ray_tpu/models/decoding.py` for the slot-based engine continuous
 batching drives.
+
+Paged variant (serving at scale): `init_paged_kv_cache` + `make_paged_decoder`
+swap the per-slot slab for a pool of fixed-size token blocks addressed
+through per-slot block tables (gathered inside the jitted step — one
+compiled shape regardless of live lengths). Host-side allocation, prefix
+reuse and preemption live in `ray_tpu/models/kv_paging.py`.
 """
 
 from __future__ import annotations
@@ -573,6 +579,231 @@ def init_kv_cache(
     return {"k": k, "v": v}
 
 
+def _make_sampler(temperature: float):
+    """Greedy argmax (temperature 0) or categorical sampling — ONE
+    implementation shared by the dense and paged decoders, so their
+    token-for-token parity cannot drift."""
+
+    def _sample(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature, axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _sample
+
+
+def _unembed_matrix(cfg: TransformerConfig, params):
+    u = params.get("unembed")
+    if u is None:
+        u = params["embed"].T
+    return u.astype(cfg.dtype)
+
+
+def _cached_attend(q, kc, vc, mask, scale, n_rep):
+    """Attention over cache-layout K/V — the single softmax formulation
+    both the dense decode step and the paged prefill/decode steps use
+    (shared so paged == dense stays bit-identical by construction).
+
+    q [B,Sq,H,D]; kc/vc [B,W,KV,D]; mask [B,Sq,W] (True = attend)."""
+    kr = _repeat_kv(kc, n_rep)
+    vr = _repeat_kv(vc, n_rep)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+    ) * scale
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vr.dtype), vr)
+
+
+def init_paged_kv_cache(
+    cfg: TransformerConfig,
+    num_blocks: int,
+    block_tokens: int,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+):
+    """Allocate the pooled (paged) per-layer KV cache: `num_blocks` physical
+    blocks of `block_tokens` tokens each, shared by every decode slot via
+    per-slot block tables. The logical axes are the same KV_CACHE_AXES as
+    the dense cache — the block dim takes the "batch" axis (dp/fsdp), so
+    the pool shards exactly like the dense slot dim under every existing
+    mesh preset. Block 0 is reserved as the null block: padded table
+    entries and masked-token writes route there (see kv_paging.py)."""
+    shape = (cfg.n_layers, num_blocks, block_tokens, cfg.n_kv_heads, cfg.d_head)
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    if mesh is not None and rules is not None:
+        from ..parallel.sharding import logical_sharding
+
+        sh = logical_sharding(mesh, rules, *KV_CACHE_AXES)
+        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+    return {"k": k, "v": v}
+
+
+def make_paged_decoder(
+    cfg: TransformerConfig,
+    rules: Optional[ShardingRules] = None,
+    mesh=None,
+    temperature: float = 0.0,
+    block_tokens: int = 64,
+):
+    """Build the paged fast path: (paged_prefill, paged_decode_step,
+    copy_blocks) over a block pool from `init_paged_kv_cache`.
+
+    paged_prefill(params, pool, table[Nmax], tokens[1,Sb], length, ctx_len,
+                  key, ctx_blocks) -> (next_token[1], logits[1,V], pool)
+      B=1 prefill of a prompt SUFFIX whose first `ctx_len` tokens (a block
+      multiple) are already in the pool (prefix-cache hit; 0 for a cold
+      prompt). Suffix K/V is scattered into the slot's table blocks and
+      attention runs over the gathered block window, so the shared span is
+      never recomputed. `ctx_blocks` is STATIC (bucketed by the caller —
+      kv_paging pads block counts to the same bucket boundaries as prompt
+      lengths) and keys the compile cache together with the suffix bucket.
+
+    paged_decode_step(params, pool, tables[B,Nmax], tokens[B],
+                      positions[B], write_phys[B], write_off[B], key)
+        -> (next_tokens[B], logits[B,V], pool)
+      One cached decode step for every slot: the new K/V is written at the
+      host-resolved (physical block, offset) pair — inactive slots route to
+      the null block — and attention gathers each slot's logical sequence
+      via its block table. ONE compiled shape per (B, Nmax) regardless of
+      live sequence lengths or block-table contents.
+
+    copy_blocks(pool, src[n], dst[n]) -> pool
+      Copy-on-write: duplicate physical blocks across all layers (refcount
+      divergence handled host-side in kv_paging.BlockAllocator).
+
+    The gather materializes [B, Nmax*block_tokens] keys per layer — the
+    jit-level paged-attention shape (a fused Pallas gather kernel is the
+    TPU follow-up); correctness and the one-compiled-shape property are
+    what this path buys today.
+    """
+    if cfg.pp_stages > 1:
+        raise NotImplementedError("decode does not support pp_stages > 1")
+    bt = int(block_tokens)
+    if bt <= 0:
+        raise ValueError(f"block_tokens must be positive, got {bt}")
+    cos, sin = rope_frequencies(cfg.d_head, cfg.max_seq_len, cfg.rope_theta)
+    scale = cfg.d_head**-0.5
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def _constrain(x, *axes):
+        if rules is None or mesh is None:
+            return x
+        return constrain(x, rules, *axes, mesh=mesh)
+
+    _sample = _make_sampler(temperature)
+
+    def _prefill_body(G, params, pool, table, tokens, length, ctx_len, key):
+        params = _cast_matmul_params(cfg, params)
+        Sb = tokens.shape[1]
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = _constrain(x, "batch", "seq", "embed")
+        qpos = ctx_len + jnp.arange(Sb)  # global positions of the suffix
+        valid_tok = jnp.arange(Sb) < length
+        # padded suffix tokens write into the null block (0), never into a
+        # real one; real tokens land at table[pos // bt] offset pos % bt
+        w_phys = jnp.where(valid_tok, table[qpos // bt], 0)
+        w_off = qpos % bt
+        window = table[:G]
+        # window position j holds global position j; key j is visible to
+        # query at global position p iff j <= p (ctx + causal in one mask)
+        kmask = (jnp.arange(G * bt)[None, :] <= qpos[:, None])[None]
+
+        def layer_fn(x, per_layer):
+            lp, kc, vc = per_layer
+            h = rms_norm(x, lp["attn_norm"])
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
+            q = apply_rope(q, cos, sin, positions=qpos[None])
+            k = apply_rope(k, cos, sin, positions=qpos[None])
+            q = _constrain(q, "batch", "seq", "heads", "head_dim")
+            # write the suffix K/V, then gather the window back — suffix
+            # keys come from the pool, so cache content is authoritative
+            kc = kc.at[w_phys, w_off].set(k[0].astype(kc.dtype))
+            vc = vc.at[w_phys, w_off].set(v[0].astype(vc.dtype))
+            kw = kc[window].reshape(1, G * bt, *kc.shape[2:])
+            vw = vc[window].reshape(1, G * bt, *vc.shape[2:])
+            attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"])
+            x = x + _mlp(h2, lp, cfg, _constrain)
+            x = _constrain(x, "batch", "seq", "embed")
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            layer_fn, x, (params["layers"], pool["k"], pool["v"])
+        )
+        x = rms_norm(x, params["final_norm"])
+        x_last = x[0, jnp.maximum(length - 1, 0)][None]
+        logits = jnp.einsum("be,ev->bv", x_last, _unembed_matrix(cfg, params))
+        logits = _constrain(logits, "batch", "vocab")
+        return _sample(logits, key), logits, {"k": k_new, "v": v_new}
+
+    _prefill_jits: Dict[int, Any] = {}
+
+    def paged_prefill(params, pool, table, tokens, length, ctx_len, key,
+                      ctx_blocks: int):
+        Sb = tokens.shape[1]
+        G = min(int(ctx_blocks) + -(-Sb // bt), table.shape[0])
+        fn = _prefill_jits.get(G)
+        if fn is None:
+            fn = jax.jit(partial(_prefill_body, G), donate_argnums=(1,))
+            _prefill_jits[G] = fn
+        return fn(params, pool, table, tokens, length, ctx_len, key)
+
+    def _decode_body(params, pool, tables, tokens, positions, write_phys,
+                     write_off, key):
+        params = _cast_matmul_params(cfg, params)
+        B, Nmax = tables.shape
+        W = Nmax * bt
+        x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # [B,1,E]
+        x = _constrain(x, "batch", "seq", "embed")
+        pos2 = positions[:, None]
+        kmask = (jnp.arange(W)[None, :] <= pos2)[:, None, :]  # [B,1,W]
+
+        def layer_fn(x, per_layer):
+            lp, kc, vc = per_layer
+            h = rms_norm(x, lp["attn_norm"])
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])  # [B,1,H,D]
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])  # [B,1,KV,D]
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
+            q = apply_rope(q, cos, sin, positions=pos2)
+            k = apply_rope(k, cos, sin, positions=pos2)
+            kc = kc.at[write_phys, write_off].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[write_phys, write_off].set(v[:, 0].astype(vc.dtype))
+            kw = kc[tables].reshape(B, W, *kc.shape[2:])
+            vw = vc[tables].reshape(B, W, *vc.shape[2:])
+            attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"])
+            x = x + _mlp(h2, lp, cfg, _constrain)
+            x = _constrain(x, "batch", "seq", "embed")
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            layer_fn, x, (params["layers"], pool["k"], pool["v"])
+        )
+        x = rms_norm(x, params["final_norm"])
+        logits = jnp.einsum("be,ev->bv", x[:, 0], _unembed_matrix(cfg, params))
+        logits = _constrain(logits, "batch", "vocab")
+        return _sample(logits, key), logits, {"k": k_new, "v": v_new}
+
+    def _copy_body(pool, src, dst):
+        k = pool["k"]
+        v = pool["v"]
+        return {"k": k.at[:, dst].set(k[:, src]),
+                "v": v.at[:, dst].set(v[:, src])}
+
+    paged_decode_step = jax.jit(_decode_body, donate_argnums=(1,))
+    copy_blocks = jax.jit(_copy_body, donate_argnums=(0,))
+    return paged_prefill, paged_decode_step, copy_blocks
+
+
 def make_decoder(
     cfg: TransformerConfig,
     rules: Optional[ShardingRules] = None,
@@ -614,18 +845,7 @@ def make_decoder(
             return x
         return constrain(x, rules, *axes, mesh=mesh)
 
-    def _sample(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(
-                key, logits.astype(jnp.float32) / temperature, axis=-1
-            ).astype(jnp.int32)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    def _unembed(params):
-        u = params.get("unembed")
-        if u is None:
-            u = params["embed"].T
-        return u.astype(cfg.dtype)
+    _sample = _make_sampler(temperature)
 
     def _prefill(params, tokens, lengths, key):
         params = _cast_matmul_params(cfg, params)
@@ -653,7 +873,7 @@ def make_decoder(
         # lengths-1 produces garbage states that are never read)
         B = tokens.shape[0]
         x_last = x[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
-        logits = jnp.einsum("be,ev->bv", x_last, _unembed(params))
+        logits = jnp.einsum("be,ev->bv", x_last, _unembed_matrix(cfg, params))
         logits = _constrain(logits, "batch", "vocab")
         return _sample(logits, key), logits, ks, vs
 
@@ -686,15 +906,7 @@ def make_decoder(
             # write this token's K/V at each slot's own position
             kc = kc.at[rows, pos2].set(k.astype(kc.dtype))
             vc = vc.at[rows, pos2].set(v.astype(vc.dtype))
-            kr = _repeat_kv(kc, n_rep)
-            vr = _repeat_kv(vc, n_rep)
-            logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
-            ) * scale  # [B,H,1,S]
-            logits = jnp.where(kvalid[:, None, None, :], logits, NEG_INF)
-            probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
-            probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vr.dtype), vr)
+            attn = _cached_attend(q, kc, vc, kvalid[:, None, :], scale, n_rep)
             x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"])
             x = x + _mlp(h2, lp, cfg, _constrain)
@@ -705,7 +917,7 @@ def make_decoder(
             layer_decode, x, (params["layers"], cache["k"], cache["v"])
         )
         x = rms_norm(x, params["final_norm"])
-        logits = jnp.einsum("be,ev->bv", x[:, 0], _unembed(params))
+        logits = jnp.einsum("be,ev->bv", x[:, 0], _unembed_matrix(cfg, params))
         logits = _constrain(logits, "batch", "vocab")
         return _sample(logits, key), logits, {"k": k_new, "v": v_new}
 

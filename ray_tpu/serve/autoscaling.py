@@ -11,6 +11,13 @@ slots are saturated is at capacity even while its request queue is still
 shallow — per-token streaming means ongoing-request counts understate load
 until latency has already degraded. The desired replica count is the max of
 the queue-depth target and the slot-occupancy target.
+
+Paged-KV extension: replicas over a PagedDecodeEngine additionally report
+block-pool headroom ("kv_blocks_total"/"kv_blocks_free"). Block saturation
+is a THIRD scale signal, independent of the other two: long-prompt traffic
+can exhaust the pool (forcing preemption/recompute churn) while slots sit
+free and the queue stays shallow. Desired replicas is the max of all three
+targets.
 """
 
 from __future__ import annotations
@@ -27,10 +34,14 @@ def calculate_desired_num_replicas(
     *,
     batch_slots: float = 0.0,
     batch_load: float = 0.0,
+    kv_blocks_total: float = 0.0,
+    kv_blocks_free: float = 0.0,
 ) -> int:
     """batch_slots: total generation slots across the deployment's current
     replicas; batch_load: active + queued generations against those slots.
-    Both default to 0 (no batcher -> pure queue-depth policy)."""
+    kv_blocks_total/kv_blocks_free: aggregate paged-KV pool size and
+    headroom across the replicas. All default to 0 (no batcher / no paged
+    engine -> the corresponding signal is off)."""
     if current_replicas == 0:
         return config.min_replicas
     desired = math.ceil(total_ongoing_requests / max(config.target_ongoing_requests, 1e-9))
@@ -42,4 +53,13 @@ def calculate_desired_num_replicas(
         target = max(config.target_batch_occupancy, 1e-9)
         desired_batch = math.ceil(batch_load / (slots_per_replica * target))
         desired = max(desired, desired_batch)
+    if kv_blocks_total > 0:
+        # same shape for block saturation: blocks_per_replica is a
+        # replica-count invariant, so desired_kv spreads the in-use blocks
+        # until per-replica utilization lands at target_kv_utilization
+        blocks_per_replica = kv_blocks_total / current_replicas
+        kv_used = max(0.0, kv_blocks_total - kv_blocks_free)
+        target = max(config.target_kv_utilization, 1e-9)
+        desired_kv = math.ceil(kv_used / (blocks_per_replica * target))
+        desired = max(desired, desired_kv)
     return max(config.min_replicas, min(config.max_replicas, desired))

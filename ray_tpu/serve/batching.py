@@ -30,6 +30,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -165,6 +166,7 @@ class GenerationStream:
         self.request = request
         self.cut = False        # drain deadline truncated this generation
         self.cancelled = False  # consumer went away
+        self.preempted = False  # evicted under KV pressure, parked to resume
         self._q: "queue.Queue" = queue.Queue()
         self._finished = threading.Event()
         self._error: Optional[BaseException] = None
@@ -251,6 +253,23 @@ class ContinuousBatcher:
     mid-generation of everyone else (that is the whole point). The
     per-step occupancy log (`occupancy_log()`) records which requests
     shared each engine step; tests use it to prove interleaving.
+
+    Paging-aware engines (ray_tpu.models.kv_paging.PagedDecodeEngine) are
+    driven through two optional duck-typed hooks:
+
+      can_admit(request) -> bool   block-budget admission: a request whose
+        worst-case KV-block need exceeds the pool's current headroom waits
+        at the head of the line (order preserved) instead of thrashing —
+        unless NOTHING is running, in which case it is admitted
+        best-effort so a lone oversized request still gets a clear error
+        rather than queueing forever.
+      take_preempted() -> [(slot, parked_request)]   generations the
+        engine evicted under pool exhaustion: their stream stays OPEN and
+        the parked request (prompt + tokens generated so far) re-enters at
+        the head of the admission line — on readmit the engine recomputes
+        the cache and the stream resumes exactly where it stopped, so the
+        consumer (an SSE socket, an iter_stream caller) never notices
+        beyond latency.
     """
 
     _serve_drainable = True
@@ -280,6 +299,20 @@ class ContinuousBatcher:
             if batch_wait_timeout_s is None else batch_wait_timeout_s
         )
         self._pending: "queue.Queue[GenerationStream]" = queue.Queue()
+        # head-of-line parking: preempted generations awaiting readmission
+        # and requests the engine's block budget cannot cover yet — checked
+        # before the pending queue so admission order is preserved
+        self._holdback: "deque" = deque()
+        # items popped from holdback/pending but not yet admitted ("in
+        # hand"): counted as ongoing so a drain poll sampling mid-gather
+        # never sees a momentarily-empty replica and reaps an open stream
+        self._in_hand = 0
+        # memoized verdict for the parked head-of-line request: pool
+        # headroom only changes on retire/preempt/admit, so the per-step
+        # can_admit recheck (prompt hashing + cache scan) is skipped until
+        # one of those happens
+        self._admission_verdict: Optional[Tuple[int, bool]] = None
+        self._admission_dirty = True
         self._free = list(range(self.max_batch_size))
         self._active: Dict[int, GenerationStream] = {}
         self._ids = itertools.count()
@@ -289,8 +322,6 @@ class ContinuousBatcher:
         self._shutdown = False
         self._steps = 0
         # bounded: observability for tests/operators, not a flight recorder
-        from collections import deque
-
         self._occupancy: "deque" = deque(maxlen=65536)
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
@@ -328,6 +359,7 @@ class ContinuousBatcher:
         no consumer is left blocking on a loop thread that exited."""
         self._shutdown = True
         self._bounce_pending()
+        self._cut_parked()
         with self._lock:
             active = list(self._active.values())
             self._active.clear()
@@ -340,24 +372,52 @@ class ContinuousBatcher:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "active": len(self._active),
                 "free_slots": len(self._free),
-                "queued": self._pending.qsize(),
+                "queued": self._pending.qsize() + len(self._holdback),
                 "steps": self._steps,
                 "draining": self._draining,
                 "max_batch_size": self.max_batch_size,
             }
+        # free-block headroom from paging-aware engines: the autoscaler's
+        # third scale signal and the admission gate's observability
+        get_stats = getattr(self.engine, "stats", None)
+        if get_stats is not None:
+            try:
+                es = get_stats()
+            except Exception:
+                es = None
+            if isinstance(es, dict):
+                for k in ("kv_blocks_total", "kv_blocks_free",
+                          "kv_blocks_cached", "preemptions", "prefix_hits"):
+                    if k in es:
+                        out[k] = es[k]
+        return out
 
     def num_ongoing(self) -> int:
         with self._lock:
-            return len(self._active) + self._pending.qsize()
+            return (len(self._active) + self._pending.qsize()
+                    + len(self._holdback) + self._in_hand)
 
     # -------------------------------------------------------------- internals
 
     def _bounce_pending(self) -> None:
+        """Fail queued-but-unadmitted requests with the retryable drain
+        error. Preempted holdback streams already emitted tokens through
+        THIS replica, so they cannot be re-routed — they stay parked for
+        readmission until the drain deadline cuts them."""
         from .replica import ReplicaDrainingError
 
+        keep = []
+        with self._lock:
+            while self._holdback:
+                item = self._holdback.popleft()
+                if item[0].preempted:
+                    keep.append(item)
+                else:
+                    item[0]._finish(error=ReplicaDrainingError())
+            self._holdback.extend(keep)
         while True:
             try:
                 stream = self._pending.get_nowait()
@@ -365,50 +425,173 @@ class ContinuousBatcher:
                 return
             stream._finish(error=ReplicaDrainingError())
 
-    def _admit_one(self, stream: GenerationStream) -> None:
-        if stream.cancelled:
-            stream._finish()
-            return
+    def _cut_parked(self) -> None:
+        """Terminal: cut preempted streams still parked (drain deadline or
+        close — they can never resume here)."""
+        with self._lock:
+            parked = list(self._holdback)
+            self._holdback.clear()
+        for stream, _ in parked:
+            stream._finish(cut=True)
+
+    def _admissible(self, stream: GenerationStream,
+                    request: Dict[str, Any]) -> bool:
+        can = getattr(self.engine, "can_admit", None)
+        if can is None:
+            return True
+        # the verdict for the parked head item is stable until a retire /
+        # preemption / admission changes the pool — skip the recheck
+        # (prompt hashing + cache scan) on the per-step hot path until then
+        rid = stream.request_id
+        if (not self._admission_dirty
+                and self._admission_verdict is not None
+                and self._admission_verdict[0] == rid):
+            return self._admission_verdict[1]
+        try:
+            verdict = bool(can(request))
+        except Exception:
+            return True  # a broken budget check must not wedge admission
+        self._admission_verdict = (rid, verdict)
+        self._admission_dirty = False
+        return verdict
+
+    def _admit_one(self, stream: GenerationStream,
+                   request: Optional[Dict[str, Any]] = None) -> bool:
+        """Admit into a free slot; returns False when the request was
+        PARKED for lack of KV blocks (the caller must stop gathering this
+        round or it would spin on the same head-of-line item)."""
+        if request is None:
+            request = stream.request
+        if stream.cancelled or stream.finished:
+            if not stream.finished:
+                stream._finish()
+            return True
         with self._lock:
             slot = self._free.pop()
             self._active[slot] = stream
         try:
-            tok, done = self.engine.admit(slot, stream.request)
+            tok, done = self.engine.admit(slot, request)
         except Exception as e:  # noqa: BLE001 — bad request must not kill the loop
+            import sys
+
+            kvmod = sys.modules.get("ray_tpu.models.kv_paging")
+            if kvmod is not None and isinstance(
+                    e, kvmod.InsufficientBlocksError):
+                # pool can't cover the prompt right now: park for retry —
+                # blocks free as running generations retire (a prompt that
+                # can NEVER fit raises ValueError instead and fails here)
+                with self._lock:
+                    self._active.pop(slot, None)
+                    self._free.append(slot)
+                    self._holdback.appendleft((stream, request))
+                return False
             stream._finish(error=e)
             self._retire(slot)
-            return
+            return True
         stream._push(tok)
         if done:
             stream._finish()
             self._retire(slot)
+        return True
 
     def _retire(self, slot: int) -> None:
         with self._lock:
             self._active.pop(slot, None)
             self._free.append(slot)
+            self._admission_dirty = True  # freed blocks: recheck parked head
         release = getattr(self.engine, "release", None)
         if release is not None:
             release(slot)
 
     def _gather(self, first_timeout: float) -> None:
-        """Admit pending requests into free slots: block up to
-        first_timeout for the first one (idle parking / coalescing),
-        then take whatever else is already queued."""
+        """Admit queued work into free slots: holdback (preempted /
+        budget-parked, order preserved) first, then the pending queue —
+        blocking up to first_timeout for the first pending item (idle
+        parking / coalescing), then taking whatever else is ready."""
         block = first_timeout
         while self._free and not self._shutdown:
+            with self._lock:
+                item = self._holdback.popleft() if self._holdback else None
+                if item is not None:
+                    self._in_hand += 1
+            if item is None:
+                try:
+                    stream = self._pending.get(timeout=block)
+                except queue.Empty:
+                    return
+                # counted the instant the pop returns (before the lengthy
+                # admissibility check) so a drain poll never sees the
+                # stream in neither queue nor batch; counting BEFORE the
+                # blocking get would instead report a phantom ongoing
+                # request on every idle batcher
+                with self._lock:
+                    self._in_hand += 1
+                item = (stream, stream.request)
             try:
-                stream = self._pending.get(timeout=block)
-            except queue.Empty:
-                return
-            block = 0.0
-            self._admit_one(stream)
+                block = 0.0
+                stream, request = item
+                if not self._admissible(stream, request):
+                    with self._lock:
+                        busy = bool(self._active)
+                        if busy:
+                            # head-of-line wait: blocks free as the running
+                            # batch retires; admitting past budget would
+                            # only force preemption churn
+                            self._holdback.appendleft(item)
+                    if busy:
+                        return
+                    # nothing running to free blocks: admit best-effort so
+                    # the request either squeezes in (cache eviction) or
+                    # fails with the engine's real error instead of
+                    # parking forever
+                if not self._admit_one(stream, request):
+                    return
+                with self._lock:
+                    self._admission_dirty = True  # pool changed: recheck
+            finally:
+                with self._lock:
+                    self._in_hand -= 1
+
+    def _absorb_preempted(self) -> None:
+        """Park engine-evicted generations (stream stays open) at the head
+        of the admission line for recompute-on-readmit."""
+        take = getattr(self.engine, "take_preempted", None)
+        if take is None:
+            return
+        try:
+            evicted = take() or ()
+        except Exception:
+            return
+        for slot, parked in reversed(list(evicted)):
+            with self._lock:
+                stream = self._active.pop(slot, None)
+                if slot not in self._free:
+                    self._free.append(slot)
+            if stream is None:
+                continue
+            if stream.cancelled:
+                stream._finish()
+                continue
+            stream.preempted = True
+            with self._lock:
+                self._holdback.appendleft((stream, parked))
+                self._admission_dirty = True  # blocks freed by the eviction
 
     def _loop(self) -> None:
         while not self._shutdown:
             if not self._active:
                 if self._draining:
                     self._bounce_pending()
+                    # preempted generations parked in holdback are
+                    # in-flight work: keep readmitting them until done or
+                    # the drain deadline cuts them
+                    with self._lock:
+                        has_parked = bool(self._holdback)
+                    if has_parked:
+                        self._gather(first_timeout=0.0)
+                    if (self._draining and self._drain_deadline is not None
+                            and time.monotonic() >= self._drain_deadline):
+                        self._cut_parked()
                     if not self._active:
                         time.sleep(0.01)
                         continue
@@ -439,12 +622,25 @@ class ContinuousBatcher:
             try:
                 results = self.engine.step(slots)
             except Exception as e:  # noqa: BLE001 — engine fault fails the batch
+                # discard any preemptions staged before the fault: their
+                # streams are errored with everyone else's below, and a
+                # stale parked entry must never hijack the slot's NEXT
+                # stream on a later successful step
+                take = getattr(self.engine, "take_preempted", None)
+                if take is not None:
+                    try:
+                        take()
+                    except Exception:
+                        pass
                 for slot in slots:
                     stream = self._active.get(slot)
                     if stream is not None:
                         stream._finish(error=e)
                     self._retire(slot)
                 continue
+            # slots the engine preempted mid-step are absent from results:
+            # park their streams (still open) for recompute-on-readmit
+            self._absorb_preempted()
             self._steps += 1
             self._occupancy.append((self._steps, len(slots), ids))
             for slot, (tok, done) in results.items():
@@ -459,7 +655,7 @@ class ContinuousBatcher:
                 if done:
                     stream._finish()
                     self._retire(slot)
-            # drain deadline: cut whatever is still running
+            # drain deadline: cut whatever is still running or parked
             if (self._draining and self._drain_deadline is not None
                     and time.monotonic() >= self._drain_deadline):
                 with self._lock:
@@ -467,3 +663,4 @@ class ContinuousBatcher:
                 for slot, stream in leftover.items():
                     stream._finish(cut=True)
                     self._retire(slot)
+                self._cut_parked()

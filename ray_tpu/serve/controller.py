@@ -458,9 +458,13 @@ class ServeController:
         batch_load = sum(
             s.get("batch_active", 0) + s.get("batch_queued", 0) for s in stats
         )
+        # paged-KV signal: block-pool saturation (0 total -> signal off)
+        kv_total = sum(s.get("kv_blocks_total", 0) for s in stats)
+        kv_free = sum(s.get("kv_blocks_free", 0) for s in stats)
         desired = calculate_desired_num_replicas(
             ac, total_ongoing, len(state.replicas),
             batch_slots=batch_slots, batch_load=batch_load,
+            kv_blocks_total=kv_total, kv_blocks_free=kv_free,
         )
         now = time.time()
         delay = ac.upscale_delay_s if desired > state.target else ac.downscale_delay_s

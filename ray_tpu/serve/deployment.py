@@ -24,6 +24,11 @@ class AutoscalingConfig:
     # Desired replicas also satisfies: load_fraction <= target_batch_occupancy,
     # where load_fraction = (active + queued generations) / total slots.
     target_batch_occupancy: float = 0.8
+    # paged-KV third signal: replicas over a PagedDecodeEngine scale up
+    # when aggregate block-pool utilization exceeds this — long-prompt
+    # traffic exhausts blocks (preemption/recompute churn) while slots and
+    # queue depth still look healthy
+    target_kv_utilization: float = 0.85
 
 
 @dataclass

@@ -260,6 +260,7 @@ class Replica:
         the decode-aware autoscaling signal: a generation-bound replica is
         saturated when its SLOTS are, long before queued-call counts say so."""
         slots = active = queued = 0
+        kv_total = kv_free = preempt = 0
         for v in self._drainables():
             get_stats = getattr(v, "stats", None)
             if get_stats is None:
@@ -273,8 +274,20 @@ class Replica:
             slots += int(s.get("max_batch_size", 0))
             active += int(s.get("active", 0))
             queued += int(s.get("queued", 0))
+            # paged-KV headroom (ContinuousBatchers over a
+            # PagedDecodeEngine): block saturation is the third scale-up
+            # signal — a replica can have free SLOTS yet no blocks left
+            # for long prompts, which queue depth never shows
+            kv_total += int(s.get("kv_blocks_total", 0))
+            # prefix-cache-held blocks are HEADROOM, not load: they evict
+            # on demand, so counting them as used would ratchet a warm
+            # idle deployment up to max_replicas and block downscaling
+            kv_free += (int(s.get("kv_blocks_free", 0))
+                        + int(s.get("kv_blocks_cached", 0)))
+            preempt += int(s.get("preemptions", 0))
         return {"batch_slots": slots, "batch_active": active,
-                "batch_queued": queued}
+                "batch_queued": queued, "kv_blocks_total": kv_total,
+                "kv_blocks_free": kv_free, "kv_preemptions": preempt}
 
     def stats(self) -> Dict[str, Any]:
         self._reap_idle_streams()
