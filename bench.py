@@ -285,6 +285,7 @@ def main_decode():
     print(
         f"[bench:decode] dev={kind} chips={n_chips} batch={batch} "
         f"prompt={prompt_len} new={new_tokens} "
+        f"attn={estats['attention_impl']} kv_dtype={estats['kv_cache_dtype']} "
         f"prefill={prefill_s * 1000:.0f}ms step={dt / new_tokens * 1000:.2f}ms "
         f"tok/s/chip={tokens_per_sec_per_chip:.1f} "
         f"kv_util={estats['kv_block_utilization']}",
@@ -304,6 +305,11 @@ def main_decode():
                 "new_tokens": new_tokens,
                 "prefill_ms": round(prefill_s * 1000, 1),
                 "decode_step_ms": round(dt / new_tokens * 1000, 3),
+                # which decode fast path produced this number — BENCH_r*
+                # trajectories stay comparable across the fused/int8 change
+                # ("gather"+"fp" rows are the pre-fused lineage)
+                "attention_variant": estats["attention_impl"],
+                "kv_dtype": estats["kv_cache_dtype"],
                 # paged-KV observability: live fraction of the block pool
                 # at the end of the timed run + preemptions (nonzero means
                 # the pool was undersized for this batch/length mix)

@@ -210,6 +210,112 @@ def bench_decode_speedup(new_tokens: int = 48) -> dict:
     }
 
 
+def bench_decode_long_context(
+    prefix_tokens: int = 0, batch: int = 2, new_tokens: int = 12,
+) -> dict:
+    """Long-context decode: the HBM-bound regime where paged attention's
+    cost actually lives (a 4k-token prefix means every decode step reads
+    ~4k tokens of K/V per layer — bandwidth, not compute). Three engines
+    decode the same prompts:
+
+      gather/fp   the block-table gather step (pre-fused reference path)
+      fused/fp    ops/paged_attention block-in-place walk, same bytes read
+      fused/int8  + int8 blocks: half the bytes per resident token
+
+    Gated: fused/fp must BEAT gather/fp at the same dtype (the kernel win,
+    isolated from quantization), and the int8 pool must hold ~2x the
+    blocks of the fp pool for the same byte budget (the capacity win
+    admission/autoscaling sees). The prefix admits in chunks through the
+    prefix cache — each admit reuses the prior chunks' blocks — so setup
+    stays ~linear instead of one quadratic 4k prefill."""
+    import dataclasses
+
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS, init_params
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.models.transformer import paged_kv_block_bytes
+
+    import jax
+    import jax.numpy as jnp
+
+    prefix_tokens = prefix_tokens or int(
+        os.environ.get("RAY_TPU_MICROBENCH_LONGCTX_TOKENS", "4096")
+    )
+    chunk = 1024
+    bt = 64
+    cfg = dataclasses.replace(
+        CONFIGS["tiny"], dtype=jnp.float32, max_seq_len=prefix_tokens + 2 * bt
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch, prefix_tokens)
+    )
+
+    def build(impl, dtype):
+        eng = PagedDecodeEngine(
+            cfg, params, max_batch_size=batch, block_tokens=bt,
+            attention_impl=impl, kv_cache_dtype=dtype, seed=0,
+            prefill_buckets=(chunk,),
+        )
+        for s in range(batch):
+            for end in range(chunk, prefix_tokens + 1, chunk):
+                eng.admit(s, {"tokens": prompts[s][:end],
+                              "max_new_tokens": 10**9})
+                if end < prefix_tokens:
+                    eng.release(s)
+        eng.step(list(range(batch)))  # compile + warm
+        return eng
+
+    # a 12-token timed window on a shared host is one scheduler hiccup
+    # away from inverting the comparison, and timing the engines
+    # back-to-back lets slow drift (thermal, co-tenant load) bias one
+    # side. So: build + warm all three, then INTERLEAVE timed repeats
+    # round-robin and keep each engine's best — best-of-repeats is the
+    # noise-free estimate, interleaving makes drift hit all three alike.
+    engines = {
+        "gather_fp": build("gather", "fp"),
+        "fused_fp": build("fused", "fp"),
+        "fused_int8": build("fused", "int8"),
+    }
+    slots = list(range(batch))
+    best = {name: 0.0 for name in engines}
+    for _ in range(3):
+        for name, eng in engines.items():
+            t0 = time.perf_counter()
+            for _ in range(new_tokens):
+                eng.step(slots)
+            r = batch * new_tokens / (time.perf_counter() - t0)
+            best[name] = max(best[name], r)
+    gather_fp = best["gather_fp"]
+    fused_fp = best["fused_fp"]
+    fused_int8 = best["fused_int8"]
+
+    # capacity: same byte budget, blocks counted by the engine's own
+    # byte-budget sizing — int8 should land ~2x fp. The probe config uses
+    # bf16 (the serving dtype) so the ratio states the production claim;
+    # this engine above runs f32 only because CPU timing wants it
+    small = dataclasses.replace(
+        cfg, dtype=jnp.bfloat16, max_seq_len=4 * bt
+    )
+    budget = 64 * paged_kv_block_bytes(small, bt)
+    blocks = {}
+    for dtype in ("fp", "int8"):
+        e = PagedDecodeEngine(
+            small, params=None, max_batch_size=1, block_tokens=bt,
+            pool_bytes=budget, kv_cache_dtype=dtype, seed=0,
+        )
+        blocks[dtype] = e.stats()["kv_blocks_total"]
+    return {
+        "decode_long_context_tokens_per_s": round(fused_int8, 1),
+        "decode_long_context_fused_fp_tokens_per_s": round(fused_fp, 1),
+        "decode_long_context_gather_tokens_per_s": round(gather_fp, 1),
+        "decode_long_context_fused_speedup_x": round(fused_fp / gather_fp, 2),
+        "decode_long_context_int8_speedup_x": round(fused_int8 / gather_fp, 2),
+        "kv_int8_blocks_ratio": round(blocks["int8"] / blocks["fp"], 2),
+    }
+
+
 def bench_prefix_hit(trials: int = 3) -> dict:
     """Prefix-reuse win, gated: admitting a prompt whose prefix blocks are
     already in the PagedDecodeEngine's hash-trie must beat the cold admit
@@ -368,6 +474,7 @@ def _run_trial() -> dict:
     # decode runs BEFORE ray init: jax (CPU) claims its arena in a clean
     # process, and the cluster's workers never contend with the jit warmup
     out.update(bench_decode_speedup())
+    out.update(bench_decode_long_context())
     out.update(bench_prefix_hit())
     ray_tpu.init()
     out["task_submit_per_s"] = round(bench_task_submit(), 1)
@@ -390,7 +497,8 @@ def main():
 
     n_trials = int(os.environ.get("RAY_TPU_MICROBENCH_TRIALS", "5"))
     gated = ("task_submit_per_s", "actor_calls_sync_per_s", "put_100mb_gbps",
-             "decode_batched_speedup_x", "prefix_hit_speedup_x")
+             "decode_batched_speedup_x", "prefix_hit_speedup_x",
+             "decode_long_context_fused_speedup_x", "kv_int8_blocks_ratio")
     expected = set(gated) | {"host_memcpy_gbps"}
     trials = []
     # trial 0 is a WARMUP, discarded: it faults in the interpreter/page
@@ -438,7 +546,10 @@ def main():
     results = {"host_cpus": os.cpu_count(), "n_trials": len(trials)}
     for k in gated + ("host_memcpy_gbps", "decode_batched_tokens_per_s",
                       "decode_serial_tokens_per_s", "prefix_hit_cold_ms",
-                      "prefix_hit_ms"):
+                      "prefix_hit_ms", "decode_long_context_tokens_per_s",
+                      "decode_long_context_gather_tokens_per_s",
+                      "decode_long_context_fused_fp_tokens_per_s",
+                      "decode_long_context_int8_speedup_x"):
         vals = [t[k] for t in trials]
         results[k] = round(statistics.median(vals), 2)
         results[k + "_spread"] = round(
@@ -485,6 +596,14 @@ def main():
         # a prefix-cache hit must beat the cold prefill of the same prompt:
         # the paged-KV prefix-reuse win (shared-span prefill is skipped)
         "prefix_hit_speedup_x": 2.0,
+        # block-in-place paged attention must beat the block-table gather
+        # at the same dtype in the long-context (bandwidth-bound) decode
+        # regime — measured ~1.3x on CPU (the XLA online-softmax walk),
+        # larger on TPU where the Pallas kernel skips the gather entirely
+        "decode_long_context_fused_speedup_x": 1.1,
+        # int8 KV blocks must ~double pool capacity per byte (the
+        # concurrent-sequences win admission and autoscaling see)
+        "kv_int8_blocks_ratio": 1.8,
     }
     results["targets"] = {k: round(v, 2) for k, v in targets.items()}
     results["targets_met"] = all(results[k] >= v for k, v in targets.items())

@@ -207,6 +207,25 @@ D("serve_kv_cache_blocks", int, 0,
   "equivalent: max_batch_size * ceil(max_seq_len/block_tokens), + the "
   "reserved null block); set below dense to oversubscribe HBM — prefix "
   "reuse and preemption keep oversubscription safe")
+D("serve_kv_cache_dtype", str, "fp",
+  "paged KV-pool storage: 'fp' stores model dtype (the exact reference "
+  "path, bit-identical to dense decode); 'int8' stores int8 blocks with "
+  "per-block per-kv-head f32 scales — half the HBM per resident token, "
+  "~2x concurrent sequences per chip, quantize at cache write / dequant "
+  "at the attention read (greedy decode stays token-identical on the "
+  "parity suite; logits drift within the quantization tolerance)")
+D("serve_paged_attention", str, "auto",
+  "paged decode-step attention: 'gather' materializes each slot's "
+  "[Nmax*block] window through its block table (exact reference); "
+  "'fused' walks the table block-in-place (Pallas kernel on TPU, chunked "
+  "online softmax elsewhere — ops/paged_attention.py), so the gather "
+  "never exists; 'auto' = fused on TPU, gather on CPU; "
+  "'fused:kernel'/'fused:xla' force one fused backend (tests)")
+D("serve_kv_pool_mb", int, 0,
+  "size the paged KV pool by HBM budget instead of block count: "
+  "num_blocks = budget // block_bytes, so int8 pools hold ~2x the blocks "
+  "of bf16 for the same bytes; 0 = use serve_kv_cache_blocks / the "
+  "dense-equivalent default (explicit constructor args win over both)")
 D("serve_kv_prefix_cache", bool, True,
   "keep full prompt blocks in a hash-trie after release so identical "
   "prompt prefixes (system prompts, few-shot headers) share physical "

@@ -51,6 +51,7 @@ from .transformer import (
     init_paged_kv_cache,
     init_params,
     make_paged_decoder,
+    paged_kv_block_bytes,
 )
 
 
@@ -250,8 +251,12 @@ class PagedDecodeEngine:
         block_tokens: Optional[int] = None,
         num_blocks: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
+        kv_cache_dtype: Optional[str] = None,
+        attention_impl: Optional[str] = None,
+        pool_bytes: Optional[int] = None,
     ):
         import jax
+        import jax.numpy as jnp
 
         from ray_tpu._private.config import GLOBAL_CONFIG as gcfg
 
@@ -265,6 +270,60 @@ class PagedDecodeEngine:
         self.block_tokens = int(block_tokens or gcfg.serve_kv_block_tokens)
         bt = self.block_tokens
         self.blocks_per_slot = -(-self.max_seq_len // bt)
+
+        kv_cache_dtype = kv_cache_dtype or gcfg.serve_kv_cache_dtype
+        if kv_cache_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'fp' or 'int8', got {kv_cache_dtype!r}"
+            )
+        self.kv_cache_dtype = kv_cache_dtype
+        kv_dtype = jnp.int8 if kv_cache_dtype == "int8" else cfg.dtype
+        self.kv_block_bytes = paged_kv_block_bytes(cfg, bt, kv_dtype)
+
+        attention_impl = attention_impl or gcfg.serve_paged_attention
+        fused_impl = "auto"
+        if attention_impl.startswith("fused:"):
+            attention_impl, fused_impl = "fused", attention_impl[6:]
+        if attention_impl == "auto":
+            # the fused kernel is the TPU fast path; the gather step stays
+            # the exact (and cheapest-to-dispatch) path on CPU CI hosts
+            attention_impl = (
+                "fused" if jax.default_backend() == "tpu" else "gather"
+            )
+        if attention_impl not in ("gather", "fused") or fused_impl not in (
+            "auto", "kernel", "xla"
+        ):
+            # fail at construction, not at the first decode step's trace —
+            # a serve replica must reject a typo'd flag before admitting
+            raise ValueError(
+                "attention_impl must be auto|gather|fused[:kernel|:xla], "
+                f"got {attention_impl!r}"
+                + (f" with backend {fused_impl!r}" if fused_impl != "auto"
+                   else "")
+            )
+        self.attention_impl = attention_impl
+
+        if num_blocks is not None and pool_bytes is not None:
+            raise ValueError(
+                "num_blocks and pool_bytes are conflicting pool sizes — "
+                "pass one (the byte budget is a ceiling, the block count "
+                "a floor)"
+            )
+        if num_blocks is None and pool_bytes is None:
+            pool_bytes = int(gcfg.serve_kv_pool_mb) * (1 << 20) or None
+        from_budget = num_blocks is None and pool_bytes is not None
+        if from_budget:
+            # byte-budget sizing: int8 pools fit ~2x the blocks of bf16
+            # ones — capacity and autoscaling see the doubling directly.
+            # The budget is a CEILING (the operator's HBM headroom), so
+            # the null block counts against it and a budget that cannot
+            # hold it plus one usable block is an error, not a tiny pool
+            num_blocks = int(pool_bytes) // self.kv_block_bytes
+            if num_blocks < 2:
+                raise ValueError(
+                    f"pool_bytes={pool_bytes} holds {num_blocks} blocks of "
+                    f"{self.kv_block_bytes} bytes; need >= 2 (null + 1 usable)"
+                )
         if num_blocks is None:
             num_blocks = int(gcfg.serve_kv_cache_blocks) or 0
         if not num_blocks:
@@ -273,15 +332,24 @@ class PagedDecodeEngine:
             # prefix reuse + preemption make safe)
             num_blocks = 1 + self.max_batch_size * self.blocks_per_slot
         if mesh is not None and rules is not None:
-            # the pool's block dim shards on the "batch" mesh axes: round
-            # up so every shard is whole
+            # the pool's block dim shards on the "batch" mesh axes: every
+            # shard must be whole — round DOWN under a byte budget (the
+            # budget is a ceiling) and UP otherwise (counts are a floor)
             axes = rules.mesh_axes("batch") or ()
             if isinstance(axes, str):
                 axes = (axes,)
             m = 1
             for a in axes:
                 m *= dict(mesh.shape)[a]
-            num_blocks = -(-num_blocks // m) * m
+            if from_budget:
+                num_blocks = (num_blocks // m) * m
+                if num_blocks < 2:
+                    raise ValueError(
+                        f"pool_bytes={pool_bytes} cannot hold a whole "
+                        f"{m}-shard block set plus the null block"
+                    )
+            else:
+                num_blocks = -(-num_blocks // m) * m
         self.num_blocks = int(num_blocks)
 
         self.params = (
@@ -295,12 +363,13 @@ class PagedDecodeEngine:
             PrefixCache(self.allocator, bt) if prefix_cache else None
         )
         self.pool = init_paged_kv_cache(
-            cfg, self.num_blocks, bt, mesh=mesh, rules=rules
+            cfg, self.num_blocks, bt, mesh=mesh, rules=rules, dtype=kv_dtype
         )
         self._prefill, self._decode_step, self._copy_blocks = (
             make_paged_decoder(
                 cfg, rules=rules, mesh=mesh, temperature=temperature,
-                block_tokens=bt,
+                block_tokens=bt, kv_dtype=kv_dtype,
+                attention_impl=attention_impl, fused_impl=fused_impl,
             )
         )
         buckets = sorted(set(
@@ -686,6 +755,12 @@ class PagedDecodeEngine:
             "decode_steps": self.decode_steps,
             "max_batch_size": self.max_batch_size,
             "block_tokens": self.block_tokens,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "attention_impl": self.attention_impl,
+            "kv_block_bytes": self.kv_block_bytes,
+            # true pool HBM: counts the reserved null block too, so this
+            # reconciles exactly with a serve_kv_pool_mb budget
+            "kv_pool_bytes": self.kv_block_bytes * self.num_blocks,
             "kv_blocks_total": self.allocator.num_usable,
             "kv_blocks_free": self.allocator.num_free,
             "kv_block_utilization": round(

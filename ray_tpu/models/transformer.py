@@ -618,12 +618,34 @@ def _cached_attend(q, kc, vc, mask, scale, n_rep):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vr.dtype), vr)
 
 
+# per-block quantization scales are [n_layers, num_blocks, kv_heads]: the
+# block dim shards with the pool's block dim, kv_heads with tp
+KV_SCALE_AXES = ("layers", "batch", "kv_heads")
+
+
+def paged_kv_block_bytes(
+    cfg: TransformerConfig, block_tokens: int, dtype=None
+) -> int:
+    """HBM bytes ONE physical block costs across all layers (K + V + the
+    per-block scales when quantized) — the unit the engine's byte-budget
+    pool sizing divides by, which is how int8 pools end up with ~2x the
+    blocks of a bf16 pool for the same budget."""
+    dtype = dtype or cfg.dtype
+    itemsize = jnp.dtype(dtype).itemsize
+    per = cfg.n_layers * block_tokens * cfg.n_kv_heads * cfg.d_head * itemsize
+    total = 2 * per  # k + v
+    if dtype == jnp.int8:
+        total += 2 * cfg.n_layers * cfg.n_kv_heads * 4  # f32 scales
+    return total
+
+
 def init_paged_kv_cache(
     cfg: TransformerConfig,
     num_blocks: int,
     block_tokens: int,
     mesh=None,
     rules: Optional[ShardingRules] = None,
+    dtype=None,
 ):
     """Allocate the pooled (paged) per-layer KV cache: `num_blocks` physical
     blocks of `block_tokens` tokens each, shared by every decode slot via
@@ -631,16 +653,28 @@ def init_paged_kv_cache(
     the dense cache — the block dim takes the "batch" axis (dp/fsdp), so
     the pool shards exactly like the dense slot dim under every existing
     mesh preset. Block 0 is reserved as the null block: padded table
-    entries and masked-token writes route there (see kv_paging.py)."""
+    entries and masked-token writes route there (see kv_paging.py).
+
+    `dtype=jnp.int8` stores the pool quantized with per-block, per-kv-head
+    f32 scales (`k_scale`/`v_scale` leaves, x ~= q * scale): half the HBM
+    per resident token, dequantized at the attention read."""
+    dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, num_blocks, block_tokens, cfg.n_kv_heads, cfg.d_head)
-    k = jnp.zeros(shape, cfg.dtype)
-    v = jnp.zeros(shape, cfg.dtype)
+    pool = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if dtype == jnp.int8:
+        sshape = (cfg.n_layers, num_blocks, cfg.n_kv_heads)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
     if mesh is not None and rules is not None:
         from ..parallel.sharding import logical_sharding
 
         sh = logical_sharding(mesh, rules, *KV_CACHE_AXES)
-        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
-    return {"k": k, "v": v}
+        ssh = logical_sharding(mesh, rules, *KV_SCALE_AXES)
+        pool = {
+            name: jax.device_put(a, ssh if name.endswith("_scale") else sh)
+            for name, a in pool.items()
+        }
+    return pool
 
 
 def make_paged_decoder(
@@ -649,6 +683,9 @@ def make_paged_decoder(
     mesh=None,
     temperature: float = 0.0,
     block_tokens: int = 64,
+    kv_dtype=None,
+    attention_impl: str = "gather",
+    fused_impl: str = "auto",
 ):
     """Build the paged fast path: (paged_prefill, paged_decode_step,
     copy_blocks) over a block pool from `init_paged_kv_cache`.
@@ -676,16 +713,35 @@ def make_paged_decoder(
       Copy-on-write: duplicate physical blocks across all layers (refcount
       divergence handled host-side in kv_paging.BlockAllocator).
 
-    The gather materializes [B, Nmax*block_tokens] keys per layer — the
-    jit-level paged-attention shape (a fused Pallas gather kernel is the
-    TPU follow-up); correctness and the one-compiled-shape property are
-    what this path buys today.
+    `kv_dtype=jnp.int8` runs the pool quantized (per-block per-kv-head f32
+    scales): cache writes quantize, attention reads dequantize, and the
+    dequantized cache content is authoritative for prefill too — so the
+    int8 engine is self-consistent even though it is not bit-identical to
+    the fp reference path (which stays exact under the default dtype).
+
+    `attention_impl` picks the decode-step attention:
+      "gather"  gather each slot's window [B, Nmax*bt] through its block
+                table, then dense masked softmax — the exact reference
+                path (bit-identical to the dense engine in fp).
+      "fused"   ops/paged_attention.py walks the block table and attends
+                block-in-place (Pallas kernel on TPU, chunked online
+                softmax under XLA elsewhere; `fused_impl` forces one).
+                Composes with KV_CACHE_AXES sharding via shard_map:
+                block-sharded pools run per-shard with a log-sum-exp
+                merge across the block axes; tp-sharded kv_heads need no
+                merge.
     """
     if cfg.pp_stages > 1:
         raise NotImplementedError("decode does not support pp_stages > 1")
     bt = int(block_tokens)
     if bt <= 0:
         raise ValueError(f"block_tokens must be positive, got {bt}")
+    if attention_impl not in ("gather", "fused"):
+        raise ValueError(
+            f"attention_impl must be 'gather' or 'fused', got {attention_impl!r}"
+        )
+    kv_dtype = kv_dtype or cfg.dtype
+    quant = kv_dtype == jnp.int8
     cos, sin = rope_frequencies(cfg.d_head, cfg.max_seq_len, cfg.rope_theta)
     scale = cfg.d_head**-0.5
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -696,6 +752,106 @@ def make_paged_decoder(
         return constrain(x, rules, *axes, mesh=mesh)
 
     _sample = _make_sampler(temperature)
+
+    def _scan_leaves(pool):
+        """Pool leaves in the fixed order the layer scans unpack."""
+        if quant:
+            return (pool["k"], pool["v"], pool["k_scale"], pool["v_scale"])
+        return (pool["k"], pool["v"])
+
+    def _pool_dict(leaves):
+        names = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
+        return dict(zip(names, leaves))
+
+    def _dequant(blocks, scales):
+        """[..., bt, KV, D] int8 x [..., KV] -> compute dtype."""
+        return (
+            blocks.astype(jnp.float32) * scales[..., None, :, None]
+        ).astype(cfg.dtype)
+
+    def _quantize(win):
+        """[G, bt, KV, D] f32 -> (int8 blocks, [G, KV] f32 scales)."""
+        amax = jnp.max(jnp.abs(win), axis=(1, 3))
+        s = amax / 127.0
+        q8 = jnp.clip(
+            jnp.round(win / jnp.maximum(s, 1e-20)[:, None, :, None]),
+            -127, 127,
+        ).astype(jnp.int8)
+        return q8, s
+
+    # ---- fused attention (ops/paged_attention.py), sharding-aware -------
+
+    def _flat_axes(logical):
+        if rules is None or mesh is None:
+            return ()
+        axes = rules.mesh_axes(logical)
+        if axes is None:
+            return ()
+        if isinstance(axes, str):
+            axes = (axes,)
+        return tuple(a for a in axes if a in mesh.shape)
+
+    def _fused_attend(q1, kc, vc, ksc, vsc, tables, positions):
+        """q1 [B, H, D] against the (possibly sharded) per-layer pool."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.paged_attention import merge_partials, paged_attention
+        from ..parallel.sharding import shard_map_compat
+
+        scales = dict(k_scale=ksc, v_scale=vsc) if quant else {}
+        block_axes = _flat_axes("batch")
+        kv_axes = _flat_axes("kv_heads")
+        q_axes = _flat_axes("heads")
+        if not block_axes and not kv_axes:
+            return paged_attention(
+                q1, kc, vc, tables, positions, scale=scale,
+                impl=fused_impl, **scales,
+            )
+
+        def inner(q1, kc, vc, *rest):
+            if quant:
+                (ksc, vsc), rest = rest[:2], rest[2:]
+                sc = dict(k_scale=ksc, v_scale=vsc)
+            else:
+                sc = {}
+            tables, positions = rest
+            if not block_axes:
+                return paged_attention(
+                    q1, kc, vc, tables, positions, scale=scale,
+                    impl=fused_impl, **sc,
+                )
+            # blocks are sharded: remap global table entries to this
+            # shard's local ids (others masked dead), attend locally, and
+            # log-sum-exp-merge the partial softmax across the block axes
+            nloc = kc.shape[0]
+            idx = jnp.int32(0)
+            for a in block_axes:
+                idx = idx * dict(mesh.shape)[a] + lax.axis_index(a)
+            lo = idx * nloc
+            live = (tables > 0) & (tables >= lo) & (tables < lo + nloc)
+            ptab = jnp.where(live, tables - lo, -1).astype(jnp.int32)
+            acc, m, l = paged_attention(
+                q1, kc, vc, ptab, positions, scale=scale, impl=fused_impl,
+                signed_tables=True, partial_out=True, **sc,
+            )
+            return merge_partials(
+                acc, m, l, axis_names=block_axes, out_dtype=q1.dtype
+            )
+
+        bspec = tuple(block_axes) if block_axes else None
+        kvspec = tuple(kv_axes) if kv_axes else None
+        qspec = P(None, tuple(q_axes) if q_axes else None, None)
+        in_specs = [qspec, P(bspec, None, kvspec, None), P(bspec, None, kvspec, None)]
+        args = [q1, kc, vc]
+        if quant:
+            in_specs += [P(bspec, kvspec)] * 2
+            args += [ksc, vsc]
+        in_specs += [P(None, None), P(None)]
+        args += [tables, positions]
+        manual = set(block_axes) | set(kv_axes) | set(q_axes)
+        return shard_map_compat(
+            inner, mesh, tuple(in_specs), qspec, manual
+        )(*args)
 
     def _prefill_body(G, params, pool, table, tokens, length, ctx_len, key):
         params = _cast_matmul_params(cfg, params)
@@ -713,8 +869,46 @@ def make_paged_decoder(
         # query at global position p iff j <= p (ctx + causal in one mask)
         kmask = (jnp.arange(G * bt)[None, :] <= qpos[:, None])[None]
 
+        def _write_suffix_quant(kc, ksc, knew):
+            """Quantized prefill write: rebuild the window in f32 (dequant
+            + suffix insert + stale-tail zeroing), requantize per block,
+            scatter the blocks back. Returns the updated pool leaves plus
+            the DEQUANTIZED window — attention reads what the cache will
+            serve, so int8 prefill and int8 decode agree on every key."""
+            raw = kc[window]  # [G, bt, KV, D] int8
+            s0 = ksc[window]  # [G, KV]
+            win = raw.astype(jnp.float32) * s0[:, None, :, None]
+            flat = win.reshape(G * bt, *win.shape[2:])
+            # padded suffix tokens scatter out of bounds and are dropped
+            # (the fp path routes them to the null block instead)
+            wpos = jnp.where(valid_tok, qpos, G * bt)
+            flat = flat.at[wpos].set(
+                knew.astype(jnp.float32), mode="drop"
+            )
+            # recycled blocks carry stale values past the live span; they
+            # are masked in attention but would poison the block scales
+            total = ctx_len + length
+            flat = jnp.where(
+                jnp.arange(G * bt)[:, None, None] < total, flat, 0.0
+            )
+            win = flat.reshape(G, bt, *flat.shape[1:])
+            q8, s = _quantize(win)
+            # shared context blocks (prefix-cache hits, refcount > 1) must
+            # never be rewritten — keep their ORIGINAL bytes/scales, so
+            # the allocator's copy-on-write invariant holds even if the
+            # quantizer stops being a round-trip identity; the slot only
+            # owns the suffix blocks it allocated
+            owned = jnp.arange(G) >= ctx_len // bt
+            q8 = jnp.where(owned[:, None, None, None], q8, raw)
+            s = jnp.where(owned[:, None], s, s0)
+            kw = _dequant(q8, s).reshape(1, G * bt, *win.shape[2:])
+            return kc.at[window].set(q8), ksc.at[window].set(s), kw
+
         def layer_fn(x, per_layer):
-            lp, kc, vc = per_layer
+            if quant:
+                lp, kc, vc, ksc, vsc = per_layer
+            else:
+                lp, kc, vc = per_layer
             h = rms_norm(x, lp["attn_norm"])
             q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])
             k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])
@@ -724,25 +918,29 @@ def make_paged_decoder(
             q = _constrain(q, "batch", "seq", "heads", "head_dim")
             # write the suffix K/V, then gather the window back — suffix
             # keys come from the pool, so cache content is authoritative
-            kc = kc.at[w_phys, w_off].set(k[0].astype(kc.dtype))
-            vc = vc.at[w_phys, w_off].set(v[0].astype(vc.dtype))
-            kw = kc[window].reshape(1, G * bt, *kc.shape[2:])
-            vw = vc[window].reshape(1, G * bt, *vc.shape[2:])
+            if quant:
+                kc, ksc, kw = _write_suffix_quant(kc, ksc, k[0])
+                vc, vsc, vw = _write_suffix_quant(vc, vsc, v[0])
+            else:
+                kc = kc.at[w_phys, w_off].set(k[0].astype(kc.dtype))
+                vc = vc.at[w_phys, w_off].set(v[0].astype(vc.dtype))
+                kw = kc[window].reshape(1, G * bt, *kc.shape[2:])
+                vw = vc[window].reshape(1, G * bt, *vc.shape[2:])
             attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
             x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"])
             x = x + _mlp(h2, lp, cfg, _constrain)
             x = _constrain(x, "batch", "seq", "embed")
-            return x, (kc, vc)
+            return x, (kc, vc, ksc, vsc) if quant else (kc, vc)
 
-        x, (k_new, v_new) = lax.scan(
-            layer_fn, x, (params["layers"], pool["k"], pool["v"])
+        x, new_leaves = lax.scan(
+            layer_fn, x, (params["layers"],) + _scan_leaves(pool)
         )
         x = rms_norm(x, params["final_norm"])
         x_last = x[0, jnp.maximum(length - 1, 0)][None]
         logits = jnp.einsum("be,ev->bv", x_last, _unembed_matrix(cfg, params))
         logits = _constrain(logits, "batch", "vocab")
-        return _sample(logits, key), logits, {"k": k_new, "v": v_new}
+        return _sample(logits, key), logits, _pool_dict(new_leaves)
 
     _prefill_jits: Dict[int, Any] = {}
 
@@ -766,38 +964,79 @@ def make_paged_decoder(
         pos2 = positions[:, None]
         kmask = (jnp.arange(W)[None, :] <= pos2)[:, None, :]  # [B,1,W]
 
+        def _write_token_quant(kc, ksc, knew):
+            """Quantized decode write: read-modify-write each slot's write
+            block — dequant, insert the token at its offset, zero the
+            not-yet-written tail (recycled blocks carry stale values that
+            would poison the scale), requantize. With an unchanged scale
+            the existing tokens round-trip exactly; a scale bump re-rounds
+            them once at the new grain. knew is [B, KV, D]."""
+            blk = kc[write_phys]  # [B, bt, KV, D] int8
+            s0 = ksc[write_phys]  # [B, KV]
+            deq = blk.astype(jnp.float32) * s0[:, None, :, None]
+            t = jnp.arange(bt)[None, :, None, None]
+            deq = jnp.where(t < write_off[:, None, None, None], deq, 0.0)
+            deq = deq.at[jnp.arange(B), write_off].set(
+                knew.astype(jnp.float32)
+            )
+            q8, s1 = _quantize(deq)
+            return kc.at[write_phys].set(q8), ksc.at[write_phys].set(s1)
+
         def layer_fn(x, per_layer):
-            lp, kc, vc = per_layer
+            if quant:
+                lp, kc, vc, ksc, vsc = per_layer
+            else:
+                lp, kc, vc = per_layer
+                ksc = vsc = None
             h = rms_norm(x, lp["attn_norm"])
             q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])  # [B,1,H,D]
             k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])  # [B,1,KV,D]
             v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
             q = apply_rope(q, cos, sin, positions=pos2)
             k = apply_rope(k, cos, sin, positions=pos2)
-            kc = kc.at[write_phys, write_off].set(k[:, 0].astype(kc.dtype))
-            vc = vc.at[write_phys, write_off].set(v[:, 0].astype(vc.dtype))
-            kw = kc[tables].reshape(B, W, *kc.shape[2:])
-            vw = vc[tables].reshape(B, W, *vc.shape[2:])
-            attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
+            if quant:
+                kc, ksc = _write_token_quant(kc, ksc, k[:, 0])
+                vc, vsc = _write_token_quant(vc, vsc, v[:, 0])
+            else:
+                kc = kc.at[write_phys, write_off].set(k[:, 0].astype(kc.dtype))
+                vc = vc.at[write_phys, write_off].set(v[:, 0].astype(vc.dtype))
+            if attention_impl == "fused":
+                # block-in-place attention: no [B, W] gather exists
+                attn = _fused_attend(
+                    q[:, 0], kc, vc, ksc, vsc, tables, positions
+                )[:, None]
+            else:
+                if quant:
+                    kw = _dequant(kc[tables], ksc[tables]).reshape(
+                        B, W, *kc.shape[2:]
+                    )
+                    vw = _dequant(vc[tables], vsc[tables]).reshape(
+                        B, W, *vc.shape[2:]
+                    )
+                else:
+                    kw = kc[tables].reshape(B, W, *kc.shape[2:])
+                    vw = vc[tables].reshape(B, W, *vc.shape[2:])
+                attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
             x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"])
             x = x + _mlp(h2, lp, cfg, _constrain)
             x = _constrain(x, "batch", "seq", "embed")
-            return x, (kc, vc)
+            return x, (kc, vc, ksc, vsc) if quant else (kc, vc)
 
-        x, (k_new, v_new) = lax.scan(
-            layer_fn, x, (params["layers"], pool["k"], pool["v"])
+        x, new_leaves = lax.scan(
+            layer_fn, x, (params["layers"],) + _scan_leaves(pool)
         )
         x = rms_norm(x, params["final_norm"])
         logits = jnp.einsum("be,ev->bv", x[:, 0], _unembed_matrix(cfg, params))
         logits = _constrain(logits, "batch", "vocab")
-        return _sample(logits, key), logits, {"k": k_new, "v": v_new}
+        return _sample(logits, key), logits, _pool_dict(new_leaves)
 
     def _copy_body(pool, src, dst):
-        k = pool["k"]
-        v = pool["v"]
-        return {"k": k.at[:, dst].set(k[:, src]),
-                "v": v.at[:, dst].set(v[:, src])}
+        # every pool leaf (K/V blocks AND their scales) has the physical
+        # block dim at axis 1
+        return {
+            name: a.at[:, dst].set(a[:, src]) for name, a in pool.items()
+        }
 
     paged_decode_step = jax.jit(_decode_body, donate_argnums=(1,))
     copy_blocks = jax.jit(_copy_body, donate_argnums=(0,))

@@ -6,6 +6,7 @@ from .norm import rms_norm  # noqa: F401
 from .rope import apply_rope, rope_frequencies  # noqa: F401
 from .attention import causal_attention  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
+from .paged_attention import paged_attention  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .losses import softmax_cross_entropy_with_int_labels  # noqa: F401

@@ -89,11 +89,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *, scale, cau
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q/k/v in [B, H, L, D] — the kernel's native layout (Mosaic requires
-    the last two BLOCK dims to tile (8, 128) or equal the array dims, so L
-    and D must be innermost). Returns out [B, H, Lq, D], lse [B, H, Lq]."""
+    """q in [B, H, L, D], k/v in [B, Hkv, L, D] — the kernel's native
+    layout (Mosaic requires the last two BLOCK dims to tile (8, 128) or
+    equal the array dims, so L and D must be innermost). GQA is folded
+    into the k/v index maps (q head h reads kv head h // n_rep), so
+    repeated KV heads are never materialized. Returns out [B, H, Lq, D],
+    lse [B, H, Lq]."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    n_rep = h // k.shape[1]
     qt, kt, vt = q, k, v
     nq = lq // block_q
     nk = lk // block_k
@@ -106,8 +110,8 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -181,12 +185,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+                dk_acc, dv_acc, *, scale, causal, block_q, block_k, nq):
+    """Grid (b, kv_head, kv_block, n_rep * nq): the innermost axis walks
+    every (q head in the GQA group, q block) pair while the dk/dv output
+    block stays fixed, so the group-sum over repeated q heads lands in the
+    same VMEM accumulator that already sums over q blocks — the repeated-KV
+    materialization (and its gradient reduction) never exists."""
     kj = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    i = pl.program_id(3)
+    ni = pl.num_programs(3)
+    qi = i % nq
 
-    @pl.when(qi == 0)
+    @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -209,7 +219,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(i == ni - 1)
     def _finalize():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
@@ -241,9 +251,14 @@ def _dqkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(scale, causal, block_q, block_k, interpret, res, do):
     """FlashAttention-2 backward: two Pallas kernels over [B, H, L, D]
-    (fused into one when the whole sequence fits a single block pair)."""
+    (fused into one when the whole sequence fits a single block pair and
+    there is no GQA group to reduce). k/v/dk/dv stay [B, Hkv, L, D]: the
+    group fold lives in the index maps (dq) and the folded innermost grid
+    axis (dk/dv)."""
     q, k, v, out, lse = res
     b, h, lq, d = q.shape
+    h_kv = k.shape[1]
+    n_rep = h // h_kv
     lk = k.shape[2]
     qt, kt, vt, dot = q, k, v, do
     # Delta_i = rowsum(dO * O)  [B, H, L, 1]
@@ -255,9 +270,9 @@ def _flash_backward(scale, causal, block_q, block_k, interpret, res, do):
     nk = lk // block_k
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0))
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
-    if nq == 1 and nk == 1:
+    if nq == 1 and nk == 1 and n_rep == 1:
         dq, dk, dv = pl.pallas_call(
             functools.partial(
                 _dqkv_kernel, scale=scale, causal=causal,
@@ -286,20 +301,32 @@ def _flash_backward(scale, causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qt, kt, vt, dot, lse4, delta)[0]
 
-    # kv kernel: q innermost so the dk/dv accumulators persist per kv block
-    qi_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
-    kj_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
-    rowi_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    # kv kernel: grid (b, kv_head, kv_block, n_rep * q_blocks) — the whole
+    # GQA group runs while the dk/dv block is resident, so group-sum and
+    # q-block-sum share one accumulator (see _dkv_kernel)
+    def _qh(g_, i_):
+        return g_ * n_rep + i_ // nq
+
+    qi_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda b_, g_, j_, i_: (b_, _qh(g_, i_), i_ % nq, 0)
+    )
+    kj_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda b_, g_, j_, i_: (b_, g_, j_, 0)
+    )
+    rowi_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b_, g_, j_, i_: (b_, _qh(g_, i_), i_ % nq, 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, nq=nq,
         ),
-        grid=(b, h, nk, nq),
+        grid=(b, h_kv, nk, nq * n_rep),
         in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, rowi_spec, rowi_spec],
         out_specs=[kj_spec, kj_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, lk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -348,14 +375,15 @@ def flash_attention(
     layout: str = "bshd",
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.attention.causal_attention on block-
-    aligned shapes; GQA handled by repeating KV heads outside the kernel
-    (gradients flow through the broadcast). Falls back to the dense einsum
-    path when the sequence doesn't tile evenly.
+    aligned shapes. GQA is folded into the kernel's k/v index maps (q head
+    h reads kv head h // n_rep, forward and backward) — repeated KV heads
+    are never materialized and dk/dv group-sum inside the kernel. Falls
+    back to the dense einsum path when the sequence doesn't tile evenly.
 
     layout="bhsd" runs the kernel on head-major inputs with NO relayout —
     the fast path the model uses (transposes around the kernel cost more
     than the attention itself at small d_head)."""
-    from .attention import causal_attention, causal_attention_bhsd, _repeat_kv, _repeat_kv_bhsd
+    from .attention import causal_attention, causal_attention_bhsd
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -366,10 +394,11 @@ def flash_attention(
     if q.shape[seq_axis] % block_q or k.shape[seq_axis] % block_k:
         dense = causal_attention_bhsd if layout == "bhsd" else causal_attention
         return dense(q, k, v, scale=scale, causal=causal)
-    n_rep = q.shape[head_axis] // k.shape[head_axis]
-    rep = _repeat_kv_bhsd if layout == "bhsd" else _repeat_kv
-    k = rep(k, n_rep)
-    v = rep(v, n_rep)
+    if q.shape[head_axis] % k.shape[head_axis]:
+        raise ValueError(
+            f"q heads {q.shape[head_axis]} not a multiple of kv heads "
+            f"{k.shape[head_axis]}"
+        )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if layout == "bhsd":
         return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

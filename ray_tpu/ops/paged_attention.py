@@ -1,0 +1,338 @@
+"""Fused paged-attention decode for TPU in Pallas.
+
+The paged decode step (ray_tpu/models/transformer.py make_paged_decoder)
+historically gathered every slot's logical sequence through its block
+table inside the jit — materializing [B, Nmax*block_tokens] keys AND
+values per layer before attending. At long contexts that gather, not the
+matmuls, is what caps tokens/s/chip: decode attention reads every live KV
+byte once per token, so doubling the traffic halves the rate.
+
+This kernel attends block-in-place over the pool layout instead:
+
+  grid = (batch, block)   block innermost, so the online-softmax scratch
+                          (f32 acc / running max / denominator) persists
+                          across one slot's walk of its block table
+  k/v BlockSpec           index_map reads the slot's block table (a
+                          scalar-prefetch operand) and DMAs physical
+                          block `table[b, j]` directly from the pool —
+                          no gathered copy ever exists
+  dead entries            table entries < 0 (padding, inactive slots,
+                          out-of-shard blocks) clamp to block 0 in the
+                          index map — Pallas skips the re-fetch when the
+                          block index repeats — and are masked in-body
+  past-length masking     key position j*block + t attends iff <= pos[b]
+
+GQA never materializes repeated KV heads: q is reshaped [KV, n_rep, D]
+and both matmuls run batched over the kv-head dim.
+
+int8 pools (per-block, per-kv-head fp32 scales — see
+transformer.init_paged_kv_cache) dequantize INSIDE the kernel: the HBM
+read is half the bytes of bf16, which is the whole point at decode.
+
+Sharded pools (blocks split across dp/fsdp shards) run the kernel
+per-shard with `partial_out=True`: the kernel returns the unnormalized
+accumulator plus the online-softmax (m, l) statistics, and the caller
+merges shards with the standard log-sum-exp combine (see
+`merge_partials`). kv_heads sharded on tp need no merge — heads are
+independent.
+
+A chunked XLA implementation (`impl="xla"`) computes the identical
+online-softmax walk without Pallas — the CPU/CI path (interpret-mode
+Pallas is a python-per-grid-step debugger, not an implementation), and
+the reference the kernel is tested against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# last resolved implementation ("kernel" | "xla"), recorded at trace time —
+# test observability: parity suites assert the path they intended to
+# exercise actually ran instead of silently falling back
+_LAST_IMPL: Optional[str] = None
+
+
+def _group_scores(q, k):
+    """[KV, n_rep, D] x [bt, KV, D] -> [KV, n_rep, bt] without repeating
+    KV heads (batched over the kv-head dim)."""
+    kt = k.transpose(1, 0, 2)  # [KV, bt, D]
+    return lax.dot_general(
+        q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+
+
+def _group_values(p, v):
+    """[KV, n_rep, bt] x [bt, KV, D] -> [KV, n_rep, D]."""
+    vt = v.transpose(1, 0, 2)  # [KV, bt, D]
+    return lax.dot_general(
+        p, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+
+
+def _pa_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+               bt, n_rep, scale, quantized, partial_out, out_dtype):
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    if partial_out:
+        o_ref, m_ref, l_ref = rest[:3]
+        acc, m_i, l_i = rest[3:]
+    else:
+        o_ref = rest[0]
+        acc, m_i, l_i = rest[1:]
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_i[:] = jnp.full_like(m_i, NEG_INF)
+        l_i[:] = jnp.zeros_like(l_i)
+
+    entry = tables_ref[b, j]
+    pos = pos_ref[b]
+    live = jnp.logical_and(entry >= 0, j * bt <= pos)
+
+    @pl.when(live)
+    def _attend():
+        k = k_ref[0]  # [bt, KV, D]
+        v = v_ref[0]
+        if quantized:
+            blk = jnp.maximum(entry, 0)
+            k = k.astype(jnp.float32) * ks_ref[blk][None, :, None]
+            v = v.astype(jnp.float32) * vs_ref[blk][None, :, None]
+        else:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        kv_heads = k.shape[1]
+        d = k.shape[2]
+        h = kv_heads * n_rep
+        qr = q_ref[0].astype(jnp.float32).reshape(kv_heads, n_rep, d)
+        s = _group_scores(qr, k).reshape(h, bt) * scale
+        kpos = j * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
+        s = jnp.where(kpos <= pos, s, NEG_INF)
+        m_prev = m_i[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_i[:] = alpha * l_i[:] + jnp.sum(p, axis=1, keepdims=True)
+        m_i[:] = m_new
+        pv = _group_values(p.reshape(kv_heads, n_rep, bt), v)
+        acc[:] = acc[:] * alpha + pv.reshape(h, d)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        if partial_out:
+            o_ref[0] = acc[:]
+            m_ref[0] = m_i[:]
+            l_ref[0] = l_i[:]
+        else:
+            l = l_i[:]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc[:] / safe_l).astype(out_dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, ptable, positions,
+                            k_scale, v_scale, scale, partial_out, interpret):
+    b, h, d = q.shape
+    _, bt, kv, _ = k_pool.shape
+    nmax = ptable.shape[1]
+    n_rep = h // kv
+    quantized = k_scale is not None
+    grid = (b, nmax)
+
+    q_spec = pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, bt, kv, d),
+        # dead entries (< 0) clamp to block 0: repeated indices skip the
+        # DMA, so a slot's padding tail costs one null-block fetch total
+        lambda b_, j_, tbl, pos: (jnp.maximum(tbl[b_, j_], 0), 0, 0, 0),
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        # scales ride whole in VMEM ([N, KV] f32 is tiny) and are indexed
+        # in-body — a (1, KV) block would fight the sublane tiling rules
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [k_scale, v_scale]
+    if partial_out:
+        out_specs = [
+            pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0)),
+            pl.BlockSpec((1, h, 1), lambda b_, j_, *_: (b_, 0, 0)),
+            pl.BlockSpec((1, h, 1), lambda b_, j_, *_: (b_, 0, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1), jnp.float32),
+        ]
+    else:
+        out_specs = [pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0))]
+        out_shape = [jax.ShapeDtypeStruct((b, h, d), q.dtype)]
+
+    kernel = functools.partial(
+        _pa_kernel, bt=bt, n_rep=n_rep, scale=scale, quantized=quantized,
+        partial_out=partial_out, out_dtype=q.dtype,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((h, d), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+            ],
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(ptable, positions, *operands)
+    if partial_out:
+        acc, m, l = outs
+        return acc, m[..., 0], l[..., 0]
+    return outs[0]
+
+
+def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
+                         k_scale, v_scale, scale, partial_out, chunk_blocks):
+    """The same block walk as the kernel, chunked for XLA: each chunk
+    gathers `chunk_blocks` physical blocks and folds them into the online
+    softmax. Never materializes the full [B, Nmax*bt] window or repeated
+    KV heads — on CPU this beats the gather path on exactly the traffic
+    the kernel saves on TPU."""
+    b, h, d = q.shape
+    _, bt, kv, _ = k_pool.shape
+    nmax = ptable.shape[1]
+    n_rep = h // kv
+    quantized = k_scale is not None
+    cb = max(1, min(chunk_blocks, nmax))
+    nch = -(-nmax // cb)
+    if nch * cb != nmax:
+        ptable = jnp.pad(ptable, ((0, 0), (0, nch * cb - nmax)),
+                         constant_values=-1)
+    qr = (q.astype(jnp.float32) * scale).reshape(b, kv, n_rep, d)
+    m = jnp.full((b, h, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, 1), jnp.float32)
+    acc = jnp.zeros((b, h, d), jnp.float32)
+    pos2 = positions.astype(jnp.int32)[:, None, None]
+    for c in range(nch):
+        tb = ptable[:, c * cb:(c + 1) * cb]  # [B, cb]
+        idx = jnp.maximum(tb, 0)
+        kc = k_pool[idx]  # [B, cb, bt, KV, D]
+        vc = v_pool[idx]
+        if quantized:
+            kc = kc.astype(jnp.float32) * k_scale[idx][:, :, None, :, None]
+            vc = vc.astype(jnp.float32) * v_scale[idx][:, :, None, :, None]
+        kc = kc.astype(jnp.float32).reshape(b, cb * bt, kv, d)
+        vc = vc.astype(jnp.float32).reshape(b, cb * bt, kv, d)
+        s = jnp.einsum(
+            "bgnd,btgd->bgnt", qr, kc, preferred_element_type=jnp.float32
+        ).reshape(b, h, cb * bt)
+        kpos = c * cb * bt + jnp.arange(cb * bt)[None, None, :]
+        live = jnp.repeat(tb >= 0, bt, axis=1)[:, None, :]
+        mask = live & (kpos <= pos2)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # NEG_INF is finite: a fully-masked row would otherwise see
+        # exp(NEG_INF - NEG_INF) = 1 and sum garbage into l/acc
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bgnt,btgd->bgnd", p.reshape(b, kv, n_rep, cb * bt), vc,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, h, d)
+        acc = acc * alpha + pv
+        m = m_new
+    if partial_out:
+        return acc, m[..., 0], l[..., 0]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l).astype(q.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,        # [B, H, D] one decode token per slot
+    k_pool: jnp.ndarray,   # [N, block_tokens, KV, D] physical blocks
+    v_pool: jnp.ndarray,   # [N, block_tokens, KV, D]
+    tables: jnp.ndarray,   # [B, Nmax] int32 block table per slot
+    positions: jnp.ndarray,  # [B] int32 current position (this token's)
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # [N, KV] f32 (int8 pools)
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",            # auto | kernel | xla
+    interpret: Optional[bool] = None,
+    signed_tables: bool = False,   # True: entries < 0 are dead (sharded
+                                   # callers pre-remap); False: entry 0 is
+                                   # the null-block sentinel
+    partial_out: bool = False,     # return (acc, m, l) for cross-shard merge
+    chunk_blocks: int = 8,
+) -> jnp.ndarray | Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged-attention decode over a block pool (module docstring).
+
+    Returns out [B, H, D] in q's dtype, or with `partial_out=True` the
+    unnormalized f32 (acc [B, H, D], m [B, H], l [B, H]) triple for
+    `merge_partials`. Slots whose table is fully dead return zeros."""
+    global _LAST_IMPL
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    if q.shape[1] % k_pool.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[1]} not a multiple of kv heads {k_pool.shape[2]}"
+        )
+    if impl not in ("auto", "kernel", "xla"):
+        raise ValueError(f"impl must be auto|kernel|xla, got {impl!r}")
+    if impl == "auto":
+        impl = "kernel" if jax.default_backend() == "tpu" else "xla"
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    if signed_tables:
+        ptable = tables.astype(jnp.int32)
+    else:
+        ptable = jnp.where(tables > 0, tables, -1).astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    _LAST_IMPL = impl
+    if impl == "kernel":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return _paged_attention_pallas(
+            q, k_pool, v_pool, ptable, positions, k_scale, v_scale, scale,
+            partial_out, interpret,
+        )
+    return _paged_attention_xla(
+        q, k_pool, v_pool, ptable, positions, k_scale, v_scale, scale,
+        partial_out, chunk_blocks,
+    )
+
+
+def merge_partials(acc, m, l, axis_names=None, out_dtype=jnp.float32):
+    """Combine per-shard online-softmax partials into the final output.
+
+    acc [B, H, D] unnormalized, m/l [B, H]. With `axis_names`, the combine
+    runs across those shard_map axes (pmax + psum); without, acc/m/l carry
+    a leading shard dim to reduce over. Rows with no live keys anywhere
+    (l == 0 everywhere) come out zero, mirroring the kernel."""
+    if axis_names:
+        m_g = lax.pmax(m, axis_names)
+        e = jnp.exp(m - m_g)
+        num = lax.psum(acc * e[..., None], axis_names)
+        den = lax.psum(l * e, axis_names)
+    else:
+        m_g = jnp.max(m, axis=0)
+        e = jnp.exp(m - m_g)
+        num = jnp.sum(acc * e[..., None], axis=0)
+        den = jnp.sum(l * e, axis=0)
+    safe = jnp.where(den == 0.0, 1.0, den)
+    return (num / safe[..., None]).astype(out_dtype)

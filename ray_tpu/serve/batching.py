@@ -390,7 +390,9 @@ class ContinuousBatcher:
                 es = None
             if isinstance(es, dict):
                 for k in ("kv_blocks_total", "kv_blocks_free",
-                          "kv_blocks_cached", "preemptions", "prefix_hits"):
+                          "kv_blocks_cached", "preemptions", "prefix_hits",
+                          "kv_block_bytes", "kv_pool_bytes",
+                          "kv_cache_dtype", "attention_impl"):
                     if k in es:
                         out[k] = es[k]
         return out

@@ -260,7 +260,7 @@ class Replica:
         the decode-aware autoscaling signal: a generation-bound replica is
         saturated when its SLOTS are, long before queued-call counts say so."""
         slots = active = queued = 0
-        kv_total = kv_free = preempt = 0
+        kv_total = kv_free = preempt = kv_bytes = 0
         for v in self._drainables():
             get_stats = getattr(v, "stats", None)
             if get_stats is None:
@@ -285,9 +285,16 @@ class Replica:
             kv_free += (int(s.get("kv_blocks_free", 0))
                         + int(s.get("kv_blocks_cached", 0)))
             preempt += int(s.get("preemptions", 0))
+            # capacity in BYTES too: an int8 pool reports ~2x the blocks
+            # of a bf16 pool for the same HBM, and this is what makes
+            # that doubling auditable from the controller side — the
+            # engine's figure includes the null block, so it reconciles
+            # exactly with a serve_kv_pool_mb budget
+            kv_bytes += int(s.get("kv_pool_bytes", 0))
         return {"batch_slots": slots, "batch_active": active,
                 "batch_queued": queued, "kv_blocks_total": kv_total,
-                "kv_blocks_free": kv_free, "kv_preemptions": preempt}
+                "kv_blocks_free": kv_free, "kv_preemptions": preempt,
+                "kv_pool_bytes": kv_bytes}
 
     def stats(self) -> Dict[str, Any]:
         self._reap_idle_streams()

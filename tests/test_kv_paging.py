@@ -544,6 +544,245 @@ def test_preemption_sse_streams_survive():
         ray_tpu.shutdown()
 
 
+# ------------------------------------------- int8 KV + fused attention
+
+
+def test_paged_int8_greedy_matches_fp(tiny_f32):
+    """ISSUE 6 acceptance: int8-pool greedy decode is token-for-token
+    identical to the fp paged engine on the test model — for both the
+    gather step and the fused block-walk step."""
+    cfg, params = tiny_f32
+    prompts = _prompts(cfg, (5, 9, 17, 30))
+    fp = PagedDecodeEngine(cfg, params, max_batch_size=2, block_tokens=8)
+    ref = [_gen(fp, i % 2, p, 12) for i, p in enumerate(prompts)]
+    for impl in ("gather", "fused"):
+        eng = PagedDecodeEngine(
+            cfg, params, max_batch_size=2, block_tokens=8,
+            kv_cache_dtype="int8", attention_impl=impl,
+        )
+        got = [_gen(eng, i % 2, p, 12) for i, p in enumerate(prompts)]
+        assert got == ref, impl
+        assert eng.stats()["kv_cache_dtype"] == "int8"
+
+
+def test_fused_paged_matches_dense(tiny_f32):
+    """The fused decode step (block-in-place attention, no [B, W] gather)
+    against the DENSE engine, interleaved multi-slot — including the
+    interpret-mode Pallas kernel for a couple of steps so tier-1 proves
+    the kernel inside the real decode loop, not just standalone."""
+    cfg, params = tiny_f32
+    prompts = _prompts(cfg, (5, 9, 17, 30))
+    dense = DecodeEngine(cfg, params, max_batch_size=4)
+    fused = PagedDecodeEngine(
+        cfg, params, max_batch_size=4, block_tokens=8,
+        attention_impl="fused",
+    )
+    for eng in (dense, fused):
+        outs = {}
+        active = []
+        for s, p in enumerate(prompts):
+            tok, done = eng.admit(s, {"tokens": p, "max_new_tokens": 10})
+            outs[s] = [tok]
+            if not done:
+                active.append(s)
+        while active:
+            for s, (tok, done) in eng.step(list(active)).items():
+                outs[s].append(tok)
+                if done:
+                    active.remove(s)
+                    eng.release(s)
+        if eng is dense:
+            expect = outs
+    assert outs == expect
+    assert fused.stats()["attention_impl"] == "fused"
+
+    # the Pallas kernel (interpret mode) through the engine contract
+    kern = PagedDecodeEngine(
+        cfg, params, max_batch_size=1, block_tokens=8,
+        attention_impl="fused:kernel",
+    )
+    assert _gen(kern, 0, prompts[0], 4) == expect[0][:4]
+
+
+def test_fused_matches_dense_under_sharded_mesh(tiny_f32):
+    """dp x fsdp x tp dryrun of the FUSED path: blocks sharded across
+    dp/fsdp mean each shard sees a slice of the pool — the shard_map
+    wrapper remaps global block ids, attends locally, and log-sum-exp
+    merges the partial softmax. Tokens must still match the unsharded
+    dense engine exactly (fp) and the int8 run must agree with solo
+    int8."""
+    cfg, params = tiny_f32
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    rules = PRESET_RULES["fsdp_tp"]
+    dense = DecodeEngine(cfg, params, max_batch_size=4)
+    fused = PagedDecodeEngine(
+        cfg, params, max_batch_size=4, block_tokens=8, rules=rules,
+        mesh=mesh, attention_impl="fused",
+    )
+    spec = fused.pool["k"].sharding.spec
+    assert spec[1] == ("dp", "fsdp") and spec[3] == "tp", spec
+    for i, p in enumerate(_prompts(cfg, (7, 19))):
+        assert _gen(fused, i, p, 8) == _gen(dense, i, p, 8), i
+
+    solo8 = PagedDecodeEngine(
+        cfg, params, max_batch_size=4, block_tokens=8,
+        kv_cache_dtype="int8", attention_impl="fused",
+    )
+    shard8 = PagedDecodeEngine(
+        cfg, params, max_batch_size=4, block_tokens=8, rules=rules,
+        mesh=mesh, kv_cache_dtype="int8", attention_impl="fused",
+    )
+    assert shard8.pool["k"].dtype == jnp.int8
+    assert shard8.pool["k_scale"].sharding.spec[1] == ("dp", "fsdp")
+    p = _prompts(cfg, (13,), seed=21)[0]
+    assert _gen(shard8, 0, p, 8) == _gen(solo8, 0, p, 8)
+
+
+def test_int8_logits_within_tolerance(tiny_f32):
+    """fp-vs-int8 logit bound: prefill + one decode step through
+    make_paged_decoder directly, comparing raw logits. Guards against the
+    quantizer silently degrading past argmax robustness (the greedy
+    parity test would then flip somewhere downstream)."""
+    import jax as _jax
+
+    from ray_tpu.models.transformer import (
+        init_paged_kv_cache,
+        make_paged_decoder,
+    )
+
+    cfg, params = tiny_f32
+    bt = 8
+    prompt = _prompts(cfg, (21,))[0]
+    padded = np.zeros(24, np.int32)
+    padded[:21] = prompt
+    table = np.zeros(8, np.int32)
+    table[:4] = [1, 2, 3, 4]
+    results = {}
+    for name, kv_dtype in (("fp", None), ("int8", jnp.int8)):
+        pool = init_paged_kv_cache(cfg, 8, bt, dtype=kv_dtype)
+        prefill, step, _ = make_paged_decoder(
+            cfg, block_tokens=bt, kv_dtype=kv_dtype
+        )
+        _, lg_p, pool = prefill(
+            params, pool, table, padded[None], np.int32(21), np.int32(0),
+            _jax.random.PRNGKey(0), 0,
+        )
+        toks, _, positions = (
+            np.array([int(prompt[0])], np.int32),
+            None,
+            np.array([21], np.int32),
+        )
+        wp = np.array([table[21 // bt]], np.int32)
+        wo = np.array([21 % bt], np.int32)
+        _, lg_d, pool = step(
+            params, pool, table[None], toks, positions, wp, wo,
+            _jax.random.PRNGKey(1),
+        )
+        results[name] = (np.asarray(lg_p), np.asarray(lg_d))
+    for i in range(2):
+        fp, i8 = results["fp"][i], results["int8"][i]
+        err = np.abs(fp - i8).max()
+        assert err < 0.1, (i, err)  # quantization noise, far below argmax gaps
+        assert err > 0.0  # int8 actually engaged (not silently fp)
+
+
+def test_fork_cow_isolation_int8(tiny_f32):
+    """Copy-on-write under the int8 pool: the CoW copy must carry the
+    per-block scales with the blocks — forks match solo int8 engines
+    teacher-forced the same way."""
+    cfg, params = tiny_f32
+    prompt = _prompts(cfg, (13,))[0]
+
+    def mk():
+        return PagedDecodeEngine(
+            cfg, params, max_batch_size=2, block_tokens=8,
+            prefix_cache=False, kv_cache_dtype="int8",
+        )
+
+    eng = mk()
+    eng.admit(0, {"tokens": prompt, "max_new_tokens": 30})
+    for _ in range(2):
+        eng.step([0])
+    eng.fork(0, 1)
+    eng.force_token(0, 5)
+    eng.force_token(1, 9)
+    outs = {0: [], 1: []}
+    for _ in range(5):
+        r = eng.step([0, 1])
+        for s in (0, 1):
+            outs[s].append(r[s][0])
+    assert eng.cow_copies >= 1
+
+    for s, forced in ((0, 5), (1, 9)):
+        solo = mk()
+        solo.admit(0, {"tokens": prompt, "max_new_tokens": 30})
+        for _ in range(2):
+            solo.step([0])
+        solo.force_token(0, forced)
+        ref = [solo.step([0])[0][0] for _ in range(5)]
+        assert ref == outs[s], (s, ref, outs[s])
+
+
+def test_preemption_storm_int8_all_streams_complete(tiny_f32):
+    """The preemption/readmit chaos test re-run with the int8 pool:
+    oversubscribed admissions preempt and recompute-on-readmit, and every
+    stream still delivers exactly what an unconstrained int8 engine
+    produces (readmission prefill re-quantizes whole blocks; parked
+    history teacher-forces the already-emitted tokens, so the stream
+    cannot fork from itself)."""
+    from ray_tpu.serve.batching import ContinuousBatcher
+
+    cfg, params = tiny_f32
+    prompts = _prompts(cfg, (9, 10, 11, 12, 13, 14), seed=5)
+    big = PagedDecodeEngine(
+        cfg, params, max_batch_size=1, block_tokens=8, prefix_cache=False,
+        kv_cache_dtype="int8",
+    )
+    refs = [_gen(big, 0, p, 25) for p in prompts]
+    eng = PagedDecodeEngine(
+        cfg, params, max_batch_size=4, block_tokens=8, num_blocks=13,
+        prefix_cache=False, kv_cache_dtype="int8",
+    )
+    b = ContinuousBatcher(eng, max_batch_size=4, batch_wait_timeout_s=0.01)
+    try:
+        streams = [b.submit(tokens=p, max_new_tokens=25) for p in prompts]
+        outs = [list(s) for s in streams]
+        assert eng.preemptions >= 1, eng.stats()
+        for i, (o, r) in enumerate(zip(outs, refs)):
+            assert o == r, (i, o, r)
+    finally:
+        b.close()
+
+
+def test_pool_bytes_sizing_doubles_blocks(tiny_f32):
+    """Byte-budget pool sizing: for the same HBM budget an int8 pool must
+    report ~2x the kv_blocks_total of a bf16 pool — the capacity doubling
+    admission and block-saturation autoscaling see directly."""
+    import dataclasses as _dc
+
+    from ray_tpu.models.transformer import paged_kv_block_bytes
+
+    cfg, _ = tiny_f32
+    bf16 = _dc.replace(cfg, dtype=jnp.bfloat16, max_seq_len=32)
+    budget = 48 * paged_kv_block_bytes(bf16, 8)
+    blocks = {}
+    for dtype in ("fp", "int8"):
+        eng = PagedDecodeEngine(
+            bf16, max_batch_size=1, block_tokens=8, pool_bytes=budget,
+            kv_cache_dtype=dtype,
+        )
+        s = eng.stats()
+        blocks[dtype] = s["kv_blocks_total"]
+        assert s["kv_block_bytes"] == paged_kv_block_bytes(
+            bf16, 8, jnp.int8 if dtype == "int8" else bf16.dtype
+        )
+    # the budget is a CEILING: 48 blocks of bytes = 48 total = 47 usable
+    # (the null block counts against the budget, never on top of it)
+    assert blocks["fp"] == 47
+    ratio = blocks["int8"] / blocks["fp"]
+    assert 1.8 <= ratio <= 2.2, blocks
+
+
 def test_autoscaling_block_saturation_signal():
     """Satellite: block saturation is a third scale-up signal — saturated
     pools demand more replicas even with idle slots and an empty queue."""

@@ -1,0 +1,225 @@
+"""Fused paged-attention kernel vs the dense gather reference (ISSUE 6).
+
+Tier-1 CI contract (the "skip-guard"): these tests run the Pallas kernel
+in INTERPRET mode on CPU and must fail loudly — never skip — when the
+kernel diverges from the dense reference, when a forced implementation
+silently falls back to another one (asserted via ops.paged_attention
+_LAST_IMPL), or when interpret mode degenerates past the module's wall
+clock budget. A green tier-1 therefore certifies the kernel's math, not
+just its importability.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+# the ops package re-exports the FUNCTION under the same name; go through
+# importlib for the module itself (its _LAST_IMPL observability var)
+pa_mod = importlib.import_module("ray_tpu.ops.paged_attention")
+merge_partials = pa_mod.merge_partials
+paged_attention = pa_mod.paged_attention
+
+pytestmark = pytest.mark.pallas
+
+# interpret-mode wall budget for the CANONICAL shapes below; blowing it
+# means interpret-mode grids grew past what tier-1 can afford — fail loud
+# so the suite shrinks the shapes instead of silently eating minutes
+INTERPRET_BUDGET_S = 120.0
+_t0 = time.perf_counter()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _module_clock():
+    # anchor the budget at the module's FIRST test, not at import:
+    # pytest imports every test module during collection, so an
+    # import-time clock would bill this module for the whole suite
+    # that runs before it
+    global _t0
+    _t0 = time.perf_counter()
+    yield
+
+
+def _setup(b=3, h=4, kv=2, d=16, bt=8, n_pool=12, n_max=5, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_pool, bt, kv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_pool, bt, kv, d)), jnp.float32)
+    # slot 0 short (mid-block position), slot 1 full table, slot 2 dead
+    tables = np.zeros((b, n_max), np.int32)
+    tables[0, :2] = [3, 7]
+    tables[1, :n_max] = rng.choice(
+        np.arange(1, n_pool), size=n_max, replace=False
+    )
+    positions = jnp.asarray([9, n_max * bt - 4, 0], jnp.int32)
+    return q, kp, vp, jnp.asarray(tables), positions
+
+
+def _dense_reference(q, kp, vp, tables, positions):
+    """Gather + masked softmax — the exact math the gather decode path
+    (transformer._cached_attend) runs, with repeated KV heads."""
+    b, h, d = q.shape
+    _, bt, kv, _ = kp.shape
+    n_max = tables.shape[1]
+    n_rep = h // kv
+    kw = kp[tables].reshape(b, n_max * bt, kv, d)
+    vw = vp[tables].reshape(b, n_max * bt, kv, d)
+    kr = jnp.repeat(kw, n_rep, axis=2)
+    vr = jnp.repeat(vw, n_rep, axis=2)
+    logits = jnp.einsum("bhd,bkhd->bhk", q, kr) * (d ** -0.5)
+    kpos = jnp.arange(n_max * bt)[None, None, :]
+    live = jnp.repeat(tables > 0, bt, axis=1)[:, None, :]
+    mask = live & (kpos <= positions[:, None, None])
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhk,bkhd->bhd", p, vr)
+
+
+def _quantize_pool(kp):
+    sc = jnp.abs(kp).max(axis=(1, 3)) / 127.0
+    q8 = jnp.clip(
+        jnp.round(kp / jnp.maximum(sc, 1e-20)[:, None, :, None]), -127, 127
+    ).astype(jnp.int8)
+    return q8, sc
+
+
+@pytest.mark.parametrize("chunk_blocks", [1, 2, 8])
+def test_xla_matches_reference(chunk_blocks):
+    q, kp, vp, tables, positions = _setup()
+    ref = _dense_reference(q, kp, vp, tables, positions)
+    out = paged_attention(
+        q, kp, vp, tables, positions, impl="xla", chunk_blocks=chunk_blocks
+    )
+    assert pa_mod._LAST_IMPL == "xla"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_kernel_interpret_matches_reference():
+    """The skip-guard proper: the PALLAS kernel (interpret mode on CPU)
+    against the dense reference. A silent fallback to XLA would pass the
+    numbers but fail the _LAST_IMPL assertion; a divergence fails the
+    tolerance. Either way the failure is loud."""
+    q, kp, vp, tables, positions = _setup()
+    ref = _dense_reference(q, kp, vp, tables, positions)
+    out = paged_attention(
+        q, kp, vp, tables, positions, impl="kernel", interpret=True
+    )
+    assert pa_mod._LAST_IMPL == "kernel", "kernel path silently not taken"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_gqa_fold_no_materialized_repeat():
+    """n_rep = 4: the kernel indexes kv head h // n_rep instead of
+    repeating KV — outputs must still match the repeated-KV reference."""
+    q, kp, vp, tables, positions = _setup(h=8, kv=2)
+    ref = _dense_reference(q, kp, vp, tables, positions)
+    for impl, kw in (("xla", {}), ("kernel", {"interpret": True})):
+        out = paged_attention(q, kp, vp, tables, positions, impl=impl, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=impl,
+        )
+
+
+def test_null_block_and_past_length_masked():
+    """Entries past a slot's live blocks are the null block (0) and the
+    write block's tail positions exceed `positions` — neither may leak
+    into the softmax. Poison the null block and every past-length
+    position with huge values; outputs must not move."""
+    q, kp, vp, tables, positions = _setup()
+    ref = _dense_reference(q, kp, vp, tables, positions)
+    kp_p = kp.at[0].set(1e4)
+    vp_p = vp.at[0].set(1e4)
+    # poison position 9+1.. of slot 0's tail block (table[0,1] = 7)
+    kp_p = kp_p.at[7, 2:].set(1e4)
+    vp_p = vp_p.at[7, 2:].set(1e4)
+    for impl, kw in (("xla", {}), ("kernel", {"interpret": True})):
+        out = paged_attention(
+            q, kp_p, vp_p, tables, positions, impl=impl, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4,
+            err_msg=impl,
+        )
+        # the fully-dead slot (all-null table) returns zeros, not NaNs
+        assert bool(jnp.all(out[2] == 0.0)), impl
+
+
+def test_int8_dequant_inside_kernel():
+    q, kp, vp, tables, positions = _setup()
+    ref = _dense_reference(q, kp, vp, tables, positions)
+    k8, ks = _quantize_pool(kp)
+    v8, vs = _quantize_pool(vp)
+    outs = {}
+    for impl, kw in (("xla", {}), ("kernel", {"interpret": True})):
+        outs[impl] = paged_attention(
+            q, k8, v8, tables, positions, k_scale=ks, v_scale=vs,
+            impl=impl, **kw,
+        )
+        # within quantization tolerance of the fp reference
+        np.testing.assert_allclose(
+            np.asarray(outs[impl]), np.asarray(ref), atol=0.05, rtol=0.05,
+            err_msg=impl,
+        )
+    # and the two implementations agree with each other tightly
+    np.testing.assert_allclose(
+        np.asarray(outs["xla"]), np.asarray(outs["kernel"]),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("impl,kw", [("xla", {}), ("kernel", {"interpret": True})])
+def test_partial_merge_equals_full(impl, kw):
+    """Split the pool into two 'shards', attend each with partial_out and
+    signed local tables, merge — must equal the single full-pool pass.
+    This is exactly the shard_map composition the sharded decode uses."""
+    q, kp, vp, tables, positions = _setup()
+    full = paged_attention(q, kp, vp, tables, positions, impl=impl, **kw)
+    half = kp.shape[0] // 2
+    accs, ms, ls = [], [], []
+    for sh in range(2):
+        lo = sh * half
+        local = jnp.where(
+            (tables > 0) & (tables >= lo) & (tables < lo + half),
+            tables - lo, -1,
+        )
+        a, m, l = paged_attention(
+            q, kp[lo:lo + half], vp[lo:lo + half], local, positions,
+            impl=impl, signed_tables=True, partial_out=True, **kw,
+        )
+        accs.append(a), ms.append(m), ls.append(l)
+    merged = merge_partials(jnp.stack(accs), jnp.stack(ms), jnp.stack(ls))
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(full), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_validation_errors():
+    q, kp, vp, tables, positions = _setup()
+    with pytest.raises(ValueError, match="together"):
+        paged_attention(q, kp, vp, tables, positions,
+                        k_scale=jnp.zeros((12, 2)))
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(q, kp, vp, tables, positions, impl="nope")
+    with pytest.raises(ValueError, match="heads"):
+        paged_attention(q[:, :3], kp, vp, tables, positions)
+
+
+def test_interpret_wall_clock_budget():
+    """Runs last: the whole module (every interpret-mode kernel above)
+    must fit the tier-1 budget. A pathological interpret regression fails
+    HERE with a number, instead of silently dragging the suite."""
+    elapsed = time.perf_counter() - _t0
+    assert elapsed < INTERPRET_BUDGET_S, (
+        f"paged-attention interpret suite took {elapsed:.1f}s "
+        f"(budget {INTERPRET_BUDGET_S}s) — shrink the kernel test shapes"
+    )
