@@ -221,6 +221,11 @@ D("serve_paged_attention", str, "auto",
   "online softmax elsewhere — ops/paged_attention.py), so the gather "
   "never exists; 'auto' = fused on TPU, gather on CPU; "
   "'fused:kernel'/'fused:xla' force one fused backend (tests)")
+D("serve_paged_attention_chunk_blocks", int, 8,
+  "fused-XLA paged attention only: physical blocks folded per "
+  "online-softmax chunk in the block-table walk — larger chunks amortize "
+  "gather dispatch, smaller ones cap the transient [B, chunk*block_tokens] "
+  "window; the Pallas kernel walks block-by-block and ignores this")
 D("serve_kv_pool_mb", int, 0,
   "size the paged KV pool by HBM budget instead of block count: "
   "num_blocks = budget // block_bytes, so int8 pools hold ~2x the blocks "

@@ -254,6 +254,7 @@ class PagedDecodeEngine:
         kv_cache_dtype: Optional[str] = None,
         attention_impl: Optional[str] = None,
         pool_bytes: Optional[int] = None,
+        chunk_blocks: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -302,6 +303,17 @@ class PagedDecodeEngine:
                    else "")
             )
         self.attention_impl = attention_impl
+        chunk_blocks = int(
+            chunk_blocks if chunk_blocks is not None
+            else gcfg.serve_paged_attention_chunk_blocks
+        )
+        if chunk_blocks <= 0:
+            # same contract as the impl flags: a bad tuning knob fails at
+            # replica construction, not at the first decode step's trace
+            raise ValueError(
+                f"chunk_blocks must be positive, got {chunk_blocks}"
+            )
+        self.chunk_blocks = chunk_blocks
 
         if num_blocks is not None and pool_bytes is not None:
             raise ValueError(
@@ -370,6 +382,7 @@ class PagedDecodeEngine:
                 cfg, rules=rules, mesh=mesh, temperature=temperature,
                 block_tokens=bt, kv_dtype=kv_dtype,
                 attention_impl=attention_impl, fused_impl=fused_impl,
+                chunk_blocks=chunk_blocks,
             )
         )
         buckets = sorted(set(
@@ -757,6 +770,7 @@ class PagedDecodeEngine:
             "block_tokens": self.block_tokens,
             "kv_cache_dtype": self.kv_cache_dtype,
             "attention_impl": self.attention_impl,
+            "attention_chunk_blocks": self.chunk_blocks,
             "kv_block_bytes": self.kv_block_bytes,
             # true pool HBM: counts the reserved null block too, so this
             # reconciles exactly with a serve_kv_pool_mb budget

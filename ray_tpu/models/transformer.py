@@ -686,6 +686,7 @@ def make_paged_decoder(
     kv_dtype=None,
     attention_impl: str = "gather",
     fused_impl: str = "auto",
+    chunk_blocks: int = 8,
 ):
     """Build the paged fast path: (paged_prefill, paged_decode_step,
     copy_blocks) over a block pool from `init_paged_kv_cache`.
@@ -730,6 +731,10 @@ def make_paged_decoder(
                 block-sharded pools run per-shard with a log-sum-exp
                 merge across the block axes; tp-sharded kv_heads need no
                 merge.
+
+    `chunk_blocks` tunes the fused-XLA walk only (blocks folded per
+    online-softmax chunk — larger amortizes gather dispatch, smaller caps
+    the transient window); the Pallas kernel walks block-by-block.
     """
     if cfg.pp_stages > 1:
         raise NotImplementedError("decode does not support pp_stages > 1")
@@ -740,6 +745,9 @@ def make_paged_decoder(
         raise ValueError(
             f"attention_impl must be 'gather' or 'fused', got {attention_impl!r}"
         )
+    chunk_blocks = int(chunk_blocks)
+    if chunk_blocks <= 0:
+        raise ValueError(f"chunk_blocks must be positive, got {chunk_blocks}")
     kv_dtype = kv_dtype or cfg.dtype
     quant = kv_dtype == jnp.int8
     cos, sin = rope_frequencies(cfg.d_head, cfg.max_seq_len, cfg.rope_theta)
@@ -805,7 +813,7 @@ def make_paged_decoder(
         if not block_axes and not kv_axes:
             return paged_attention(
                 q1, kc, vc, tables, positions, scale=scale,
-                impl=fused_impl, **scales,
+                impl=fused_impl, chunk_blocks=chunk_blocks, **scales,
             )
 
         def inner(q1, kc, vc, *rest):
@@ -818,7 +826,7 @@ def make_paged_decoder(
             if not block_axes:
                 return paged_attention(
                     q1, kc, vc, tables, positions, scale=scale,
-                    impl=fused_impl, **sc,
+                    impl=fused_impl, chunk_blocks=chunk_blocks, **sc,
                 )
             # blocks are sharded: remap global table entries to this
             # shard's local ids (others masked dead), attend locally, and
@@ -832,7 +840,8 @@ def make_paged_decoder(
             ptab = jnp.where(live, tables - lo, -1).astype(jnp.int32)
             acc, m, l = paged_attention(
                 q1, kc, vc, ptab, positions, scale=scale, impl=fused_impl,
-                signed_tables=True, partial_out=True, **sc,
+                signed_tables=True, partial_out=True,
+                chunk_blocks=chunk_blocks, **sc,
             )
             return merge_partials(
                 acc, m, l, axis_names=block_axes, out_dtype=q1.dtype

@@ -55,6 +55,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+
+def _mosaic_params(dimension_semantics):
+    """compiler_params across jax versions: the dataclass was named
+    TPUCompilerParams on 0.4.x/0.5.x, CompilerParams later; before either,
+    pallas_call took a {"mosaic": {...}} dict."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is not None:
+        return cls(dimension_semantics=dimension_semantics)
+    return dict(mosaic=dict(dimension_semantics=dimension_semantics))
+
 # last resolved implementation ("kernel" | "xla"), recorded at trace time —
 # test observability: parity suites assert the path they intended to
 # exercise actually ran instead of silently falling back
@@ -198,6 +210,11 @@ def _paged_attention_pallas(q, k_pool, v_pool, ptable, positions,
             ],
         ),
         out_shape=out_shape,
+        # batch iterations are independent (scratch re-inits at j == 0);
+        # the block walk is sequential — it carries the online-softmax
+        # scratch. Telling Mosaic lets it parallelize/pipeline over b
+        # while keeping each slot's walk ordered.
+        compiler_params=_mosaic_params(("parallel", "arbitrary")),
         interpret=interpret,
     )(ptable, positions, *operands)
     if partial_out:

@@ -8,10 +8,61 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Persistent XLA compilation cache: on a small CPU host the tier-1 wall
+# clock is dominated by jit-compiling the same tiny-model executables
+# identically on every run. The cache keys on serialized HLO + compile
+# options + jax/XLA version, so hits are exact; a cold run pays a few
+# percent for the writes, every later run skips those compiles entirely.
+# Set as env vars (not only jax.config) so spawned worker processes
+# inherit it. Opt out / redirect with RAY_TPU_TEST_JAX_CACHE_DIR=off|<dir>.
+_cache_dir = os.environ.get("RAY_TPU_TEST_JAX_CACHE_DIR", "")
+_owns_cache = False
+if _cache_dir != "off":
+    if _cache_dir:
+        # an explicit redirect must win over an ambient JAX_COMPILATION_CACHE_DIR
+        # (e.g. a shared cache exported globally in CI)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+        _owns_cache = True
+    elif "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+            os.path.expanduser("~"), ".cache", "ray_tpu", "jax_test_cache"
+        )
+        _owns_cache = True
+    # retune write floors + eviction cap only for a directory this conftest
+    # owns — an inherited JAX_COMPILATION_CACHE_DIR is someone else's cache
+    # and must keep its own policy (zeroed floors write every trivial
+    # compile; the max size bounds the dir, but would LRU-evict a shared
+    # cache down to 256MB)
+    if _owns_cache:
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_MAX_SIZE", str(256 * 1024 * 1024)
+        )
 try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if _cache_dir != "off" and "JAX_COMPILATION_CACHE_DIR" in os.environ:
+        # sitecustomize may have imported jax before the env vars landed
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ["JAX_COMPILATION_CACHE_DIR"],
+        )
+    if _owns_cache:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+        )
+        jax.config.update(
+            "jax_compilation_cache_max_size",
+            int(os.environ["JAX_COMPILATION_CACHE_MAX_SIZE"]),
+        )
 except ImportError:
     pass
 

@@ -604,6 +604,29 @@ def test_fused_paged_matches_dense(tiny_f32):
     assert _gen(kern, 0, prompts[0], 4) == expect[0][:4]
 
 
+def test_fused_chunk_blocks_tuning_is_invisible_to_tokens(tiny_f32):
+    """`chunk_blocks` tunes the fused-XLA walk's gather granularity only —
+    any value (including one that doesn't divide the block-table length)
+    must produce the same greedy tokens as the gather reference."""
+    cfg, params = tiny_f32
+    prompts = _prompts(cfg, (5, 17, 30))
+    ref_eng = PagedDecodeEngine(cfg, params, max_batch_size=2, block_tokens=8)
+    ref = [_gen(ref_eng, i % 2, p, 10) for i, p in enumerate(prompts)]
+    for cb in (1, 3):
+        eng = PagedDecodeEngine(
+            cfg, params, max_batch_size=2, block_tokens=8,
+            attention_impl="fused:xla", chunk_blocks=cb,
+        )
+        got = [_gen(eng, i % 2, p, 10) for i, p in enumerate(prompts)]
+        assert got == ref, cb
+        assert eng.stats()["attention_chunk_blocks"] == cb
+    # a typo'd knob fails at replica construction, not first-step trace
+    with pytest.raises(ValueError, match="chunk_blocks"):
+        PagedDecodeEngine(
+            cfg, params, max_batch_size=2, block_tokens=8, chunk_blocks=0
+        )
+
+
 def test_fused_matches_dense_under_sharded_mesh(tiny_f32):
     """dp x fsdp x tp dryrun of the FUSED path: blocks sharded across
     dp/fsdp mean each shard sees a slice of the pool — the shard_map
