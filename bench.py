@@ -274,12 +274,21 @@ def main_decode():
         engine.admit(s, {"tokens": prompts[s], "max_new_tokens": 10**9})
     prefill_s = time.perf_counter() - t0
     engine.step(slots)  # decode compile + warm
+    # spec verify buckets compile OUT of the timed loop: a drafter's
+    # first mid-window proposal would otherwise bill a trace+compile
+    # to dt and sink the spec-on row
+    engine.warmup_verify()
+    gen0 = engine.tokens_generated
     t0 = time.perf_counter()
     for _ in range(new_tokens):
         engine.step(slots)
     dt = time.perf_counter() - t0
+    # count tokens EMITTED, not steps: with speculation on a step emits
+    # 1..k+1 per slot, and a steps-based rate would report a spec-on run
+    # as slower while the spec stats next to it say otherwise
+    emitted = engine.tokens_generated - gen0
 
-    tokens_per_sec_per_chip = batch * new_tokens / dt / n_chips
+    tokens_per_sec_per_chip = emitted / dt / n_chips
     estats = engine.stats()
     kind = getattr(dev, "device_kind", dev.platform)
     print(
@@ -303,6 +312,7 @@ def main_decode():
                 "batch": batch,
                 "prompt_len": prompt_len,
                 "new_tokens": new_tokens,
+                "emitted_tokens": int(emitted),
                 "prefill_ms": round(prefill_s * 1000, 1),
                 "decode_step_ms": round(dt / new_tokens * 1000, 3),
                 # which decode fast path produced this number — BENCH_r*
@@ -315,6 +325,12 @@ def main_decode():
                 # the pool was undersized for this batch/length mix)
                 "kv_block_utilization": estats["kv_block_utilization"],
                 "preemptions": estats["preemptions"],
+                # speculative decoding (serve_speculative_k; 0 = off):
+                # rows stay comparable across spec-on/spec-off rounds —
+                # tokens/s/chip plus which k and what the drafter earned
+                "spec_k": estats["spec_k"],
+                "spec_accept_rate": estats["spec_accept_rate"],
+                "spec_tokens_per_step": estats["spec_tokens_per_step"],
             }
         )
     )

@@ -105,6 +105,13 @@ D("direct_task_max_leases", int, 8,
   "max concurrently held worker leases per (caller, resource shape)")
 D("task_lease_idle_ms", int, 200,
   "idle time before a held task lease is released back to the cluster")
+D("data_plane_request_warn_s", float, 60.0,
+  "a driver->head data-plane request (get_objects dep resolution on the "
+  "direct task channels) still unanswered after this long logs a loud "
+  "repeating error naming its rid and the connection's other outstanding "
+  "rids — turns a lost request/reply pair (the standalone "
+  "test_repartition_exchange_exact wedge) into a diagnosable log line "
+  "next to the test hang-guard's stack dump; 0 disables")
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")
@@ -231,6 +238,19 @@ D("serve_kv_pool_mb", int, 0,
   "num_blocks = budget // block_bytes, so int8 pools hold ~2x the blocks "
   "of bf16 for the same bytes; 0 = use serve_kv_cache_blocks / the "
   "dense-equivalent default (explicit constructor args win over both)")
+D("serve_speculative_k", int, 0,
+  "speculative decoding on the paged engine: a drafter proposes up to k "
+  "tokens per slot per step and the target model verifies all k+1 "
+  "positions in ONE batched decode step — accepted tokens commit through "
+  "the block-table append, the rejected tail rolls back (table truncated, "
+  "blocks freed). Greedy output stays token-for-token identical to "
+  "non-speculative decode; greedy/temperature-0 only. 0 = off; the "
+  "single-stream latency win scales with the drafter's accept rate")
+D("serve_speculative_drafter", str, "ngram",
+  "drafter when serve_speculative_k > 0: 'ngram' (self-drafting suffix "
+  "lookup over the slot's own history — no extra model) or "
+  "'ngram:<max_n>'; PagedDecodeEngine(drafter=...) also accepts any "
+  "object with propose(tokens, k) -> tokens, the small-draft-model hook")
 D("serve_kv_prefix_cache", bool, True,
   "keep full prompt blocks in a hash-trie after release so identical "
   "prompt prefixes (system prompts, few-shot headers) share physical "

@@ -15,9 +15,12 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import pickle
 import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
 MAX_MSG = 1 << 40
@@ -219,15 +222,57 @@ class Connection:
             self.writer.write(_frame(msg, codec or self.codec))
             await self.writer.drain()
 
-    async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+    async def request(
+        self,
+        msg: dict,
+        timeout: Optional[float] = None,
+        warn_after_s: Optional[float] = None,
+        warn_tag: Optional[str] = None,
+    ) -> Any:
+        """Send `msg` with a fresh monotonic rid and await the correlated
+        reply. `warn_after_s` arms a watchdog that logs LOUDLY (repeating
+        each interval, naming the rid, message type, `warn_tag` and this
+        connection's other outstanding rids) while the reply is missing —
+        semantics are unchanged, but a lost request/reply pair becomes a
+        diagnosable log line next to a hang-guard stack dump instead of a
+        silent wedge."""
         rid = next(self._rid_counter)
         msg = dict(msg, rid=rid)
-        fut = asyncio.get_running_loop().create_future()
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
         self._pending[rid] = fut
-        await self.send(msg)
+        watchdog = None
+        # the send itself sits inside the cleanup scope: a failed/cancelled
+        # send must not leak the pending entry or an immortal watchdog
         try:
+            if warn_after_s and warn_after_s > 0:
+                t0 = loop.time()
+                mtype = msg.get("t")
+
+                async def _watch():
+                    while not fut.done():
+                        await asyncio.sleep(warn_after_s)
+                        if fut.done():
+                            return
+                        outstanding = sorted(
+                            r for r in self._pending if r != rid
+                        )
+                        logger.error(
+                            "request t=%r rid=%d%s has no reply after %.0fs "
+                            "(connection %s; %d other outstanding rids: %s)",
+                            mtype, rid,
+                            f" [{warn_tag}]" if warn_tag else "",
+                            loop.time() - t0,
+                            "closed" if self._closed else "open",
+                            len(outstanding), outstanding[:8],
+                        )
+
+                watchdog = loop.create_task(_watch())
+            await self.send(msg)
             return await asyncio.wait_for(fut, timeout)
         finally:
+            if watchdog is not None:
+                watchdog.cancel()
             self._pending.pop(rid, None)
 
     async def _close(self):

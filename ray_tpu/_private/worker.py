@@ -931,7 +931,14 @@ class _TaskChannel:
 
 async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
     """Resolve dep envelopes for a direct push (local cache first, head
-    for the rest) — shared by the actor and task direct channels."""
+    for the rest) — shared by the actor and task direct channels.
+
+    The head request is instrumented: every request/reply pair on the
+    head connection already carries a monotonic rid, and a reply missing
+    past data_plane_request_warn_s logs a loud repeating error naming the
+    orphaned get_objects request (rid, owning task, dep ids) — the known
+    lost-task wedge parks HERE with the head holding every dep, so the
+    hang-guard dump plus this line pinpoints the lost pair."""
     resolved = {}
     missing = []
     for oid in spec.get("deps", []):
@@ -941,8 +948,15 @@ async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
         else:
             missing.append(oid)
     if missing:
+        warn_s = float(cfg.data_plane_request_warn_s)
         envs = await worker.conn.request(
-            {"t": "get_objects", "object_ids": missing}
+            {"t": "get_objects", "object_ids": missing},
+            warn_after_s=warn_s if warn_s > 0 else None,
+            warn_tag=(
+                f"get_objects for task {spec.get('task_id')!r} "
+                f"({len(missing)} deps: "
+                f"{[str(o)[:16] for o in missing[:4]]}{'...' if len(missing) > 4 else ''})"
+            ),
         )
         resolved.update(dict(zip(missing, envs)))
     return resolved

@@ -21,3 +21,8 @@ from .kv_paging import (  # noqa: F401
     PagedDecodeEngine,
     PrefixCache,
 )
+from .speculative import (  # noqa: F401
+    NGramDrafter,
+    ReplayDrafter,
+    resolve_drafter,
+)

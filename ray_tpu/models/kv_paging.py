@@ -34,6 +34,21 @@ the uninterrupted run would have produced. The engine therefore never
 OOMs the replica: admission past capacity degrades to recompute, not to a
 crash.
 
+Speculative decoding (`speculative_k > 0`) layers propose/verify/commit on
+top of the same machinery: a drafter (models/speculative.py; self-drafting
+n-gram lookup by default, any propose(tokens, k) object as the
+small-draft-model hook) guesses up to k tokens per slot between steps, and
+ONE batched verify step scores all k+1 positions (transformer.py
+`paged_verify_step`). Accepted tokens commit through the normal block-table
+append; the rejected tail is rolled back by truncating the slot's table —
+freed blocks return to the allocator, and on int8 pools the partial last
+block is requantized by the verify commit itself (it replays the
+single-token RMW history for accepted tokens only). A step may therefore
+emit 1..k+1 tokens per slot: step() returns token LISTS when speculation
+is enabled. Greedy output is token-for-token identical to non-speculative
+decode by construction (acceptance compares drafts against the model's own
+argmax); speculation is greedy-only.
+
 Not thread-safe: one loop thread (the batcher's) owns admit/step/release;
 stats() reads are safe from other threads (plain int reads).
 """
@@ -255,6 +270,8 @@ class PagedDecodeEngine:
         attention_impl: Optional[str] = None,
         pool_bytes: Optional[int] = None,
         chunk_blocks: Optional[int] = None,
+        speculative_k: Optional[int] = None,
+        drafter=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -314,6 +331,51 @@ class PagedDecodeEngine:
                 f"chunk_blocks must be positive, got {chunk_blocks}"
             )
         self.chunk_blocks = chunk_blocks
+
+        speculative_k = int(
+            gcfg.serve_speculative_k if speculative_k is None
+            else speculative_k
+        )
+        if speculative_k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        self.speculative_k = speculative_k
+        self.drafter = None
+        if speculative_k:
+            if temperature > 0.0:
+                # acceptance compares drafts against the model's argmax;
+                # per-position sampling would not preserve the temperature
+                # distribution — refuse at construction, not mid-stream
+                raise ValueError(
+                    "speculative decoding is greedy-only (temperature 0), "
+                    f"got temperature={temperature}"
+                )
+            from .speculative import resolve_drafter
+
+            self.drafter = resolve_drafter(
+                drafter if drafter is not None
+                else gcfg.serve_speculative_drafter
+            )
+            if self.drafter is None:
+                raise ValueError(
+                    f"speculative_k={speculative_k} needs a drafter, but "
+                    "the drafter resolved to 'off'"
+                )
+            # draft lengths bucket to powers of two (plus k itself) so a
+            # jittery drafter compiles O(log k) verify shapes, not O(k)
+            buckets, b = [], 1
+            while b < speculative_k:
+                buckets.append(b)
+                b *= 2
+            buckets.append(speculative_k)
+            self._k_buckets = tuple(buckets)
+        elif drafter is not None:
+            # same strictness as the other conflicting-knob pairs: a
+            # drafter that can never run is a misconfiguration, not a noop
+            raise ValueError(
+                "drafter given but speculative_k is 0 — pass "
+                "speculative_k > 0 (or serve_speculative_k) to enable "
+                "speculative decoding"
+            )
 
         if num_blocks is not None and pool_bytes is not None:
             raise ValueError(
@@ -377,7 +439,7 @@ class PagedDecodeEngine:
         self.pool = init_paged_kv_cache(
             cfg, self.num_blocks, bt, mesh=mesh, rules=rules, dtype=kv_dtype
         )
-        self._prefill, self._decode_step, self._copy_blocks = (
+        self._prefill, self._decode_step, self._verify_step, self._copy_blocks = (
             make_paged_decoder(
                 cfg, rules=rules, mesh=mesh, temperature=temperature,
                 block_tokens=bt, kv_dtype=kv_dtype,
@@ -422,6 +484,13 @@ class PagedDecodeEngine:
         self.preemptions = 0
         self.cow_copies = 0
         self.prefill_shapes: set = set()  # (ctx_blocks, suffix_blocks) keys
+        # speculative decoding counters
+        self.spec_steps = 0
+        self.spec_slot_steps = 0  # (slot, verify-step) participations
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_shapes: set = set()  # K1 widths the verify step compiled
 
     # ------------------------------------------------------------- internals
 
@@ -669,55 +738,92 @@ class PagedDecodeEngine:
         if hist:
             hist[-1] = int(token)
 
-    def step(self, slots: List[int]) -> Dict[int, Tuple[int, bool]]:
-        """One cached decode step for the live slots in `slots`. Slots the
-        pool cannot grow are PREEMPTED (newest first) rather than OOMing;
-        they are absent from the result and surface via take_preempted()."""
-        bt = self.block_tokens
+    def step(self, slots: List[int]) -> Dict[int, Tuple[Any, bool]]:
+        """One engine step for the live slots in `slots`. Slots the pool
+        cannot grow are PREEMPTED (newest first) rather than OOMing; they
+        are absent from the result and surface via take_preempted().
+
+        Without speculation each slot's result is (token, done). With
+        `speculative_k > 0` a step that verified drafts returns
+        ([token, ...], done) — 1..k+1 tokens per slot — and steps where no
+        slot drafted fall back to the plain single-token result."""
         surviving = [s for s in sorted(set(slots)) if self._live[s]]
         if not surviving:
             return {}
+        if self.speculative_k:
+            drafts = self._propose(surviving)
+            if any(drafts.values()):
+                return self._spec_step(surviving, drafts)
+        return self._plain_step(surviving)
 
-        # resolve this step's block needs (new block at a block boundary,
-        # copy-on-write when the write block is shared) under pool pressure
-        while True:
-            needs = []
-            for s in surviving:
-                bidx = int(self._positions[s]) // bt
-                blk = int(self._tables[s, bidx])
+    def _span_need(self, surviving: List[int], block_span) -> int:
+        """Blocks the write spans require right now: unallocated entries
+        plus shared blocks that must copy-on-write. Conservative across
+        slots (a block shared between two stepping forks counts twice;
+        the first CoW un-shares it for the second)."""
+        need = 0
+        for s in surviving:
+            for bi in block_span(s):
+                blk = int(self._tables[s, bi])
                 if blk == 0 or self.allocator.refcount(blk) > 1:
-                    needs.append(s)
-            self._reclaim(len(needs))
-            if len(needs) <= self.allocator.num_free:
+                    need += 1
+        return need
+
+    def _reserve_write_spans(self, surviving: List[int], block_span) -> List[int]:
+        """Make every block index in block_span(s) writable for each
+        surviving slot — allocated and exclusively owned. ONE reservation
+        contract for the plain step (span = the single write block) and
+        the speculative step (span = the k+1-token verify window): evict
+        cache blocks, preempt newest-first under pressure, then allocate
+        + copy-on-write. Returns the surviving list (shrunk by
+        preemptions). Note _reclaim cannot change the spans' own need
+        (eviction only frees cache-ONLY blocks, refcount 1 — a span
+        block is always also held by its slot), so need is computed once
+        per pass."""
+        while True:
+            need = self._span_need(surviving, block_span)
+            self._reclaim(need)
+            if need <= self.allocator.num_free:
                 break
             victim = max(surviving, key=lambda s: self._admit_seq[s])
             self._preempt(victim)
             surviving.remove(victim)
             if not surviving:
-                return {}
+                return surviving
 
         cow_src: List[int] = []
         cow_dst: List[int] = []
-        for s in needs:
-            if s not in surviving:
-                continue
-            bidx = int(self._positions[s]) // bt
-            blk = int(self._tables[s, bidx])
-            if blk and self.allocator.refcount(blk) == 1:
-                continue  # an earlier CoW this step already un-shared it
-            nb = self.allocator.alloc(1)[0]
-            if blk:  # shared: copy-on-write before this slot's write
-                cow_src.append(blk)
-                cow_dst.append(nb)
-                self.allocator.decref(blk)
-                self.cow_copies += 1
-            self._tables[s, bidx] = nb
-            self._row_blocks[s] = max(int(self._row_blocks[s]), bidx + 1)
+        for s in surviving:
+            for bi in block_span(s):
+                blk = int(self._tables[s, bi])
+                if blk and self.allocator.refcount(blk) == 1:
+                    continue  # an earlier CoW this step already un-shared it
+                nb = self.allocator.alloc(1)[0]
+                if blk:  # shared: copy-on-write before this slot's write
+                    cow_src.append(blk)
+                    cow_dst.append(nb)
+                    self.allocator.decref(blk)
+                    self.cow_copies += 1
+                self._tables[s, bi] = nb
+                self._row_blocks[s] = max(int(self._row_blocks[s]), bi + 1)
         if cow_src:
             self.pool = self._copy_blocks(
                 self.pool, np.asarray(cow_src, np.int32),
                 np.asarray(cow_dst, np.int32),
             )
+        return surviving
+
+    def _plain_step(self, surviving: List[int]) -> Dict[int, Tuple[int, bool]]:
+        bt = self.block_tokens
+
+        # resolve this step's block needs (new block at a block boundary,
+        # copy-on-write when the write block is shared) under pool pressure
+        surviving = self._reserve_write_spans(
+            surviving,
+            lambda s: (int(self._positions[s]) // bt,),
+        )
+        if not surviving:
+            return {}
 
         B = self.max_batch_size
         write_phys = np.zeros(B, np.int32)  # inactive rows -> null block
@@ -744,6 +850,164 @@ class PagedDecodeEngine:
         self.decode_steps += 1
         self.tokens_generated += len(surviving)
         return out
+
+    # ----------------------------------------------------- speculative path
+
+    def warmup_verify(self) -> int:
+        """Compile every speculative verify bucket against the live pool
+        (the probe writes touch only the null block, outputs are
+        discarded). Call before a timed window or at replica start so a
+        drafter's FIRST proposal mid-traffic does not bill a trace +
+        compile to a real request. Returns the number of shapes warmed;
+        no-op with speculation off or shapes already compiled."""
+        if not self.speculative_k:
+            return 0
+        B = self.max_batch_size
+        warmed = 0
+        for k_eff in self._k_buckets:
+            K1 = k_eff + 1
+            if K1 in self.spec_shapes:
+                continue
+            zeros = np.zeros((B, K1), np.int32)
+            _, _, self.pool = self._verify_step(
+                self.params, self.pool, self._tables, zeros,
+                np.zeros(B, np.int32), np.zeros(B, np.int32),
+                zeros, zeros, self._next_key(),
+            )
+            self.spec_shapes.add(K1)
+            warmed += 1
+        return warmed
+
+    def _propose(self, surviving: List[int]) -> Dict[int, List[int]]:
+        """Ask the drafter for up to k tokens per slot, capped so the
+        verify span can neither outrun max_new_tokens (at most
+        remaining-1 drafts: the undrafted output is always one token) nor
+        write past max_seq_len. Drafter faults and out-of-vocab tokens
+        degrade to 'no draft' — a bad drafter may slow a stream down, it
+        must never wedge or corrupt it."""
+        drafts: Dict[int, List[int]] = {}
+        for s in surviving:
+            cap = min(
+                self.speculative_k,
+                int(self._max_new[s] - self._new_counts[s]) - 1,
+                self.max_seq_len - 1 - int(self._positions[s]),
+            )
+            if cap <= 0:
+                drafts[s] = []
+                continue
+            try:
+                # the LIVE history list, not a copy — O(seq) boxing per
+                # slot per step would erode the latency win speculation
+                # exists for; drafters must treat it as read-only
+                raw = self.drafter.propose(self._history[s] or (), cap)
+            except Exception:
+                raw = []
+            clean: List[int] = []
+            for t in list(raw)[:cap]:
+                t = int(t)
+                if not 0 <= t < self.cfg.vocab_size:
+                    break
+                clean.append(t)
+            drafts[s] = clean
+        return drafts
+
+    def _spec_step(
+        self, surviving: List[int], drafts: Dict[int, List[int]]
+    ) -> Dict[int, Tuple[List[int], bool]]:
+        """Verify each slot's draft in ONE batched forward and commit the
+        accepted prefix. Block bookkeeping is the plain step's, widened to
+        the k+1-token span: blocks for the whole span are taken up front
+        (preempting newest-first under pressure, CoW for shared write
+        blocks), and the rejected tail is rolled back afterwards by
+        truncating the table — unused blocks go straight back to the
+        allocator."""
+        bt = self.block_tokens
+
+        def _span_blocks(s: int):
+            p = int(self._positions[s])
+            return range(p // bt, (p + len(drafts.get(s, ()))) // bt + 1)
+
+        # speculation must never cost a preemption that non-speculative
+        # decode would not have paid: if the k+1-token spans cannot fit
+        # the pool without evicting a generation, drop the drafts and
+        # take the plain single-token step (which preempts only when even
+        # THAT cannot fit). The feasibility probe is SIDE-EFFECT-FREE —
+        # evictable() estimates what reclaim could free without actually
+        # flushing prefix-cache blocks for a speculation we then abandon.
+        need = self._span_need(surviving, _span_blocks)
+        evictable = (
+            self.prefix_cache.evictable() if self.prefix_cache else 0
+        )
+        if need > self.allocator.num_free + evictable:
+            return self._plain_step(surviving)
+        self._reclaim(need)
+        if need > self.allocator.num_free:
+            # reclaim under-delivered (evictable() counts blocks only a
+            # cascade of leaf evictions could reach): still no preemption
+            return self._plain_step(surviving)
+        surviving = self._reserve_write_spans(surviving, _span_blocks)
+        if not surviving:
+            return {}
+
+        kmax = max(len(drafts[s]) for s in surviving)
+        k_eff = next(b for b in self._k_buckets if b >= kmax)
+        K1 = k_eff + 1
+        B = self.max_batch_size
+        tokens = np.zeros((B, K1), np.int32)
+        draft_len = np.zeros(B, np.int32)
+        write_phys = np.zeros((B, K1), np.int32)  # dead/padded -> null block
+        write_off = np.zeros((B, K1), np.int32)
+        for s in surviving:
+            p = int(self._positions[s])
+            d = drafts.get(s, [])
+            tokens[s, 0] = self._last_tokens[s]
+            tokens[s, 1:1 + len(d)] = d
+            draft_len[s] = len(d)
+            for i in range(len(d) + 1):
+                write_phys[s, i] = self._tables[s, (p + i) // bt]
+                write_off[s, i] = (p + i) % bt
+        out, accepted, self.pool = self._verify_step(
+            self.params, self.pool, self._tables, tokens, self._positions,
+            draft_len, write_phys, write_off, self._next_key(),
+        )
+        out = np.asarray(out)
+        accepted = np.asarray(accepted)
+
+        results: Dict[int, Tuple[List[int], bool]] = {}
+        for s in surviving:
+            a = int(accepted[s])
+            final: List[int] = []
+            done = False
+            hist = self._history[s]
+            for tok in (int(t) for t in out[s, :a + 1]):
+                final.append(tok)
+                self._positions[s] += 1
+                self._new_counts[s] += 1
+                if hist is not None:
+                    hist.append(tok)
+                if self._done(s, tok):
+                    done = True
+                    break
+            self._last_tokens[s] = final[-1]
+            # rollback: truncate the table past the last committed token —
+            # span blocks the rejected tail reserved return to the pool
+            keep = (int(self._positions[s]) - 1) // bt + 1
+            for bi in range(keep, int(self._row_blocks[s])):
+                blk = int(self._tables[s, bi])
+                if blk:
+                    self.allocator.decref(blk)
+                    self._tables[s, bi] = 0
+            self._row_blocks[s] = min(int(self._row_blocks[s]), keep)
+            results[s] = (final, done)
+            self.tokens_generated += len(final)
+            self.spec_emitted += len(final)
+            self.spec_slot_steps += 1
+            self.spec_proposed += int(draft_len[s])
+            self.spec_accepted += a
+        self.decode_steps += 1
+        self.spec_steps += 1
+        self.spec_shapes.add(K1)
+        return results
 
     def take_preempted(self) -> List[Tuple[int, Dict[str, Any]]]:
         """(slot, parked_request) pairs preempted since the last call. The
@@ -787,4 +1051,21 @@ class PagedDecodeEngine:
             "prefix_tokens_reused": self.prefix_tokens_reused,
             "preemptions": self.preemptions,
             "cow_copies": self.cow_copies,
+            # speculative decoding: k=0 means off; rates cover spec steps
+            # only (a step where nobody drafted is a plain decode step)
+            "spec_k": self.speculative_k,
+            "spec_steps": self.spec_steps,
+            "spec_slot_steps": self.spec_slot_steps,
+            "spec_proposed_tokens": self.spec_proposed,
+            "spec_accepted_tokens": self.spec_accepted,
+            "spec_emitted_tokens": self.spec_emitted,
+            "spec_accept_rate": round(
+                self.spec_accepted / max(1, self.spec_proposed), 4
+            ),
+            # average accepted burst length per slot per verify step
+            # (1..k+1) — batch-size-independent, unlike tokens per ENGINE
+            # step which would just re-measure occupancy
+            "spec_tokens_per_step": round(
+                self.spec_emitted / max(1, self.spec_slot_steps), 2
+            ),
         }

@@ -1,0 +1,123 @@
+"""Draft-token proposers for speculative decoding on the paged engine.
+
+Speculative decoding splits token generation into a cheap PROPOSE and an
+exact VERIFY: a drafter guesses the next k tokens from the sequence so
+far, and the target model scores all k+1 positions in one batched paged
+decode step (`make_paged_decoder`'s `paged_verify_step`). Accepted
+tokens commit; the first mismatch rolls the rest back. Greedy output is
+token-for-token what non-speculative decode would have produced — the
+drafter only changes HOW FAST tokens arrive, never WHICH tokens.
+
+A drafter is anything with
+
+    propose(tokens: Sequence[int], k: int) -> Sequence[int]
+
+where `tokens` is the slot's full history (prompt + generated so far) and
+the return is up to k guesses for what comes next (shorter, including
+empty, is always legal — the engine pads short proposals and falls back
+to the plain single-token step when nobody proposes). Proposals must be
+CHEAP relative to a decode step: they run on the batcher's loop thread
+between steps. The engine passes its LIVE history sequence (no per-step
+copy); drafters must treat `tokens` as read-only.
+
+Built-ins:
+
+  NGramDrafter   self-drafting suffix lookup (prompt-lookup decoding): find
+                 the most recent earlier occurrence of the history's last
+                 n-gram and propose what followed it. No extra model, no
+                 device work — it wins whenever generation revisits spans
+                 it has produced or read before (code, quotes, structured
+                 output, greedy cycles).
+  ReplayDrafter  proposes continuations from recorded sequences whose
+                 prefix matches the history. The perfect-draft harness for
+                 benchmarks/tests (accept rate 1.0 by construction) and
+                 the shape a small-draft-model hook takes: anything that
+                 can guess a continuation plugs in the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class NGramDrafter:
+    """Suffix-lookup self-drafting: match the last `n` tokens (longest n
+    first) against the rest of the history; propose the continuation of
+    the most recent match."""
+
+    def __init__(self, max_n: int = 3, min_n: int = 1,
+                 max_history: int = 4096):
+        if not (1 <= min_n <= max_n):
+            raise ValueError(f"need 1 <= min_n <= max_n, got {min_n}..{max_n}")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+        self.max_history = int(max_history)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        # slice BEFORE converting: the history is the engine's live list
+        # and can far exceed the lookup window
+        arr = np.asarray(tokens[-self.max_history:], np.int64)
+        L = arr.size
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if L <= n:
+                continue
+            pat = arr[-n:]
+            # windows starting at 0..L-n-1: every occurrence EXCEPT the
+            # suffix itself (whose continuation is the future we want)
+            win = np.lib.stride_tricks.sliding_window_view(arr, n)[:-1]
+            hits = np.nonzero((win == pat).all(axis=1))[0]
+            if hits.size:
+                i = int(hits[-1])
+                return arr[i + n:i + n + k].tolist()
+        return []
+
+
+class ReplayDrafter:
+    """Propose from recorded sequences: if the history is a proper prefix
+    of any recorded sequence, the next k recorded tokens are the draft."""
+
+    def __init__(self, sequences: Sequence[Sequence[int]]):
+        self.sequences = [[int(t) for t in s] for s in sequences]
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        hist = [int(t) for t in tokens]
+        n = len(hist)
+        for seq in self.sequences:
+            if len(seq) > n and seq[:n] == hist:
+                return seq[n:n + k]
+        return []
+
+
+class _CallableDrafter:
+    def __init__(self, fn: Callable[[Sequence[int], int], Sequence[int]]):
+        self._fn = fn
+
+    def propose(self, tokens: Sequence[int], k: int) -> Sequence[int]:
+        return self._fn(tokens, k)
+
+
+def resolve_drafter(spec) -> Optional[object]:
+    """Turn a config value into a drafter: 'ngram' / 'ngram:<max_n>' build
+    the built-in, ''/'off'/None disable, and any object with .propose (or
+    a bare callable — the small-draft-model hook) passes through."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip().lower()
+        if s in ("", "off", "none"):
+            return None
+        if s == "ngram":
+            return NGramDrafter()
+        if s.startswith("ngram:"):
+            return NGramDrafter(max_n=int(s[len("ngram:"):]))
+        raise ValueError(
+            f"unknown drafter {spec!r}: expected 'ngram', 'ngram:<max_n>', "
+            "'off', or an object with propose(tokens, k)"
+        )
+    if hasattr(spec, "propose"):
+        return spec
+    if callable(spec):
+        return _CallableDrafter(spec)
+    raise ValueError(f"drafter {spec!r} has no propose(tokens, k)")

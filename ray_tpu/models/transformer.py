@@ -689,7 +689,8 @@ def make_paged_decoder(
     chunk_blocks: int = 8,
 ):
     """Build the paged fast path: (paged_prefill, paged_decode_step,
-    copy_blocks) over a block pool from `init_paged_kv_cache`.
+    paged_verify_step, copy_blocks) over a block pool from
+    `init_paged_kv_cache`.
 
     paged_prefill(params, pool, table[Nmax], tokens[1,Sb], length, ctx_len,
                   key, ctx_blocks) -> (next_token[1], logits[1,V], pool)
@@ -709,6 +710,42 @@ def make_paged_decoder(
       the null block — and attention gathers each slot's logical sequence
       via its block table. ONE compiled shape per (B, Nmax) regardless of
       live sequence lengths or block-table contents.
+
+    paged_verify_step(params, pool, tables[B,Nmax], tokens[B,K1],
+                      positions[B], draft_len[B], write_phys[B,K1],
+                      write_off[B,K1], key)
+        -> (out_tokens[B,K1], accepted[B], pool)
+      Speculative decoding's verify: tokens[:, 0] is each slot's pending
+      input token and tokens[:, 1:] its (padded) draft; ONE batched
+      forward scores all K1 positions, greedy acceptance is computed
+      in-graph (draft i survives iff it matches the model's output at
+      position i-1 and every earlier draft survived), and ONLY the
+      accepted inputs' K/V commit to the pool — rejected entries route to
+      the null block, so there is nothing in the pool to roll back.
+      Attention reuses the paged-prefill window machinery: the slot's
+      cached window is gathered through its table and the K1 in-flight
+      K/V are appended past it with a causal tail mask, so no pool write
+      precedes acceptance. Like prefill, the verify step ALWAYS runs this
+      gather-window formulation — `attention_impl="fused"` covers only
+      the single-token decode step (the Pallas kernel is single-query);
+      extending the fused walk to the k+1-query verify is the named TPU
+      follow-up, and until then long-context speculation re-pays part of
+      the gather cost the fused kernel removed.
+      Compiled once per (B, K1, Nmax) — the engine
+      buckets K1 (kv_paging) so draft-length jitter cannot churn the jit
+      cache. Greedy-only: with temperature > 0 the per-position samples
+      would not preserve the sampling distribution (the engine refuses to
+      enable speculation off greedy).
+
+      fp pools commit with one masked scatter; int8 pools REPLAY the
+      single-token RMW sequence (a K1-step in-graph scan of the same
+      dequant -> zero-tail -> insert -> requantize write), so the
+      committed bytes and scales are bit-identical to non-speculative
+      decode having written the accepted tokens one at a time. The only
+      int8 divergence is that verify attends the in-flight K/V at full
+      precision (the reference attends them post-quantization) — greedy
+      tokens can differ only where quantization noise alone would flip
+      the argmax.
 
     copy_blocks(pool, src[n], dst[n]) -> pool
       Copy-on-write: duplicate physical blocks across all layers (refcount
@@ -778,14 +815,35 @@ def make_paged_decoder(
         ).astype(cfg.dtype)
 
     def _quantize(win):
-        """[G, bt, KV, D] f32 -> (int8 blocks, [G, KV] f32 scales)."""
-        amax = jnp.max(jnp.abs(win), axis=(1, 3))
+        """[..., bt, KV, D] f32 -> (int8 blocks, [..., KV] f32 scales).
+        Leading dims are free: the prefill path quantizes [G] blocks, the
+        decode RMW [B], the speculative commit [L, B]."""
+        amax = jnp.max(jnp.abs(win), axis=(-3, -1))
         s = amax / 127.0
         q8 = jnp.clip(
-            jnp.round(win / jnp.maximum(s, 1e-20)[:, None, :, None]),
+            jnp.round(win / jnp.maximum(s, 1e-20)[..., None, :, None]),
             -127, 127,
         ).astype(jnp.int8)
         return q8, s
+
+    def _rmw_insert_quant(blk, s0, knew, wo):
+        """The int8 token write's shared math — dequantize the write
+        block, zero the stale tail, insert ONE token, requantize — over
+        arbitrary leading dims: blk [..., B, bt, KV, D], s0 [..., B, KV],
+        knew [..., B, KV, D], wo [B]. The single-token decode step and
+        the speculative verify commit both call THIS, so the commit's
+        replayed write history cannot drift from the per-token reference
+        (spec-vs-plain int8 bit-identity of the pool depends on it).
+        With an unchanged scale the existing tokens round-trip exactly;
+        a scale bump re-rounds them once at the new grain."""
+        B = wo.shape[0]
+        deq = blk.astype(jnp.float32) * s0[..., None, :, None]
+        keep = jnp.arange(bt)[:, None, None] < wo[:, None, None, None]
+        deq = jnp.where(keep, deq, 0.0)
+        deq = deq.at[..., jnp.arange(B), wo, :, :].set(
+            knew.astype(jnp.float32)
+        )
+        return _quantize(deq)
 
     # ---- fused attention (ops/paged_attention.py), sharding-aware -------
 
@@ -975,20 +1033,12 @@ def make_paged_decoder(
 
         def _write_token_quant(kc, ksc, knew):
             """Quantized decode write: read-modify-write each slot's write
-            block — dequant, insert the token at its offset, zero the
-            not-yet-written tail (recycled blocks carry stale values that
-            would poison the scale), requantize. With an unchanged scale
-            the existing tokens round-trip exactly; a scale bump re-rounds
-            them once at the new grain. knew is [B, KV, D]."""
-            blk = kc[write_phys]  # [B, bt, KV, D] int8
-            s0 = ksc[write_phys]  # [B, KV]
-            deq = blk.astype(jnp.float32) * s0[:, None, :, None]
-            t = jnp.arange(bt)[None, :, None, None]
-            deq = jnp.where(t < write_off[:, None, None, None], deq, 0.0)
-            deq = deq.at[jnp.arange(B), write_off].set(
-                knew.astype(jnp.float32)
+            block (shared math in `_rmw_insert_quant` — recycled blocks
+            carry stale values past the live span that would poison the
+            scale, hence the zero-tail). knew is [B, KV, D]."""
+            q8, s1 = _rmw_insert_quant(
+                kc[write_phys], ksc[write_phys], knew, write_off
             )
-            q8, s1 = _quantize(deq)
             return kc.at[write_phys].set(q8), ksc.at[write_phys].set(s1)
 
         def layer_fn(x, per_layer):
@@ -1040,6 +1090,118 @@ def make_paged_decoder(
         logits = _constrain(logits, "batch", "vocab")
         return _sample(logits, key), logits, _pool_dict(new_leaves)
 
+    def _rmw_commit_quant(kc, ksc, knew, wp_i, wo_i):
+        """[L]-batched twin of the decode step's `_write_token_quant`:
+        ONE token into each slot's write block across every layer at
+        once. The RMW math itself is `_rmw_insert_quant`, shared with the
+        per-token decode write — replaying it per accepted token
+        reproduces the single-token write history bit-for-bit. knew is
+        [L, B, KV, D]."""
+        q8, s1 = _rmw_insert_quant(kc[:, wp_i], ksc[:, wp_i], knew, wo_i)
+        return kc.at[:, wp_i].set(q8), ksc.at[:, wp_i].set(s1)
+
+    def _verify_commit(pool, ks, vs, wp, wo):
+        """Write the accepted inputs' K/V stacks ([L,B,K1,KV,D]) into the
+        pool; rejected/dead entries arrive with wp == 0 (null block)."""
+        if not quant:
+            return {
+                "k": pool["k"].at[:, wp, wo].set(ks.astype(pool["k"].dtype)),
+                "v": pool["v"].at[:, wp, wo].set(vs.astype(pool["v"].dtype)),
+            }
+
+        def one(carry, xs):
+            kc, ksc, vc, vsc = carry
+            k_i, v_i, wp_i, wo_i = xs
+            kc, ksc = _rmw_commit_quant(kc, ksc, k_i, wp_i, wo_i)
+            vc, vsc = _rmw_commit_quant(vc, vsc, v_i, wp_i, wo_i)
+            return (kc, ksc, vc, vsc), None
+
+        # token order matters: each RMW zeroes past its own offset, so the
+        # scan walks positions ascending — exactly the sequential history
+        (kc, ksc, vc, vsc), _ = lax.scan(
+            one,
+            (pool["k"], pool["k_scale"], pool["v"], pool["v_scale"]),
+            (jnp.moveaxis(ks, 2, 0), jnp.moveaxis(vs, 2, 0), wp.T, wo.T),
+        )
+        return {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
+
+    def _verify_body(params, pool, tables, tokens, positions, draft_len,
+                     write_phys, write_off, key):
+        params = _cast_matmul_params(cfg, params)
+        B, K1 = tokens.shape
+        Nmax = tables.shape[1]
+        W = Nmax * bt
+        x = params["embed"].astype(cfg.dtype)[tokens]  # [B, K1, E]
+        x = _constrain(x, "batch", "seq", "embed")
+        qpos = positions[:, None] + jnp.arange(K1)[None, :]  # [B, K1]
+        # padded tail positions can run past the rope tables (they are
+        # rejected by the draft_len mask; clamp keeps the gather in range)
+        rope_pos = jnp.minimum(qpos, cfg.max_seq_len - 1)
+        # cached window holds positions 0..p-1 (the pending token's K/V is
+        # NOT yet written); everything at or past p in a recycled block is
+        # stale. In-flight tokens attend each other causally past the
+        # window — appended, never written, so acceptance decides what
+        # lands in the pool.
+        cmask = jnp.broadcast_to(
+            jnp.arange(W)[None, None, :] < positions[:, None, None],
+            (B, K1, W),
+        )
+        fmask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((K1, K1), bool))[None], (B, K1, K1)
+        )
+        mask = jnp.concatenate([cmask, fmask], axis=2)  # [B, K1, W+K1]
+
+        def layer_fn(x, per_layer):
+            if quant:
+                lp, kc, vc, ksc, vsc = per_layer
+            else:
+                lp, kc, vc = per_layer
+            h = rms_norm(x, lp["attn_norm"])
+            q = jnp.einsum("bse,ehd->bshd", h, lp["wq"])
+            k = jnp.einsum("bse,ekd->bskd", h, lp["wk"])
+            v = jnp.einsum("bse,ekd->bskd", h, lp["wv"])
+            q = apply_rope(q, cos, sin, positions=rope_pos)
+            k = apply_rope(k, cos, sin, positions=rope_pos)
+            q = _constrain(q, "batch", "seq", "heads", "head_dim")
+            if quant:
+                kw = _dequant(kc[tables], ksc[tables]).reshape(
+                    B, W, *kc.shape[2:]
+                )
+                vw = _dequant(vc[tables], vsc[tables]).reshape(
+                    B, W, *vc.shape[2:]
+                )
+            else:
+                kw = kc[tables].reshape(B, W, *kc.shape[2:])
+                vw = vc[tables].reshape(B, W, *vc.shape[2:])
+            kcat = jnp.concatenate([kw, k.astype(kw.dtype)], axis=1)
+            vcat = jnp.concatenate([vw, v.astype(vw.dtype)], axis=1)
+            attn = _cached_attend(q, kcat, vcat, mask, scale, n_rep)
+            x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"])
+            x = x + _mlp(h2, lp, cfg, _constrain)
+            x = _constrain(x, "batch", "seq", "embed")
+            return x, (k, v)
+
+        x, (ks, vs) = lax.scan(
+            layer_fn, x, (params["layers"],) + _scan_leaves(pool)
+        )
+        x = rms_norm(x, params["final_norm"])
+        logits = jnp.einsum("bse,ev->bsv", x, _unembed_matrix(cfg, params))
+        logits = _constrain(logits, "batch", "seq", "vocab")
+        out = _sample(logits, key)  # [B, K1]
+        # greedy acceptance: draft i survives iff it equals the model's
+        # output one position earlier AND every prior draft survived
+        match = (tokens[:, 1:] == out[:, :-1]) & (
+            jnp.arange(1, K1)[None, :] <= draft_len[:, None]
+        )
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(
+            axis=1
+        ).astype(jnp.int32)
+        commit = jnp.arange(K1)[None, :] <= accepted[:, None]  # [B, K1]
+        wp = jnp.where(commit, write_phys, 0)
+        pool = _verify_commit(pool, ks, vs, wp, write_off)
+        return out, accepted, pool
+
     def _copy_body(pool, src, dst):
         # every pool leaf (K/V blocks AND their scales) has the physical
         # block dim at axis 1
@@ -1048,8 +1210,9 @@ def make_paged_decoder(
         }
 
     paged_decode_step = jax.jit(_decode_body, donate_argnums=(1,))
+    paged_verify_step = jax.jit(_verify_body, donate_argnums=(1,))
     copy_blocks = jax.jit(_copy_body, donate_argnums=(0,))
-    return paged_prefill, paged_decode_step, copy_blocks
+    return paged_prefill, paged_decode_step, paged_verify_step, copy_blocks
 
 
 def make_decoder(

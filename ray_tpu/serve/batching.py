@@ -248,6 +248,12 @@ class ContinuousBatcher:
       step(slots)          -> {slot: (token, done)}
       release(slot)          optional
 
+    A step result may also carry a token LIST per slot (speculative
+    decoding: PagedDecodeEngine with speculative_k > 0 emits 1..k+1
+    accepted tokens per verify step). Every token is pushed to the
+    stream individually, so SSE consumers see the whole accepted burst
+    and deadlines/drain/preemption still cut at token granularity.
+
     One loop thread owns the engine. Requests submitted while the batch is
     full wait in a queue and are admitted the moment a slot retires —
     mid-generation of everyone else (that is the whole point). The
@@ -392,7 +398,11 @@ class ContinuousBatcher:
                 for k in ("kv_blocks_total", "kv_blocks_free",
                           "kv_blocks_cached", "preemptions", "prefix_hits",
                           "kv_block_bytes", "kv_pool_bytes",
-                          "kv_cache_dtype", "attention_impl"):
+                          "kv_cache_dtype", "attention_impl",
+                          "spec_k", "spec_steps", "spec_slot_steps",
+                          "spec_proposed_tokens", "spec_accepted_tokens",
+                          "spec_emitted_tokens", "spec_accept_rate",
+                          "spec_tokens_per_step"):
                     if k in es:
                         out[k] = es[k]
         return out
@@ -653,7 +663,11 @@ class ContinuousBatcher:
                     stream._finish()
                     self._retire(slot)
                     continue
-                stream._push(tok)
+                # multi-token retirement: a speculative verify step may
+                # emit a burst of accepted tokens — push each one so the
+                # stream (and its SSE consumer) sees them all in order
+                for t in (tok if isinstance(tok, (list, tuple)) else (tok,)):
+                    stream._push(t)
                 if done:
                     stream._finish()
                     self._retire(slot)

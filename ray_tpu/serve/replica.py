@@ -261,6 +261,8 @@ class Replica:
         saturated when its SLOTS are, long before queued-call counts say so."""
         slots = active = queued = 0
         kv_total = kv_free = preempt = kv_bytes = 0
+        spec_k = spec_slot_steps = spec_proposed = 0
+        spec_accepted = spec_emitted = 0
         for v in self._drainables():
             get_stats = getattr(v, "stats", None)
             if get_stats is None:
@@ -291,10 +293,24 @@ class Replica:
             # engine's figure includes the null block, so it reconciles
             # exactly with a serve_kv_pool_mb budget
             kv_bytes += int(s.get("kv_pool_bytes", 0))
+            # speculative decoding: aggregate the raw counters and derive
+            # the replica-level rates from their sums, so a fleet of
+            # batchers reports one honest accept rate instead of an
+            # average of per-batcher averages
+            spec_k = max(spec_k, int(s.get("spec_k", 0)))
+            spec_slot_steps += int(s.get("spec_slot_steps", 0))
+            spec_proposed += int(s.get("spec_proposed_tokens", 0))
+            spec_accepted += int(s.get("spec_accepted_tokens", 0))
+            spec_emitted += int(s.get("spec_emitted_tokens", 0))
         return {"batch_slots": slots, "batch_active": active,
                 "batch_queued": queued, "kv_blocks_total": kv_total,
                 "kv_blocks_free": kv_free, "kv_preemptions": preempt,
-                "kv_pool_bytes": kv_bytes}
+                "kv_pool_bytes": kv_bytes,
+                "spec_k": spec_k,
+                "spec_accept_rate": round(
+                    spec_accepted / max(1, spec_proposed), 4),
+                "spec_tokens_per_step": round(
+                    spec_emitted / max(1, spec_slot_steps), 2)}
 
     def stats(self) -> Dict[str, Any]:
         self._reap_idle_streams()

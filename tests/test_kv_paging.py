@@ -683,7 +683,7 @@ def test_int8_logits_within_tolerance(tiny_f32):
     results = {}
     for name, kv_dtype in (("fp", None), ("int8", jnp.int8)):
         pool = init_paged_kv_cache(cfg, 8, bt, dtype=kv_dtype)
-        prefill, step, _ = make_paged_decoder(
+        prefill, step, _verify, _copy = make_paged_decoder(
             cfg, block_tokens=bt, kv_dtype=kv_dtype
         )
         _, lg_p, pool = prefill(

@@ -115,3 +115,69 @@ def test_log_tail_partial_line_semantics(tmp_path):
     assert log_tail.read_increments(d, offsets, pending) == [
         ("worker-1", "FATAL no newline")
     ]
+
+
+def test_connection_request_warns_on_stalled_reply(tmp_path, caplog):
+    """Data-plane diagnosability (the standalone lost-task wedge): a
+    Connection.request armed with warn_after_s logs a loud error naming
+    the orphaned rid + tag while the reply is missing, repeats it, and
+    still delivers the reply when it finally lands."""
+    import asyncio
+    import logging
+
+    from ray_tpu._private import protocol
+
+    async def main():
+        path = os.path.join(str(tmp_path), "sock")
+        release = asyncio.Event()
+
+        async def server_handler(msg):
+            if msg.get("t") == "slow":
+                await release.wait()
+                return "finally"
+            return "fast"
+
+        conns = []
+
+        async def on_client(reader, writer):
+            conns.append(
+                protocol.Connection(reader, writer, server_handler).start()
+            )
+
+        server = await asyncio.start_unix_server(on_client, path=path)
+        reader, writer = await protocol.open_stream(path)
+
+        async def client_handler(msg):
+            return None
+
+        conn = protocol.Connection(reader, writer, client_handler).start()
+        assert await conn.request({"t": "fast"}) == "fast"
+
+        async def _release_later():
+            await asyncio.sleep(0.35)
+            release.set()
+
+        rel = asyncio.get_running_loop().create_task(_release_later())
+        with caplog.at_level(logging.ERROR, logger="ray_tpu._private.protocol"):
+            got = await conn.request(
+                {"t": "slow"}, warn_after_s=0.1,
+                warn_tag="get_objects for task 'T-test' (1 deps)",
+            )
+        await rel
+        assert got == "finally"
+        warns = [r for r in caplog.records if "no reply after" in r.message]
+        assert warns, caplog.records
+        text = warns[0].getMessage()
+        assert "t='slow'" in text and "T-test" in text and "rid=" in text
+        assert len(warns) >= 2  # repeats each interval while orphaned
+        # an answered request never warns
+        caplog.clear()
+        assert await conn.request({"t": "fast"}, warn_after_s=5.0) == "fast"
+        assert not caplog.records
+        await conn.close()
+        for c in conns:
+            await c.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
