@@ -237,6 +237,35 @@ def main_hbm():
 # --------------------------------------------------------------------------
 
 
+def _decode_realtext_spec(k: int = 4, new_tokens: int = 48) -> dict:
+    """Real-text drafter measurement riding the decode row: load a hub
+    model (RAY_TPU_BENCH_MODEL_PATH, else the checked-in fixture), run
+    the n-gram drafter over tokenizer-encoded English prompts, and record
+    the measured accept rate + the model's identity. Measured, never
+    asserted — drafter yield on real text is a model/workload property,
+    and the row exists precisely to OBSERVE it (PR 7's open question).
+    Absent model files degrade to the synthetic identity, never a fault."""
+    out = {"model_id": None, "params_source": "synthetic",
+           "spec_accept_rate_realtext": None}
+    path = os.environ.get("RAY_TPU_BENCH_MODEL_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "fixtures", "hub_gpt2_tiny",
+    )
+    try:
+        from ray_tpu.models.hub import measure_realtext_spec
+
+        m = measure_realtext_spec(path, k=k, new_tokens=new_tokens)
+        out.update(
+            model_id=m["model_id"],
+            params_source=m["params_source"],
+            spec_accept_rate_realtext=m["spec_accept_rate"],
+        )
+    except Exception as e:
+        print(f"[bench:decode] realtext spec measurement unavailable: {e!r}",
+              file=sys.stderr)
+    return out
+
+
 def main_decode():
     """Batched KV-cache decode throughput: the serving-side counterpart of
     the training rows. Prefills `batch` slots, then times `new_tokens`
@@ -244,7 +273,10 @@ def main_decode():
     serve replica drives — block-table gather attention, so the row also
     tracks the paging overhead), reporting tokens/s/chip plus block-pool
     utilization and preemptions. The batched-vs-serial and prefix-hit
-    gates live in microbench.py; this row is the absolute rate."""
+    gates live in microbench.py; this row is the absolute rate. The row
+    also carries the real-text drafter measurement (model-hub weights +
+    tokenizer-encoded English prompts) so decode trajectories name which
+    weights they speak for."""
     import dataclasses
 
     import jax
@@ -331,6 +363,10 @@ def main_decode():
                 "spec_k": estats["spec_k"],
                 "spec_accept_rate": estats["spec_accept_rate"],
                 "spec_tokens_per_step": estats["spec_tokens_per_step"],
+                # which weights/tokenizer this round can speak for + what
+                # the n-gram drafter measured on real-text prompts (hub
+                # model; "synthetic" when no checkpoint was loadable)
+                **_decode_realtext_spec(),
             }
         )
     )

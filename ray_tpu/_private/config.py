@@ -251,6 +251,13 @@ D("serve_speculative_drafter", str, "ngram",
   "lookup over the slot's own history — no extra model) or "
   "'ngram:<max_n>'; PagedDecodeEngine(drafter=...) also accepts any "
   "object with propose(tokens, k) -> tokens, the small-draft-model hook")
+D("serve_model_path", str, "",
+  "default checkpoint DIRECTORY for serve.openai_api.OpenAICompletions "
+  "(model.safetensors + config.json + vocab.json + merges.txt — the "
+  "model-hub layout, models/hub); explicit constructor args win")
+D("serve_model_id", str, "",
+  "model id the OpenAI-compatible endpoint advertises in /v1/models and "
+  "completion responses; empty = the checkpoint directory's name")
 D("serve_kv_prefix_cache", bool, True,
   "keep full prompt blocks in a hash-trie after release so identical "
   "prompt prefixes (system prompts, few-shot headers) share physical "

@@ -26,3 +26,4 @@ from .speculative import (  # noqa: F401
     ReplayDrafter,
     resolve_drafter,
 )
+from . import hub  # noqa: F401  — real checkpoints + tokenizers (model hub)

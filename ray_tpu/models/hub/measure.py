@@ -1,0 +1,64 @@
+"""Shared real-text drafter measurement (bench.py + microbench.py).
+
+One implementation of the drive loop both bench surfaces report: load a
+hub checkpoint, run the n-gram drafter over tokenizer-encoded English
+prompts through a speculative PagedDecodeEngine, and return the measured
+accept rate with the model's identity. MEASURED, never asserted —
+drafter yield on real text is a property of the model's output
+distribution, and the whole point of the row is to observe it
+(ROADMAP item 1 / PR 7's open question).
+
+Raises on missing/unreadable checkpoints; callers choose their own
+degradation (bench rows fall back to a "synthetic" identity).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+_DEFAULT_PROMPTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "In the morning the sun was shining over the hills.",
+]
+
+
+def measure_realtext_spec(
+    path: str,
+    k: int = 4,
+    new_tokens: int = 48,
+    prompts: Optional[List[str]] = None,
+) -> Dict[str, object]:
+    """Returns {model_id, params_source, spec_accept_rate,
+    spec_tokens_per_step} for the checkpoint directory at `path` (its
+    reference.json supplies the prompt set when present)."""
+    from ..kv_paging import PagedDecodeEngine
+    from .checkpoint import load_model
+
+    bundle = load_model(path)
+    if prompts is None:
+        ref_path = os.path.join(path, "reference.json")
+        if os.path.exists(ref_path):
+            with open(ref_path, encoding="utf-8") as f:
+                prompts = json.load(f)["prompts"]
+        else:
+            prompts = _DEFAULT_PROMPTS
+    eng = PagedDecodeEngine(
+        bundle.cfg, bundle.params, max_batch_size=1, seed=0,
+        eos_id=bundle.eos_id, speculative_k=k, drafter="ngram",
+    )
+    eng.warmup_verify()
+    for text in prompts:
+        ids = bundle.tokenizer.encode(text)
+        _, done = eng.admit(0, {"tokens": ids, "max_new_tokens": new_tokens})
+        while not done:
+            (_, done), = eng.step([0]).values()
+        eng.release(0)
+    stats = eng.stats()
+    return {
+        "model_id": bundle.model_id,
+        "params_source": bundle.params_source,
+        "spec_accept_rate": stats["spec_accept_rate"],
+        "spec_tokens_per_step": stats["spec_tokens_per_step"],
+    }

@@ -1,0 +1,210 @@
+"""Minimal safetensors I/O: lazy per-tensor mmap reads, no torch.
+
+Format (https://github.com/huggingface/safetensors, stable since 0.3):
+
+    [8 bytes] little-endian u64 N = header length
+    [N bytes] JSON header: {tensor_name: {"dtype": "F32", "shape": [..],
+              "data_offsets": [begin, end]}, ...} plus an optional
+              "__metadata__" str->str dict
+    [  ...  ] tensor data, offsets relative to the end of the header
+
+The reader maps the file once (`mmap`, read-only) and materializes ONE
+tensor per `tensor()` call as a numpy array viewing the mapped pages —
+the OS pages in only the bytes actually touched, so loading a sharded
+model reads each shard's bytes once and never the whole file into an
+anonymous buffer. This is the property the checkpoint loader builds on:
+transform + device_put one tensor at a time, peak host memory stays
+O(largest tensor), not O(model).
+
+The writer exists for fixture generation and round-trip tests; it writes
+the same layout the reference implementation produces (sorted keys,
+contiguous C-order data).
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# safetensors dtype tag <-> numpy dtype. BF16 needs ml_dtypes (jax ships
+# it); resolved lazily so pure-f32 files work even without it.
+_DTYPES: Dict[str, Any] = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _np_dtype(tag: str) -> np.dtype:
+    if tag == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_DTYPES[tag])
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {tag!r}") from None
+
+
+def _tag_for(dtype: np.dtype) -> str:
+    dtype = np.dtype(dtype)
+    if dtype.name == "bfloat16":
+        return "BF16"
+    for tag, np_t in _DTYPES.items():
+        if np.dtype(np_t) == dtype:
+            return tag
+    raise ValueError(f"unsupported numpy dtype {dtype!r}")
+
+
+class SafetensorsFile:
+    """Lazy reader over one .safetensors file.
+
+    Usage:
+        with SafetensorsFile(path) as f:
+            for name in f.keys():
+                arr = f.tensor(name)        # np view onto the mmap
+                ...                         # copy/transform before close
+
+    `tensor()` returns a READ-ONLY array viewing the mapped file; callers
+    that outlive the file (or need to mutate) must copy. `np.ascontiguousarray`
+    / any arithmetic already copies, which is what the checkpoint mapper's
+    transforms do anyway.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._f = open(self.path, "rb")
+        try:
+            head = self._f.read(8)
+            if len(head) != 8:
+                raise ValueError(f"{path}: truncated safetensors header")
+            (n,) = struct.unpack("<Q", head)
+            # guard before allocating: a corrupt length must not OOM
+            if n > 100 * (1 << 20):
+                raise ValueError(f"{path}: implausible header length {n}")
+            try:
+                header = json.loads(self._f.read(n))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}: malformed safetensors header: {e}")
+            self.metadata: Dict[str, str] = header.pop("__metadata__", {}) or {}
+            self._entries: Dict[str, Dict[str, Any]] = header
+            self._data_start = 8 + n
+            self._mm = mmap.mmap(
+                self._f.fileno(), 0, access=mmap.ACCESS_READ
+            )
+        except Exception:
+            self._f.close()
+            raise
+
+    # ------------------------------------------------------------- contents
+
+    def keys(self) -> List[str]:
+        return list(self._entries.keys())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._entries)
+
+    def shape(self, name: str) -> Tuple[int, ...]:
+        return tuple(self._entries[name]["shape"])
+
+    def dtype(self, name: str) -> np.dtype:
+        return _np_dtype(self._entries[name]["dtype"])
+
+    def nbytes(self, name: str) -> int:
+        b, e = self._entries[name]["data_offsets"]
+        return int(e) - int(b)
+
+    def tensor(self, name: str) -> np.ndarray:
+        """One tensor as a read-only numpy view onto the mapped file —
+        only these pages fault in; nothing else is read."""
+        ent = self._entries.get(name)
+        if ent is None:
+            raise KeyError(
+                f"{self.path}: no tensor {name!r} "
+                f"(has {sorted(self._entries)[:8]}...)"
+            )
+        dtype = _np_dtype(ent["dtype"])
+        shape = tuple(ent["shape"])
+        begin, end = (int(x) for x in ent["data_offsets"])
+        expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if end - begin != expect:
+            raise ValueError(
+                f"{self.path}: tensor {name!r} spans {end - begin} bytes, "
+                f"shape {shape} x {dtype} needs {expect}"
+            )
+        # offsets are relative to the data section: negative or
+        # past-the-end values would silently reinterpret header bytes (or
+        # nothing) as weights via the whole-file mmap
+        data_len = len(self._mm) - self._data_start
+        if not 0 <= begin <= end <= data_len:
+            raise ValueError(
+                f"{self.path}: tensor {name!r} offsets [{begin}, {end}] "
+                f"fall outside the {data_len}-byte data section"
+            )
+        arr = np.frombuffer(
+            self._mm, dtype=dtype, count=expect // dtype.itemsize,
+            offset=self._data_start + begin,
+        ).reshape(shape)
+        arr.flags.writeable = False
+        return arr
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except BufferError:
+            # live tensor() views still reference the mapping: leave it to
+            # die with them (the OS mapping outlives the fd close below)
+            pass
+        finally:
+            self._f.close()
+
+    def __enter__(self) -> "SafetensorsFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def load_file(path: str) -> Dict[str, np.ndarray]:
+    """Eager convenience: every tensor COPIED out (safe after close).
+    Prefer SafetensorsFile + per-tensor reads for anything model-sized."""
+    with SafetensorsFile(path) as f:
+        return {k: np.array(f.tensor(k)) for k in f.keys()}
+
+
+def save_file(
+    tensors: Dict[str, np.ndarray],
+    path: str,
+    metadata: Optional[Dict[str, str]] = None,
+) -> None:
+    """Write `tensors` in safetensors layout (sorted names, C-order)."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+    arrays: List[np.ndarray] = []
+    offset = 0
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        header[name] = {
+            "dtype": _tag_for(arr.dtype),
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + arr.nbytes],
+        }
+        arrays.append(arr)
+        offset += arr.nbytes
+    payload = json.dumps(header, separators=(",", ":")).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(payload)))
+        f.write(payload)
+        for arr in arrays:
+            f.write(arr.tobytes())

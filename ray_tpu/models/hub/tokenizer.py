@@ -1,0 +1,277 @@
+"""GPT-2-family byte-level BPE tokenizer (vocab.json + merges.txt).
+
+Byte-level BPE never fails on input: text is UTF-8-encoded to bytes,
+bytes map 1:1 to 256 printable unicode "byte tokens" (the GPT-2 table —
+control/whitespace bytes are remapped above U+0100 so vocab/merges files
+stay readable), and BPE merges only ever combine those. Every merge
+learned on English text therefore starts from the same 256-symbol base
+alphabet; the classic GPT-2 quirk that words carry their LEADING SPACE
+("Ġthe" = " the") falls out of the pre-tokenizer keeping the space
+attached to the following word.
+
+Streaming: one token is NOT one unicode character — a multi-byte UTF-8
+sequence (emoji, CJK) routinely splits across tokens, so decoding tokens
+independently yields mojibake. `IncrementalDetokenizer` feeds token bytes
+through an incremental UTF-8 decoder that holds back incomplete tail
+sequences; the SSE path emits exactly the complete characters available
+so far and flushes the remainder (replacement-charred if truly invalid)
+at end of stream.
+
+No external deps: the exact GPT-2 pre-tokenizer pattern needs the
+`regex` module for \\p{L}/\\p{N}; when unavailable we fall back to an
+`re`-based approximation ([^\\W\\d_] for letters, \\d for digits) that
+agrees with it on ASCII + most scripts. Fixture reference encodings are
+generated and checked with the SAME implementation, so tests are
+self-consistent either way.
+"""
+
+from __future__ import annotations
+
+import codecs
+import functools
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_GPT2_SPLIT = (
+    r"'s|'t|'re|'ve|'m|'ll|'d"
+    r"| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+
+def _compile_split():
+    try:
+        import regex
+
+        return regex.compile(_GPT2_SPLIT)
+    except ImportError:
+        import re
+
+        # \p{L} ~ [^\W\d_] under re.UNICODE; \p{N} ~ \d — close enough
+        # for the scripts the fixtures cover, and self-consistent with
+        # the fixture generator (which uses the same fallback). The
+        # punctuation class must include "_" explicitly: GPT-2's
+        # [^\s\p{L}\p{N}] treats it as punctuation, but _ is \w in re —
+        # a bare [^\s\w] would DROP underscores from the input (findall
+        # skips unmatched chars), and no input may ever be dropped.
+        return re.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d"
+            r"| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+|\s+(?!\S)|\s+"
+        )
+
+
+@functools.lru_cache()
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte<->unicode table: printable latin-1 bytes map to
+    themselves, the rest shift above U+0100 — a bijection over all 256
+    byte values whose images are all printable (so vocab.json and
+    merges.txt are plain readable text files)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+def _get_pairs(word: Tuple[str, ...]):
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+class ByteBPETokenizer:
+    """vocab.json (token string -> id) + merges.txt (rank-ordered pairs).
+
+    Special tokens (e.g. "<|endoftext|>") are matched as literal spans
+    BEFORE pre-tokenization, so their text never byte-encodes; any vocab
+    entry shaped like <|...|> is auto-registered."""
+
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        merges: Sequence[Tuple[str, str]],
+        special_tokens: Optional[Iterable[str]] = None,
+    ):
+        self.encoder: Dict[str, int] = dict(vocab)
+        self.decoder: Dict[int, str] = {v: k for k, v in self.encoder.items()}
+        if len(self.decoder) != len(self.encoder):
+            raise ValueError("vocab maps two tokens to one id")
+        self.bpe_ranks: Dict[Tuple[str, str], int] = {
+            tuple(m): i for i, m in enumerate(merges)
+        }
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        specials = set(special_tokens or ())
+        specials.update(
+            t for t in self.encoder
+            if t.startswith("<|") and t.endswith("|>")
+        )
+        unknown = sorted(t for t in specials if t not in self.encoder)
+        if unknown:
+            raise ValueError(f"special tokens not in vocab: {unknown}")
+        # longest-first so overlapping specials match greedily
+        self.special_tokens: List[str] = sorted(specials, key=len, reverse=True)
+        self._special_ids = {self.encoder[t] for t in self.special_tokens}
+        self._split = _compile_split()
+        self._cache: Dict[str, List[str]] = {}
+        self.eos_token = (
+            "<|endoftext|>" if "<|endoftext|>" in self.encoder else None
+        )
+        self.eos_id = (
+            self.encoder[self.eos_token] if self.eos_token is not None else None
+        )
+
+    # -------------------------------------------------------------- loading
+
+    @classmethod
+    def from_files(
+        cls,
+        vocab_path: str,
+        merges_path: str,
+        special_tokens: Optional[Iterable[str]] = None,
+    ) -> "ByteBPETokenizer":
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(merges_path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.rstrip("\n")
+                # ONLY the first line may be the "#version: ..." header:
+                # '#' is a legitimate merge symbol ("# #" -> "##" in real
+                # gpt2 vocabularies), so a blanket comment skip would
+                # silently drop merges and break tokenization parity
+                if not line or (i == 0 and line.startswith("#version")):
+                    continue
+                a, _, b = line.partition(" ")
+                if not b:
+                    raise ValueError(f"malformed merge line {line!r}")
+                merges.append((a, b))
+        return cls(vocab, merges, special_tokens)
+
+    @classmethod
+    def from_dir(cls, path: str, **kw) -> "ByteBPETokenizer":
+        return cls.from_files(
+            os.path.join(path, "vocab.json"),
+            os.path.join(path, "merges.txt"),
+            **kw,
+        )
+
+    def __len__(self) -> int:
+        return len(self.encoder)
+
+    # ------------------------------------------------------------------ BPE
+
+    def _bpe(self, token: str) -> List[str]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word: Tuple[str, ...] = tuple(token)
+        pairs = _get_pairs(word)
+        while pairs:
+            best = min(
+                pairs, key=lambda p: self.bpe_ranks.get(p, float("inf"))
+            )
+            if best not in self.bpe_ranks:
+                break
+            a, b = best
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        out = list(word)
+        if len(self._cache) < 16384:  # bounded; hot words dominate anyway
+            self._cache[token] = out
+        return out
+
+    def _encode_ordinary(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for piece in self._split.findall(text):
+            mapped = "".join(
+                self.byte_encoder[b] for b in piece.encode("utf-8")
+            )
+            for sub in self._bpe(mapped):
+                tid = self.encoder.get(sub)
+                if tid is None:
+                    # unmerged base symbol missing from a truncated vocab:
+                    # fall back to its byte tokens (never drop input)
+                    for ch in sub:
+                        ids.append(self.encoder[ch])
+                else:
+                    ids.append(tid)
+        return ids
+
+    def encode(self, text: str) -> List[int]:
+        """Text -> token ids; special-token literals become their ids."""
+        if not self.special_tokens:
+            return self._encode_ordinary(text)
+        ids: List[int] = []
+        rest = text
+        while rest:
+            hit, pos = None, len(rest)
+            for sp in self.special_tokens:
+                i = rest.find(sp)
+                if i != -1 and i < pos:
+                    hit, pos = sp, i
+            if hit is None:
+                ids.extend(self._encode_ordinary(rest))
+                break
+            if pos:
+                ids.extend(self._encode_ordinary(rest[:pos]))
+            ids.append(self.encoder[hit])
+            rest = rest[pos + len(hit):]
+        return ids
+
+    # --------------------------------------------------------------- decode
+
+    def token_bytes(self, token_id: int) -> bytes:
+        """The raw bytes one token contributes to the output stream."""
+        tok = self.decoder.get(int(token_id))
+        if tok is None:
+            return b""
+        if token_id in self._special_ids:
+            return tok.encode("utf-8")
+        return bytes(self.byte_decoder[c] for c in tok)
+
+    def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        return b"".join(self.token_bytes(i) for i in ids)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    def detokenizer(self) -> "IncrementalDetokenizer":
+        return IncrementalDetokenizer(self)
+
+
+class IncrementalDetokenizer:
+    """Token-at-a-time detokenization that never splits a character: feed
+    ids with push(), get back only the COMPLETE text available so far;
+    incomplete UTF-8 tails stay buffered until their continuation bytes
+    arrive (or flush() force-decodes them with replacement chars)."""
+
+    def __init__(self, tok: ByteBPETokenizer):
+        self._tok = tok
+        self._dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+
+    def push(self, token_id: int) -> str:
+        return self._dec.decode(self._tok.token_bytes(token_id), False)
+
+    def push_many(self, ids: Sequence[int]) -> str:
+        return self._dec.decode(self._tok.decode_bytes(ids), False)
+
+    def flush(self) -> str:
+        return self._dec.decode(b"", True)

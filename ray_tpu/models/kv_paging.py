@@ -556,6 +556,15 @@ class PagedDecodeEngine:
 
     # ----------------------------------------------------------- engine API
 
+    def worst_case_blocks(self, prompt_len: int, max_new: int) -> int:
+        """Blocks a request can EVER need: its full prompt + max_new span,
+        capped at max_seq_len. The single formula behind admission (both
+        the hard-fail and the budget check) and the serving API's
+        submit-time validation — one definition, so a doomed request is
+        judged identically at every gate."""
+        span = min(int(prompt_len) + int(max_new), self.max_seq_len)
+        return -(-span // self.block_tokens)
+
     def can_admit(self, request: Dict[str, Any]) -> bool:
         """Worst-case block-budget admission check: free + cache-evictable
         blocks must cover the request's full prompt + max_new_tokens span,
@@ -568,8 +577,7 @@ class PagedDecodeEngine:
             return True  # let admit() raise the real validation error
         mnt = request.get("max_new_tokens")
         mnt = self.default_max_new_tokens if mnt is None else max(1, int(mnt))
-        total = min(length + mnt, self.max_seq_len)
-        worst = -(-total // self.block_tokens)
+        worst = self.worst_case_blocks(length, mnt)
         if worst > self.allocator.num_usable:
             # can NEVER fit: report admissible so the batcher routes it to
             # admit(), whose worst-case check fails it with the hard
@@ -621,7 +629,7 @@ class PagedDecodeEngine:
         # on readmission). length + max_new is invariant across preemption
         # cycles, so passing this check once means readmission can never
         # hard-fail by size.
-        worst = -(-min(length + mnt, self.max_seq_len) // bt)
+        worst = self.worst_case_blocks(length, mnt)
         if worst > self.allocator.num_usable:
             raise ValueError(
                 f"request worst case of {worst} KV blocks "

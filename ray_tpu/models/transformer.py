@@ -100,6 +100,17 @@ class TransformerConfig:
     moe_impl: str = "dispatch"
     moe_capacity_factor: float = 1.25
     tie_embeddings: bool = False
+    # "silu_gate": llama-family gated MLP (w_gate/w_up/w_down, silu) —
+    # the default everywhere. "gelu": gpt2-family two-matmul MLP
+    # (w_up/w_down, tanh-approx gelu, no gate) — what the model hub's
+    # gpt2-class checkpoint mapping loads into (models/hub/checkpoint.py);
+    # dense MLP only (MoE keeps the gated experts)
+    mlp_variant: str = "silu_gate"
+    # trailing vocab entries that exist only for sharding alignment (e.g.
+    # a checkpoint's 50257-token vocab padded to 50304 so the vocab dim
+    # divides a tp mesh): embedding rows are zero, and the samplers mask
+    # their logits to -inf so a padded id can never be emitted
+    vocab_pad: int = 0
     # pipeline parallelism: >1 splits the layer stack into pp stages
     pp_stages: int = 1
     pp_microbatches: int = 4
@@ -109,12 +120,20 @@ class TransformerConfig:
     # O(tokens x vocab) residual HBM — worth a batch-size step on 16G chips
     loss_chunk: int = 0
 
+    def __post_init__(self):
+        if self.mlp_variant not in ("silu_gate", "gelu"):
+            raise ValueError(
+                f"mlp_variant must be 'silu_gate' or 'gelu', "
+                f"got {self.mlp_variant!r}"
+            )
+
     def flops_per_token(self) -> float:
         """Approximate training FLOPs/token (fwd+bwd ≈ 6 * params-matmul)."""
         attn = 2 * self.d_model * self.d_head * (self.n_heads + 2 * self.n_kv_heads)
         attn += 2 * self.n_heads * self.d_head * self.d_model
         mlp_mult = self.n_experts if self.n_experts else 1
-        mlp = 3 * 2 * self.d_model * self.d_ff * (min(self.top_k, mlp_mult) if self.n_experts else 1)
+        n_mats = 2 if (not self.n_experts and self.mlp_variant == "gelu") else 3
+        mlp = n_mats * 2 * self.d_model * self.d_ff * (min(self.top_k, mlp_mult) if self.n_experts else 1)
         per_layer = attn + mlp
         # attention scores/values: 2 * 2 * L * d per token (L = seq len, set at call)
         embed = 2 * self.d_model * self.vocab_size
@@ -133,7 +152,7 @@ class TransformerConfig:
             lp += self.d_model * self.n_experts  # router
             lp += self.n_experts * 3 * self.d_model * self.d_ff
         else:
-            lp += 3 * self.d_model * self.d_ff
+            lp += (2 if self.mlp_variant == "gelu" else 3) * self.d_model * self.d_ff
         total = self.n_layers * lp + self.d_model
         total += self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
         return total
@@ -197,6 +216,11 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
             w_up=("layers", "expert", "embed", "mlp"),
             w_down=("layers", "expert", "mlp", "embed"),
         )
+    elif cfg.mlp_variant == "gelu":
+        layer.update(
+            w_up=("layers", "embed", "mlp"),
+            w_down=("layers", "mlp", "embed"),
+        )
     else:
         layer.update(
             w_gate=("layers", "embed", "mlp"),
@@ -242,6 +266,11 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
             w_gate=dense_init(next(keys), (L, X, E, F), E),
             w_up=dense_init(next(keys), (L, X, E, F), E),
             w_down=dense_init(next(keys), (L, X, F, E), F),
+        )
+    elif cfg.mlp_variant == "gelu":
+        layer.update(
+            w_up=dense_init(next(keys), (L, E, F), E),
+            w_down=dense_init(next(keys), (L, F, E), F),
         )
     else:
         layer.update(
@@ -356,11 +385,18 @@ def _mlp(h, lp, cfg: TransformerConfig, constrain_fn):
         return _moe_dispatch(h, lp, cfg, constrain_fn)
     from jax.ad_checkpoint import checkpoint_name
 
-    g = checkpoint_name(
-        jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(h.dtype)), "mlp_gate"
-    )
     u = checkpoint_name(
         jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(h.dtype)), "mlp_up"
+    )
+    if cfg.mlp_variant == "gelu":
+        # gpt2-family two-matmul MLP (tanh-approx gelu, matching gelu_new)
+        u = constrain_fn(u, "batch", "seq", "mlp")
+        return jnp.einsum(
+            "bsf,fe->bse", jax.nn.gelu(u, approximate=True),
+            lp["w_down"].astype(h.dtype),
+        )
+    g = checkpoint_name(
+        jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(h.dtype)), "mlp_gate"
     )
     g = constrain_fn(g, "batch", "seq", "mlp")
     return jnp.einsum("bsf,fe->bse", jax.nn.silu(g) * u, lp["w_down"].astype(h.dtype))
@@ -579,12 +615,18 @@ def init_kv_cache(
     return {"k": k, "v": v}
 
 
-def _make_sampler(temperature: float):
+def _make_sampler(temperature: float, vocab_pad: int = 0):
     """Greedy argmax (temperature 0) or categorical sampling — ONE
     implementation shared by the dense and paged decoders, so their
-    token-for-token parity cannot drift."""
+    token-for-token parity cannot drift. `vocab_pad` masks the trailing
+    alignment-only vocab entries (see TransformerConfig.vocab_pad) to
+    -inf so a padded id can never win the argmax / be sampled."""
 
     def _sample(logits, key):
+        if vocab_pad:
+            V = logits.shape[-1]
+            pad = jnp.arange(V) >= V - vocab_pad
+            logits = jnp.where(pad, NEG_INF, logits)
         if temperature > 0.0:
             return jax.random.categorical(
                 key, logits.astype(jnp.float32) / temperature, axis=-1
@@ -796,7 +838,7 @@ def make_paged_decoder(
             return x
         return constrain(x, rules, *axes, mesh=mesh)
 
-    _sample = _make_sampler(temperature)
+    _sample = _make_sampler(temperature, cfg.vocab_pad)
 
     def _scan_leaves(pool):
         """Pool leaves in the fixed order the layer scans unpack."""
@@ -1256,7 +1298,7 @@ def make_decoder(
             return x
         return constrain(x, rules, *axes, mesh=mesh)
 
-    _sample = _make_sampler(temperature)
+    _sample = _make_sampler(temperature, cfg.vocab_pad)
 
     def _prefill(params, tokens, lengths, key):
         params = _cast_matmul_params(cfg, params)

@@ -33,6 +33,7 @@ from .http_proxy import (  # noqa: F401
 )
 from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from .openai_api import OpenAICompletions, openai_app  # noqa: F401
 from .replica import ReplicaDrainingError, ReplicaStreamHandle  # noqa: F401
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"

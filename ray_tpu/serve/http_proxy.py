@@ -100,6 +100,13 @@ class StreamingResponse:
             self.chunks = list(self.chunks)
 
 
+def _sse_encode(item) -> str:
+    """Default SSE payload encoding: strings pass through, everything else
+    is JSON — str() of a dict/list would emit python repr (single quotes),
+    which standard SSE consumers (OpenAI clients included) cannot parse."""
+    return item if isinstance(item, str) else json.dumps(item)
+
+
 class _SSEStream:
     """Format a pull-style token stream (GenerationStream) as server-sent
     events while PRESERVING its long-poll next_batch surface, so replica
@@ -107,7 +114,7 @@ class _SSEStream:
     is `data: [DONE]` — preceded by `event: cut` when the generation was
     truncated at a drain deadline."""
 
-    def __init__(self, inner, encode=str):
+    def __init__(self, inner, encode=_sse_encode):
         self._inner = inner
         self._encode = encode
 
@@ -127,12 +134,14 @@ class _SSEStream:
             cancel()
 
 
-def sse_stream(stream, encode=str) -> StreamingResponse:
+def sse_stream(stream, encode=_sse_encode) -> StreamingResponse:
     """Wrap a token stream as a non-buffered text/event-stream response:
     every token becomes its own SSE `data:` event delivered per-token over
-    chunked transfer. `stream` is ideally pull-style (has next_batch, e.g.
-    ContinuousBatcher.submit()'s GenerationStream); plain iterables work
-    but pull one chunk per stream_next round-trip."""
+    chunked transfer — `data: <payload>\\n\\n` frames ending with the
+    `data: [DONE]\\n\\n` sentinel (the OpenAI wire shape; dict/list items
+    are JSON-encoded by default). `stream` is ideally pull-style (has
+    next_batch, e.g. ContinuousBatcher.submit()'s GenerationStream); plain
+    iterables work but pull one chunk per stream_next round-trip."""
     if hasattr(stream, "next_batch"):
         chunks: Any = _SSEStream(stream, encode)
     else:

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Deterministic model-hub fixture generator (NO network, NO torch).
+
+Writes tests/fixtures/hub_gpt2_tiny/ — a complete tiny gpt2-shaped
+checkpoint directory the hub tests and benches load offline:
+
+    model.safetensors   gpt2-NAMED tensors (wte/wpe, h.{i}.ln_1,
+                        attn.c_attn fused-qkv Conv1D [E, 3E], attn.c_proj,
+                        mlp.c_fc/c_proj, ln_f — weights AND the biases /
+                        position embeddings the loader must drop), values
+                        from a fixed seed
+    config.json         HF-style gpt2 config (n_embd/n_head/n_layer/...)
+    vocab.json          256 byte tokens + BPE merges + <|endoftext|>
+    merges.txt          rank-ordered merges TRAINED here on the embedded
+                        corpus (so leading-space merges like "Ġthe" arise
+                        the way they do in real gpt2 vocabularies)
+    reference.json      recorded reference encodings (tokenizer regression
+                        surface) + English bench prompts + fixture ids
+
+Re-running reproduces byte-identical files (fixed seed, deterministic
+BPE tie-breaks); CI never regenerates — the fixture is checked in.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ray_tpu.models.hub.tokenizer import (  # noqa: E402
+    ByteBPETokenizer,
+    _compile_split,
+    bytes_to_unicode,
+)
+
+SEED = 20260804
+N_MERGES = 64
+N_EMBD, N_HEAD, N_LAYER, N_POSITIONS = 32, 4, 2, 128
+OUT_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "hub_gpt2_tiny"
+)
+
+# The BPE training corpus: repetitive English so common merges ("th",
+# "the", "Ġthe", "in", "ing", ...) earn their ranks exactly as they do at
+# scale. Also the source of the bench's real-text prompts.
+CORPUS = """\
+The quick brown fox jumps over the lazy dog. The dog was not amused by
+the quick brown fox, and the fox was not amused by the dog. In the
+morning the sun was shining over the hills and the king was counting his
+gold in the counting house. The people of the town were singing in the
+streets, and the singing could be heard over the hills and far away.
+When the king heard the singing he was pleased, and he sent the people
+of the town a thousand pieces of gold from the counting house. The
+people were pleased with the king, and the king was pleased with the
+people, and the town was pleased with the morning sun over the hills.
+There was singing and counting and shining all over the town in the
+morning, and the quick brown fox jumped over the lazy dog again and
+again and again until the morning turned into the evening and the
+evening turned into the night and the night turned into the morning.
+"""
+
+PROMPTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "In the morning the sun was shining over the hills.",
+    "The people of the town were singing in the streets.",
+    "The king was counting his gold in the counting house.",
+    "The singing could be heard over the hills and far away.",
+]
+
+# tokenizer regression surface: unicode, leading-space merges, specials,
+# multi-byte sequences that SPLIT across byte tokens
+REFERENCE_TEXTS = [
+    "The quick brown fox",
+    " the the the",
+    "hello world",
+    "counting house",
+    "café naïve résumé",
+    "日本語のテスト",
+    "emoji \U0001f680\U0001f40d end",
+    "mixed é日\U0001f680x",
+    "tabs\tand\nnewlines  double space",
+    "<|endoftext|>",
+    "before<|endoftext|>after",
+    "1234 numbers 5,678.90",
+    "don't can't it's",
+]
+
+
+def train_bpe(corpus: str, n_merges: int):
+    """Tiny deterministic byte-level BPE trainer: count adjacent symbol
+    pairs over the pre-tokenized corpus, merge the most frequent
+    (lexicographic tie-break), repeat."""
+    byte_enc = bytes_to_unicode()
+    split = _compile_split()
+    words = collections.Counter()
+    for piece in split.findall(corpus):
+        words[tuple(byte_enc[b] for b in piece.encode("utf-8"))] += 1
+    merges = []
+    for _ in range(n_merges):
+        pairs = collections.Counter()
+        for word, cnt in words.items():
+            for i in range(len(word) - 1):
+                pairs[(word[i], word[i + 1])] += cnt
+        if not pairs:
+            break
+        best = min(pairs.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        merges.append(best)
+        a, b = best
+        new_words = collections.Counter()
+        for word, cnt in words.items():
+            out, i = [], 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    out.append(a + b)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            new_words[tuple(out)] += cnt
+        words = new_words
+    return merges
+
+
+def build_tokenizer_files(out_dir: str):
+    merges = train_bpe(CORPUS, N_MERGES)
+    # vocab: 256 byte tokens (codepoint order, the gpt2 convention), then
+    # merged tokens in rank order, then the special
+    vocab = {}
+    for ch in sorted(bytes_to_unicode().values(), key=ord):
+        vocab[ch] = len(vocab)
+    for a, b in merges:
+        tok = a + b
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(os.path.join(out_dir, "vocab.json"), "w", encoding="utf-8") as f:
+        json.dump(vocab, f, ensure_ascii=False, indent=0, sort_keys=False)
+        f.write("\n")
+    with open(os.path.join(out_dir, "merges.txt"), "w", encoding="utf-8") as f:
+        f.write("#version: 0.2\n")
+        for a, b in merges:
+            f.write(f"{a} {b}\n")
+    return len(vocab)
+
+
+def build_checkpoint(out_dir: str, vocab_size: int):
+    E, H, L, F = N_EMBD, N_HEAD, N_LAYER, 4 * N_EMBD
+    rng = np.random.default_rng(SEED)
+
+    def w(*shape, scale=0.02):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tensors = {
+        "wte.weight": w(vocab_size, E),
+        # dropped by the loader (rope replaces learned positions) — present
+        # so the drop path is exercised by a real tensor, not a unit stub
+        "wpe.weight": w(N_POSITIONS, E),
+        "ln_f.weight": np.ones(E, np.float32) + w(E, scale=0.1),
+        "ln_f.bias": w(E),
+    }
+    for i in range(L):
+        p = f"h.{i}."
+        tensors[p + "ln_1.weight"] = np.ones(E, np.float32) + w(E, scale=0.1)
+        tensors[p + "ln_1.bias"] = w(E)
+        # Conv1D layout: [in, out] — fused qkv
+        tensors[p + "attn.c_attn.weight"] = w(E, 3 * E)
+        tensors[p + "attn.c_attn.bias"] = w(3 * E)
+        tensors[p + "attn.c_proj.weight"] = w(E, E)
+        tensors[p + "attn.c_proj.bias"] = w(E)
+        tensors[p + "ln_2.weight"] = np.ones(E, np.float32) + w(E, scale=0.1)
+        tensors[p + "ln_2.bias"] = w(E)
+        tensors[p + "mlp.c_fc.weight"] = w(E, F)
+        tensors[p + "mlp.c_fc.bias"] = w(F)
+        tensors[p + "mlp.c_proj.weight"] = w(F, E)
+        tensors[p + "mlp.c_proj.bias"] = w(E)
+    from ray_tpu.models.hub.safetensors_io import save_file
+
+    save_file(
+        tensors, os.path.join(out_dir, "model.safetensors"),
+        metadata={"format": "pt", "fixture": "hub_gpt2_tiny",
+                  "seed": str(SEED)},
+    )
+    config = {
+        "model_type": "gpt2",
+        "vocab_size": vocab_size,
+        "n_embd": E,
+        "n_head": H,
+        "n_layer": L,
+        "n_positions": N_POSITIONS,
+        "n_inner": F,
+        "tie_word_embeddings": True,
+    }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+        f.write("\n")
+
+
+def build_reference(out_dir: str):
+    tok = ByteBPETokenizer.from_dir(out_dir)
+    encodings = [
+        {"text": t, "ids": tok.encode(t)} for t in REFERENCE_TEXTS
+    ]
+    ref = {
+        "model_id": "hub_gpt2_tiny",
+        "seed": SEED,
+        "vocab_size": len(tok),
+        "eos_id": tok.eos_id,
+        "prompts": PROMPTS,
+        "encodings": encodings,
+    }
+    with open(os.path.join(out_dir, "reference.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(ref, f, ensure_ascii=False, indent=1)
+        f.write("\n")
+
+
+def main():
+    out_dir = os.path.abspath(OUT_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    vocab_size = build_tokenizer_files(out_dir)
+    build_checkpoint(out_dir, vocab_size)
+    build_reference(out_dir)
+    sizes = {
+        f: os.path.getsize(os.path.join(out_dir, f))
+        for f in sorted(os.listdir(out_dir))
+    }
+    print(f"wrote {out_dir} (vocab={vocab_size}):")
+    for f, s in sizes.items():
+        print(f"  {f}: {s} bytes")
+
+
+if __name__ == "__main__":
+    main()

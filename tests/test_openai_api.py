@@ -1,0 +1,350 @@
+"""OpenAI-compatible /v1 surface over the fixture model (ISSUE 12).
+
+Raw-socket clients drive the deployed endpoint exactly the way a stock
+OpenAI client does: POST /v1/completions with the standard request
+shape, assert the standard response shapes — including the SSE wire
+format (`data: {json}\\n\\n` frames, `data: [DONE]\\n\\n` sentinel,
+Content-Type: text/event-stream) and that streamed greedy text equals
+the non-streamed completion for the same prompt. Offline: the model is
+the checked-in tests/fixtures/hub_gpt2_tiny."""
+
+import json
+import os
+import socket
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.openai_api import _StopBuffer, openai_app
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "hub_gpt2_tiny"
+)
+
+
+# ------------------------------------------------------------- unit pieces
+
+
+def test_stop_buffer_holds_back_potential_matches():
+    sb = _StopBuffer(["END"])
+    assert sb.push("hello E") == "hello "   # "E" could start "END"
+    assert sb.push("N") == ""               # "EN" still could
+    assert sb.push("joy") == "ENjoy"        # resolved: not a stop
+    assert sb.push(" so EN") == " so "
+    assert sb.push("D tail") == ""          # matched: nothing after
+    assert sb.matched and sb.flush() == ""
+
+
+def test_stop_buffer_earliest_match_wins():
+    sb = _StopBuffer(["xx", "yy"])
+    assert sb.push("a yy b xx c") == "a "
+    assert sb.matched
+
+
+def test_stop_buffer_flush_releases_held_tail():
+    sb = _StopBuffer(["STOP"])
+    assert sb.push("tail ST") == "tail "
+    assert sb.flush() == "ST"  # stream ended: the held prefix was no stop
+
+
+# --------------------------------------------------------------- e2e serve
+
+
+@pytest.fixture(scope="module")
+def v1(request):
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    serve.run(
+        openai_app(FIXTURE, engine_kwargs={"max_batch_size": 4},
+                   deployment_name="OpenAICompletionsTest"),
+        name="llm", route_prefix="/v1",
+    )
+    host, port = serve.proxy_address().split(":")
+    yield host, int(port)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _request(v1, body, path="/v1/completions", method="POST"):
+    """One raw HTTP/1.1 request; returns (status, headers, raw_body)."""
+    host, port = v1
+    s = socket.create_connection((host, port), timeout=120)
+    payload = json.dumps(body).encode() if body is not None else b""
+    s.sendall(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        "Content-Type: application/json\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    buf = b""
+    while True:
+        d = s.recv(65536)
+        if not d:
+            break
+        buf += d
+        head, sep, body_part = buf.partition(b"\r\n\r\n")
+        if not sep:
+            continue
+        hl = head.decode("latin1").split("\r\n")
+        hdrs = {}
+        for ln in hl[1:]:
+            k, _, v = ln.partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        if "content-length" in hdrs:
+            if len(body_part) >= int(hdrs["content-length"]):
+                break
+        elif b"0\r\n\r\n" in body_part:
+            break
+    s.close()
+    head, _, body_part = buf.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    hl = head.decode("latin1").split("\r\n")
+    hdrs = {}
+    for ln in hl[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    return status, hdrs, body_part
+
+
+def _dechunk(raw: bytes) -> bytes:
+    out, rest = b"", raw
+    while rest:
+        ln, _, rest = rest.partition(b"\r\n")
+        try:
+            n = int(ln, 16)
+        except ValueError:
+            break
+        if n == 0:
+            break
+        out += rest[:n]
+        rest = rest[n + 2:]
+    return out
+
+
+def _sse_frames(raw: bytes):
+    text = _dechunk(raw).decode("utf-8")
+    frames = text.split("\n\n")
+    assert frames[-1] == "", "stream must end with a frame separator"
+    return frames[:-1]
+
+
+def test_models_endpoint(v1):
+    status, hdrs, body = _request(v1, None, "/v1/models", "GET")
+    assert status == 200 and "application/json" in hdrs["content-type"]
+    obj = json.loads(body)
+    assert obj["object"] == "list"
+    assert obj["data"][0]["id"] == "hub_gpt2_tiny"
+    assert obj["data"][0]["object"] == "model"
+
+
+def test_completion_nonstream_openai_shape(v1):
+    """The standard client request shape (model/temperature included)
+    gets the standard response shape with real usage accounting."""
+    status, hdrs, body = _request(v1, {
+        "model": "hub_gpt2_tiny",
+        "prompt": "The quick brown fox",
+        "max_tokens": 8,
+        "temperature": 1.0,  # accepted and ignored: greedy engine
+    })
+    assert status == 200
+    obj = json.loads(body)
+    assert obj["object"] == "text_completion"
+    assert obj["id"].startswith("cmpl-")
+    assert obj["model"] == "hub_gpt2_tiny"
+    (choice,) = obj["choices"]
+    assert choice["index"] == 0 and choice["logprobs"] is None
+    assert choice["finish_reason"] in ("stop", "length")
+    assert isinstance(choice["text"], str) and choice["text"]
+    u = obj["usage"]
+    assert u["prompt_tokens"] > 0
+    assert u["completion_tokens"] <= 8
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+
+def test_completion_stream_sse_wire_format(v1):
+    """THE SSE satellite: data: <json>\\n\\n framing on the wire, the
+    [DONE] sentinel, text/event-stream content type, and streamed text
+    equal to the non-streamed greedy completion."""
+    ref_status, _, ref_body = _request(v1, {
+        "prompt": "In the morning", "max_tokens": 8,
+    })
+    ref_text = json.loads(ref_body)["choices"][0]["text"]
+
+    status, hdrs, body = _request(v1, {
+        "prompt": "In the morning", "max_tokens": 8, "stream": True,
+    })
+    assert status == 200
+    assert hdrs["content-type"] == "text/event-stream"
+    assert hdrs.get("transfer-encoding") == "chunked"
+    frames = _sse_frames(body)
+    assert frames[-1] == "data: [DONE]", frames[-1]
+    texts, finishes = [], []
+    for f in frames[:-1]:
+        assert f.startswith("data: "), f
+        chunk = json.loads(f[len("data: "):])  # every frame is valid JSON
+        assert chunk["object"] == "text_completion"
+        (c,) = chunk["choices"]
+        texts.append(c["text"])
+        finishes.append(c["finish_reason"])
+    assert "".join(texts) == ref_text
+    # exactly one terminal finish_reason, on the final data frame
+    assert finishes[-1] in ("stop", "length")
+    assert all(f is None for f in finishes[:-1])
+
+
+def test_stop_sequence_cuts_stream_and_nonstream(v1):
+    """Pick a stop string from the model's own output, then assert both
+    paths cut BEFORE it with finish_reason stop — and the streaming path
+    never leaked text past it."""
+    _, _, body = _request(v1, {"prompt": "The quick brown fox",
+                               "max_tokens": 12})
+    full = json.loads(body)["choices"][0]["text"]
+    assert len(full) > 4, full
+    stop = full[2:5]  # mid-generation substring, guaranteed to occur
+
+    _, _, body = _request(v1, {"prompt": "The quick brown fox",
+                               "max_tokens": 12, "stop": stop})
+    obj = json.loads(body)["choices"][0]
+    assert obj["finish_reason"] == "stop"
+    assert obj["text"] == full[:full.find(stop)]
+    assert stop not in obj["text"]
+
+    _, _, raw = _request(v1, {"prompt": "The quick brown fox",
+                              "max_tokens": 12, "stop": [stop],
+                              "stream": True})
+    frames = _sse_frames(raw)
+    streamed = "".join(
+        json.loads(f[6:])["choices"][0]["text"] for f in frames[:-1]
+    )
+    assert streamed == full[:full.find(stop)]
+    assert json.loads(frames[-2][6:])["choices"][0]["finish_reason"] == "stop"
+
+
+def test_echo_prepends_prompt(v1):
+    for stream in (False, True):
+        _, _, raw = _request(v1, {"prompt": "The quick", "max_tokens": 4,
+                                  "echo": True, "stream": stream})
+        if stream:
+            text = "".join(
+                json.loads(f[6:])["choices"][0]["text"]
+                for f in _sse_frames(raw)[:-1]
+            )
+        else:
+            text = json.loads(raw)["choices"][0]["text"]
+        assert text.startswith("The quick"), text
+
+
+def test_multi_prompt_batch(v1):
+    status, _, body = _request(v1, {
+        "prompt": ["The quick", "In the", "counting house"],
+        "max_tokens": 3,
+    })
+    assert status == 200
+    obj = json.loads(body)
+    assert [c["index"] for c in obj["choices"]] == [0, 1, 2]
+    assert obj["usage"]["completion_tokens"] <= 9
+
+
+def test_token_id_prompt(v1):
+    """OpenAI accepts pre-tokenized prompts (list of ids)."""
+    from ray_tpu.models.hub import ByteBPETokenizer
+
+    tok = ByteBPETokenizer.from_dir(FIXTURE)
+    ids = tok.encode("The quick brown fox")
+    s_text, _, b_text = _request(v1, {"prompt": "The quick brown fox",
+                                      "max_tokens": 5})
+    s_ids, _, b_ids = _request(v1, {"prompt": ids, "max_tokens": 5})
+    assert s_text == s_ids == 200
+    assert (json.loads(b_text)["choices"][0]["text"]
+            == json.loads(b_ids)["choices"][0]["text"])
+
+
+def test_openai_shaped_errors(v1):
+    cases = [
+        ({"max_tokens": 4}, "prompt"),               # missing prompt
+        ({"prompt": "x", "n": 2}, "n > 1"),
+        ({"prompt": "x", "max_tokens": 0}, "max_tokens"),
+        ({"prompt": "x", "best_of": 3}, "best_of"),
+        ({"prompt": "", "max_tokens": 4}, "prompt"),
+        ({"prompt": "x", "stop": ["a", "b", "c", "d", "e"]}, "stop"),
+        ({"prompt": [1, 10**9], "max_tokens": 4}, "vocab"),
+        # JSON booleans are int subclasses in python — not token ids
+        ({"prompt": [True, False], "max_tokens": 4}, "prompt"),
+    ]
+    for body, needle in cases:
+        status, _, raw = _request(v1, body)
+        assert status == 400, (body, status)
+        err = json.loads(raw)["error"]
+        assert err["type"] == "invalid_request_error", err
+        assert needle in err["message"], (needle, err)
+    # oversized prompt -> context_length_exceeded
+    status, _, raw = _request(v1, {"prompt": "word " * 400,
+                                   "max_tokens": 4})
+    assert status == 400
+    assert json.loads(raw)["error"]["type"] == "context_length_exceeded"
+
+
+def test_stream_frames_are_utf8_complete(v1):
+    """Every SSE frame must be independently valid UTF-8 JSON even though
+    the model's byte-level tokens can split characters — the incremental
+    detokenizer holds partial sequences back (_dechunk decodes utf-8
+    strictly; a split char inside any frame would raise)."""
+    _, hdrs, raw = _request(v1, {"prompt": "café 日本", "max_tokens": 6,
+                                 "stream": True})
+    frames = _sse_frames(raw)
+    assert frames[-1] == "data: [DONE]"
+    for f in frames[:-1]:
+        json.loads(f[len("data: "):])
+
+
+def test_replica_stats_carry_model_identity(v1):
+    """Bench/observability contract: the deployment's stats name the
+    model id and the params source (real weights, not synthetic)."""
+    h = serve.DeploymentHandle("OpenAICompletionsTest")
+    stats = h.stats.remote().result(timeout_s=30)
+    assert stats["model_id"] == "hub_gpt2_tiny"
+    assert stats["params_source"].endswith("model.safetensors")
+
+
+def test_openai_app_mints_unique_deployment_names():
+    """Two models deployed side by side must not silently redeploy each
+    other: every openai_app() bind gets its own deployment name unless
+    the caller pins one."""
+    a = openai_app(FIXTURE)
+    b = openai_app(FIXTURE)
+    assert a.deployment.name != b.deployment.name
+    assert a.deployment.name.startswith("OpenAICompletions_")
+    pinned = openai_app(FIXTURE, deployment_name="Pinned")
+    assert pinned.deployment.name == "Pinned"
+
+
+def test_pool_overflow_rejected_as_400():
+    """A request whose worst-case KV span exceeds the WHOLE pool must be
+    an OpenAI-shaped 400 at submit time — not a ValueError surfacing
+    mid-stream as a 500 (submit only enqueues; engine.admit runs later
+    on the batcher loop thread). Direct construction with a starved
+    pool; no cluster needed."""
+    from ray_tpu.serve.http_proxy import Request
+    from ray_tpu.serve.openai_api import OpenAICompletions
+
+    svc = OpenAICompletions(FIXTURE, engine_kwargs={
+        "max_batch_size": 1, "block_tokens": 8, "num_blocks": 3,
+    })
+    try:
+        for stream in (False, True):
+            resp = svc(Request(
+                method="POST", path="/v1/completions", route="/v1",
+                subpath="completions", query={}, headers={},
+                body={"prompt": "The quick brown fox", "max_tokens": 100,
+                      "stream": stream},
+            ))
+            assert resp.status == 400, (stream, resp)
+            assert "KV blocks" in resp.body["error"]["message"], resp.body
+        # a request that FITS the tiny pool still works end to end
+        ok = svc(Request(
+            method="POST", path="/v1/completions", route="/v1",
+            subpath="completions", query={}, headers={},
+            body={"prompt": "The", "max_tokens": 4},
+        ))
+        assert ok.status == 200 and ok.body["choices"][0]["text"]
+    finally:
+        svc.batcher.close()
