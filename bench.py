@@ -352,6 +352,14 @@ def main_decode():
                 # ("gather"+"fp" rows are the pre-fused lineage)
                 "attention_variant": estats["attention_impl"],
                 "kv_dtype": estats["kv_cache_dtype"],
+                # ISSUE 13: the attention the VERIFY step ran (one fused
+                # multi-query impl serves decode/verify/prefill, so it
+                # equals attention_variant — recorded separately so TPU
+                # certification rounds can name the fused-verify config
+                # even if the impls ever diverge again) + the chunked-
+                # prefill granularity (0 = whole-prompt admission)
+                "verify_attention_variant": estats["attention_impl"],
+                "prefill_chunk_tokens": estats["prefill_chunk_tokens"],
                 # paged-KV observability: live fraction of the block pool
                 # at the end of the timed run + preemptions (nonzero means
                 # the pool was undersized for this batch/length mix)

@@ -383,12 +383,243 @@ def bench_decode_speculative(new_tokens: int = 96, k: int = 4) -> dict:
         best_off = max(best_off, timed(base))
         best_on = max(best_on, timed(spec))
     speedup = best_on / best_off if identical else 0.0
-    return {
+    out = {
         "spec_off_tokens_per_s": round(best_off, 1),
         "spec_on_tokens_per_s": round(best_on, 1),
         "spec_decode_speedup_x": round(speedup, 2),
         "spec_accept_rate": spec.stats()["spec_accept_rate"],
         "spec_greedy_identical": int(identical),
+    }
+    out.update(_spec_verify_longctx())
+    return out
+
+
+def _spec_verify_longctx(
+    prefix_tokens: int = 0, batch: int = 2, new_tokens: int = 24, k: int = 4,
+) -> dict:
+    """Long-context half of the speculative row (ISSUE 13), gated: the
+    fused multi-query verify step (q = k+1 through the block-in-place
+    walk + in-flight log-sum-exp merge) must at least MATCH the
+    gather-window verify at long context — before the multi-query
+    kernel, speculation re-paid the gather cost the fused decode path
+    had eliminated, so long-context streams LOST part of the fused win
+    the moment they drafted. Perfect-draft replay (mechanics, not
+    drafter quality), in-row greedy-identity assertion zeroes the
+    speedup on divergence, warm-then-interleaved best-of-repeats."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import CONFIGS, init_params
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.models.speculative import ReplayDrafter
+
+    prefix_tokens = prefix_tokens or int(
+        os.environ.get("RAY_TPU_MICROBENCH_LONGCTX_TOKENS", "4096")
+    )
+    chunk = min(1024, prefix_tokens)
+    bt = 64
+    cfg = dataclasses.replace(
+        CONFIGS["tiny"], dtype=jnp.float32, max_seq_len=prefix_tokens + 2 * bt
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch, prefix_tokens)
+    )
+    slots = list(range(batch))
+
+    def admit_chunked(eng):
+        # the long prefix admits in chunks through the prefix cache (setup
+        # stays ~linear); re-admission after release hits the cache whole.
+        # Returns each slot's FIRST sampled token (from the full-prompt
+        # admission) — it is part of the slot's history, so the replay
+        # drafter's recorded sequences must include it or they never
+        # prefix-match and speculation silently never runs
+        first = {}
+        for s in slots:
+            for end in range(chunk, prefix_tokens + 1, chunk):
+                t, _ = eng.admit(s, {"tokens": prompts[s][:end],
+                                     "max_new_tokens": 10**9})
+                if end < prefix_tokens:
+                    eng.release(s)
+            first[s] = int(t)
+        return first
+
+    plain = PagedDecodeEngine(
+        cfg, params, max_batch_size=batch, block_tokens=bt, seed=0,
+        prefill_buckets=(chunk,),
+    )
+    refs = {s: [t] for s, t in admit_chunked(plain).items()}
+    for _ in range(new_tokens - 1):
+        r = plain.step(slots)
+        for s in slots:
+            refs[s].append(r[s][0])
+
+    def build(impl):
+        eng = PagedDecodeEngine(
+            cfg, params, max_batch_size=batch, block_tokens=bt, seed=0,
+            prefill_buckets=(chunk,), attention_impl=impl, speculative_k=k,
+            drafter=ReplayDrafter(
+                [list(prompts[s]) + refs[s] for s in slots]
+            ),
+        )
+        return eng, admit_chunked(eng)
+
+    def run(eng, first):
+        outs = {s: [first[s]] for s in slots}
+        while min(len(o) for o in outs.values()) < new_tokens:
+            for s, (toks, _) in eng.step(slots).items():
+                outs[s].extend(
+                    toks if isinstance(toks, (list, tuple)) else [toks]
+                )
+        return outs
+
+    engines = {"gather": build("gather"), "fused": build("fused:xla")}
+    identical = True
+    for eng, first in engines.values():  # warm + identity
+        o = run(eng, first)
+        identical = identical and all(
+            o[s][:new_tokens] == refs[s] for s in slots
+        )
+    # the gate certifies the VERIFY path: if the drafter never engaged
+    # (spec_steps == 0) the timed loop would measure plain decode and the
+    # comparison would be vacuous — zero the metric so the gate fails loud
+    engaged = all(e.spec_steps > 0 for e, _ in engines.values())
+
+    def timed(eng):
+        for s in slots:
+            eng.release(s)
+            # re-admit whole: the prefix cache serves every full block, so
+            # only the tail re-prefills — setup off the timed path
+            eng.admit(s, {"tokens": prompts[s], "max_new_tokens": 10**9})
+        n = 0
+        t0 = time.perf_counter()
+        while n < batch * new_tokens:
+            for toks, _ in eng.step(slots).values():
+                n += len(toks) if isinstance(toks, (list, tuple)) else 1
+        return n / (time.perf_counter() - t0)
+
+    best = {name: 0.0 for name in engines}
+    for _ in range(3):
+        for name, (eng, _) in engines.items():
+            best[name] = max(best[name], timed(eng))
+    ok = identical and engaged
+    speedup = best["fused"] / best["gather"] if ok else 0.0
+    return {
+        "spec_verify_ctx_tokens": prefix_tokens,
+        "spec_verify_engaged": int(engaged),
+        "spec_verify_gather_tokens_per_s": round(best["gather"], 1),
+        "spec_verify_fused_tokens_per_s": round(best["fused"], 1),
+        "spec_verify_fused_speedup_x": round(speedup, 2),
+    }
+
+
+def bench_decode_mixed_traffic(
+    prefix_tokens: int = 0, chunk: int = 256, decode_slots: int = 2,
+    base_steps: int = 32,
+) -> dict:
+    """Mixed-traffic tail latency (ISSUE 13's scheduling gate): decode
+    p99 inter-token latency measured WHILE a long prompt streams into the
+    same running batch as prefill chunks (`prefill_chunk_tokens`), gated
+    two ways against the decode-only baseline on the same engine:
+
+      decode_mixed_p99_ratio_x <= bound   chunk steps interleave with
+        decode steps, so the worst inter-token gap a decode stream sees
+        is ~one chunk's compute — BOUNDED, load-independent of prompt
+        length. A scheduler regression (multiple chunks coalescing into
+        one step, or a silent whole-prefill fallback) blows this by an
+        order of magnitude.
+      decode_chunk_stall_reduction_x >= bound   the same prompt admitted
+        WHOLE stalls every decode stream for its entire prefill; chunked
+        admission must cut that head-of-line spike by >= 4x (measured
+        ~12-20x: the ratio grows with prompt length — that is the point).
+
+    The engine runs the production shape: fused attention, chunked
+    prefill ON, prefix cache OFF (a cache hit would skip the very
+    prefill being measured). All chunk-prefill compile keys are warmed by
+    a full throwaway admission first."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import CONFIGS, init_params
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+
+    prefix_tokens = prefix_tokens or int(
+        os.environ.get("RAY_TPU_MICROBENCH_LONGCTX_TOKENS", "4096")
+    )
+    bt = 64
+    cfg = dataclasses.replace(
+        CONFIGS["tiny"], dtype=jnp.float32, max_seq_len=prefix_tokens + 4 * bt
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    dec_prompts = rng.integers(0, cfg.vocab_size, size=(decode_slots, 128))
+    long_warm = rng.integers(0, cfg.vocab_size, size=prefix_tokens)
+    long_timed = rng.integers(0, cfg.vocab_size, size=prefix_tokens)
+    B = decode_slots + 1
+    dslots = list(range(decode_slots))
+    lslot = decode_slots
+
+    def build(chunk_tokens, buckets):
+        eng = PagedDecodeEngine(
+            cfg, params, max_batch_size=B, block_tokens=bt,
+            attention_impl="fused", prefill_chunk_tokens=chunk_tokens,
+            prefix_cache=False, seed=0, prefill_buckets=buckets,
+        )
+        for s in dslots:
+            eng.admit(s, {"tokens": dec_prompts[s],
+                          "max_new_tokens": 10**9})
+        eng.step(dslots)  # decode compile + warm
+        return eng
+
+    eng = build(chunk, (128, chunk))
+    # warm EVERY chunk-prefill compile key (ctx buckets double up the
+    # prompt, so a 4k prompt walks ~log2 distinct (ctx, chunk) shapes)
+    eng.admit(lslot, {"tokens": long_warm, "max_new_tokens": 1})
+    while eng.stats()["prefilling"]:
+        eng.step(dslots + [lslot])
+    eng.release(lslot)
+
+    base = []
+    for _ in range(base_steps):
+        t0 = time.perf_counter()
+        eng.step(dslots)
+        base.append(time.perf_counter() - t0)
+
+    eng.admit(lslot, {"tokens": long_timed, "max_new_tokens": 1})
+    mixed = []
+    while eng.stats()["prefilling"]:
+        t0 = time.perf_counter()
+        eng.step(dslots + [lslot])
+        mixed.append(time.perf_counter() - t0)
+    eng.release(lslot)
+
+    # the head-of-line spike chunking removes: the same prompt admitted
+    # whole (chunking OFF) blocks the loop for its entire prefill
+    whole = build(0, (128, prefix_tokens))
+    whole.admit(lslot, {"tokens": long_warm, "max_new_tokens": 1})
+    whole.release(lslot)  # prefill compile
+    t0 = time.perf_counter()
+    whole.admit(lslot, {"tokens": long_timed, "max_new_tokens": 1})
+    stall = time.perf_counter() - t0
+
+    p99_base = float(np.percentile(base, 99))
+    p99_mixed = float(np.percentile(mixed, 99))
+    return {
+        "mixed_traffic_prompt_tokens": prefix_tokens,
+        "mixed_traffic_chunk_tokens": chunk,
+        "decode_only_p99_ms": round(p99_base * 1000, 2),
+        "decode_mixed_p99_ms": round(p99_mixed * 1000, 2),
+        "decode_mixed_p99_ratio_x": round(p99_mixed / p99_base, 2),
+        "whole_prompt_stall_ms": round(stall * 1000, 1),
+        "decode_chunk_stall_reduction_x": round(stall / p99_mixed, 2),
     }
 
 
@@ -575,6 +806,55 @@ def bench_head_stress(n_tasks: int = 0, n_actors: int = 0) -> dict:
         ray_tpu.shutdown()
 
 
+# every gate in one table: metric -> (op, target). Targets may be
+# callables of the results dict (floor-relative: put/cross-node derive
+# from the host's measured memcpy floor). Both the full supervisor and
+# the --only selector judge from HERE, so a bound cannot drift between
+# the sweep and the targeted CI step.
+GATES = {
+    "task_submit_per_s": (">=", 5000.0),
+    "actor_calls_sync_per_s": (">=", 2500.0),
+    # put pays exactly one copy: on hosts whose single-core memcpy floor
+    # is below 12.5 GB/s the absolute 10 GB/s is unreachable by
+    # construction — the honest target is ~75% of the floor, capped
+    "put_100mb_gbps": (">=", lambda r: min(10.0, 0.75 * r["host_memcpy_gbps"])),
+    # cross-node bulk transfer is ~20x below the memcpy floor today
+    # (VERDICT weak #3) — ANTI-REGRESSION, not aspiration: trips if the
+    # direct pull path gets slower still, leaves the 0.5x-of-floor
+    # target to the zero-copy work (ROADMAP item 3)
+    "cross_node_256mb_gbps": (">=", lambda r: min(0.15, 0.02 * r["host_memcpy_gbps"])),
+    # batched KV-cache decode must beat serial per-request decode: the
+    # continuous-batching serving fast path (both engines run PAGED)
+    "decode_batched_speedup_x": (">=", 2.0),
+    # a prefix-cache hit must beat the cold prefill of the same prompt
+    "prefix_hit_speedup_x": (">=", 2.0),
+    # block-in-place paged attention must beat the block-table gather at
+    # the same dtype in the long-context (bandwidth-bound) decode regime
+    "decode_long_context_fused_speedup_x": (">=", 1.1),
+    # int8 KV blocks must ~double pool capacity per byte
+    "kv_int8_blocks_ratio": (">=", 1.8),
+    # one k+1-token speculative verify step must beat the k+1
+    # single-token steps it replaces at low batch (perfect-draft harness)
+    "spec_decode_speedup_x": (">=", 1.5),
+    # the multi-query fused verify must AT LEAST match the gather-window
+    # verify at long context (measured ~1.9x on CPU at 4k ctx) — before
+    # ISSUE 13, speculation re-paid the gather cost fused decode saved
+    "spec_verify_fused_speedup_x": (">=", 1.0),
+    # chunked prefill: decode p99 inter-token latency while a 4k prompt
+    # streams in chunks stays BOUNDED vs the decode-only baseline (one
+    # chunk's compute, ~25x a tiny-batch CPU decode step; a scheduler
+    # regression — chunks coalescing, whole-prefill fallback — is 10x+)
+    "decode_mixed_p99_ratio_x": ("<=", 50.0),
+    # ... and must cut the whole-prompt head-of-line spike by >= 4x
+    "decode_chunk_stall_reduction_x": (">=", 4.0),
+}
+
+
+def _gate_ok(metric: str, value: float, target: float) -> bool:
+    op = GATES[metric][0]
+    return value <= target if op == "<=" else value >= target
+
+
 def _run_trial() -> dict:
     """One fresh-process trial of the GATED metrics + this trial's own
     environment noise floor (memcpy) — so every rate ships with the host
@@ -587,6 +867,7 @@ def _run_trial() -> dict:
     out.update(bench_decode_speedup())
     out.update(bench_decode_long_context())
     out.update(bench_decode_speculative())
+    out.update(bench_decode_mixed_traffic())
     out.update(bench_decode_spec_realtext())
     out.update(bench_prefix_hit())
     ray_tpu.init()
@@ -609,10 +890,11 @@ def main():
     import subprocess
 
     n_trials = int(os.environ.get("RAY_TPU_MICROBENCH_TRIALS", "5"))
-    gated = ("task_submit_per_s", "actor_calls_sync_per_s", "put_100mb_gbps",
-             "decode_batched_speedup_x", "prefix_hit_speedup_x",
-             "decode_long_context_fused_speedup_x", "kv_int8_blocks_ratio",
-             "spec_decode_speedup_x")
+    # every GATES entry is trial-gated except cross-node, which needs its
+    # own 2-node cluster and is measured once in THIS process — derived,
+    # not hand-listed, so a new gate cannot be silently dropped from the
+    # sweep's judgment
+    gated = tuple(k for k in GATES if k != "cross_node_256mb_gbps")
     expected = set(gated) | {"host_memcpy_gbps"}
     trials = []
     # trial 0 is a WARMUP, discarded: it faults in the interpreter/page
@@ -633,7 +915,12 @@ def main():
         try:
             proc = subprocess.run(
                 [sys.executable, sys.argv[0]], env=env, capture_output=True,
-                text=True, timeout=600,
+                text=True,
+                # ISSUE 13 grew each trial by the verify-longctx + mixed-
+                # traffic phases (~2 min extra on a 1-core host)
+                timeout=int(os.environ.get(
+                    "RAY_TPU_MICROBENCH_TRIAL_TIMEOUT_S", "900"
+                )),
             )
         except subprocess.TimeoutExpired:
             # one hung (host-throttled) trial must not sink the artifact —
@@ -666,6 +953,13 @@ def main():
                       "decode_long_context_int8_speedup_x",
                       "spec_off_tokens_per_s", "spec_on_tokens_per_s",
                       "spec_accept_rate", "spec_greedy_identical",
+                      "spec_verify_ctx_tokens", "spec_verify_engaged",
+                      "spec_verify_gather_tokens_per_s",
+                      "spec_verify_fused_tokens_per_s",
+                      "mixed_traffic_prompt_tokens",
+                      "mixed_traffic_chunk_tokens",
+                      "decode_only_p99_ms", "decode_mixed_p99_ms",
+                      "whole_prompt_stall_ms",
                       "spec_realtext_available",
                       "spec_accept_rate_realtext",
                       "spec_tokens_per_step_realtext"):
@@ -688,55 +982,135 @@ def main():
     results["cross_node_256mb_gbps"] = round(bench_cross_node_gbps(), 2)
     results.update(bench_head_stress())
 
-    # put pays exactly one copy: on hosts whose single-core memcpy floor is
-    # below 12.5 GB/s the absolute 10 GB/s is unreachable by construction —
-    # the honest target is ~75% of the MEDIAN floor, capped at the absolute
-    # target (floor and put now come from the same trials, so no
-    # minutes-apart drift; medians already absorb per-trial noise)
-    put_target = min(10.0, 0.75 * results["host_memcpy_gbps"])
-    results["put_target_gbps"] = round(put_target, 2)
-    # cross-node bulk transfer is ~20x below the memcpy floor today
-    # (VERDICT weak #3: 0.31 vs 6.54 GB/s in MICROBENCH_r05) — this gate is
-    # ANTI-REGRESSION, not aspiration: it trips if the direct pull path
-    # gets slower still (e.g. an extra copy/pickle sneaks in), while
-    # leaving the 0.5x-of-floor target to the zero-copy work (VERDICT next
-    # #4). Floor-relative with an absolute cap so slow hosts stay honest.
-    cross_target = min(0.15, 0.02 * results["host_memcpy_gbps"])
-    results["cross_node_target_gbps"] = round(cross_target, 3)
+    # targets resolve from the shared GATES table (floor-relative ones —
+    # put, cross-node — derive from the MEDIAN memcpy floor: floor and
+    # rate come from the same trials, so no minutes-apart drift; the gate
+    # rationale lives next to each entry in GATES)
     targets = {
-        "task_submit_per_s": 5000.0,
-        "actor_calls_sync_per_s": 2500.0,
-        "put_100mb_gbps": put_target,
-        "cross_node_256mb_gbps": cross_target,
-        # batched KV-cache decode must beat serial per-request decode: the
-        # continuous-batching serving fast path, gated anti-regression
-        # (both engines run PAGED, so this also gates "paging on" decode)
-        "decode_batched_speedup_x": 2.0,
-        # a prefix-cache hit must beat the cold prefill of the same prompt:
-        # the paged-KV prefix-reuse win (shared-span prefill is skipped)
-        "prefix_hit_speedup_x": 2.0,
-        # block-in-place paged attention must beat the block-table gather
-        # at the same dtype in the long-context (bandwidth-bound) decode
-        # regime — measured ~1.3x on CPU (the XLA online-softmax walk),
-        # larger on TPU where the Pallas kernel skips the gather entirely
-        "decode_long_context_fused_speedup_x": 1.1,
-        # int8 KV blocks must ~double pool capacity per byte (the
-        # concurrent-sequences win admission and autoscaling see)
-        "kv_int8_blocks_ratio": 1.8,
-        # one k+1-token speculative verify step must beat the k+1
-        # single-token steps it replaces at low batch (perfect-draft
-        # harness; in-row identity assertion zeroes the metric on any
-        # greedy divergence) — the single-stream serving latency lever
-        "spec_decode_speedup_x": 1.5,
+        k: (v(results) if callable(v) else v)
+        for k, (_, v) in GATES.items()
+        if k in gated or k == "cross_node_256mb_gbps"
     }
+    results["put_target_gbps"] = round(targets["put_100mb_gbps"], 2)
+    results["cross_node_target_gbps"] = round(
+        targets["cross_node_256mb_gbps"], 3
+    )
     results["targets"] = {k: round(v, 2) for k, v in targets.items()}
-    results["targets_met"] = all(results[k] >= v for k, v in targets.items())
+    results["targets_met"] = all(
+        _gate_ok(k, results[k], v) for k, v in targets.items()
+    )
     print(json.dumps(results))
     return results
+
+
+# --------------------------------------------------------------------------
+# --only: a named row as a targeted CI step
+# --------------------------------------------------------------------------
+
+# row name -> (metrics fn, needs a ray cluster, GATES entries the row's
+# metrics are judged by). Derived targets pull the memcpy floor in
+# automatically. One in-process pass — the fresh-process median-of-N
+# discipline belongs to the full supervisor; a targeted CI step wants one
+# honest measurement and a hard exit code.
+ROWS = {
+    "decode_speedup": (bench_decode_speedup, False,
+                       ("decode_batched_speedup_x",)),
+    "decode_long_context": (bench_decode_long_context, False,
+                            ("decode_long_context_fused_speedup_x",
+                             "kv_int8_blocks_ratio")),
+    "decode_speculative": (bench_decode_speculative, False,
+                           ("spec_decode_speedup_x",
+                            "spec_verify_fused_speedup_x")),
+    "decode_mixed_traffic": (bench_decode_mixed_traffic, False,
+                             ("decode_mixed_p99_ratio_x",
+                              "decode_chunk_stall_reduction_x")),
+    "decode_spec_realtext": (bench_decode_spec_realtext, False, ()),
+    "prefix_hit": (bench_prefix_hit, False, ("prefix_hit_speedup_x",)),
+    "task_submit": (lambda: {"task_submit_per_s": round(bench_task_submit(), 1)},
+                    True, ("task_submit_per_s",)),
+    "actor_sync": (lambda: {"actor_calls_sync_per_s": round(bench_actor_sync(), 1)},
+                   True, ("actor_calls_sync_per_s",)),
+    "put": (lambda: {"put_100mb_gbps": round(bench_put_gbps(), 2)},
+            True, ("put_100mb_gbps",)),
+    # needs_ray=None: the row manages its OWN ray lifecycle (head_stress
+    # calls init with a custom system config; cross_node builds a
+    # Cluster) — run_only must release any shared cluster first, or the
+    # row's init raises "called twice"
+    "cross_node": (lambda: {"cross_node_256mb_gbps": round(bench_cross_node_gbps(), 2)},
+                   None, ("cross_node_256mb_gbps",)),
+    "head_stress": (bench_head_stress, None, ()),
+}
+
+
+def run_only(names) -> bool:
+    """Run the named row(s) in THIS process, judge exactly their gates,
+    print one JSON object, return pass/fail (the exit code)."""
+    unknown = [n for n in names if n not in ROWS]
+    if unknown:
+        print(f"[microbench] unknown row(s) {unknown}; "
+              f"available: {sorted(ROWS)}", file=sys.stderr)
+        return False
+    results = {"host_cpus": os.cpu_count(), "rows": list(names)}
+    needs_floor = any(
+        callable(GATES[g][1])
+        for n in names for g in ROWS[n][2]
+    )
+    if needs_floor:
+        results["host_memcpy_gbps"] = round(host_memcpy_gbps(), 2)
+    inited = False
+    import ray_tpu
+
+    try:
+        for n in names:
+            fn, needs_ray, _ = ROWS[n]
+            if needs_ray and not inited:
+                ray_tpu.init()
+                inited = True
+            elif needs_ray is None and inited:
+                # row manages its own cluster: hand the runtime back
+                ray_tpu.shutdown()
+                inited = False
+            results.update(fn())
+    finally:
+        if inited:
+            ray_tpu.shutdown()
+    checked, ok = {}, True
+    for n in names:
+        for g in ROWS[n][2]:
+            if g not in results:
+                # a row that stopped emitting its gated metric must FAIL
+                # the targeted step, not silently pass with no judgment
+                checked[g] = {"missing": True, "passed": False}
+                ok = False
+                continue
+            op, tgt = GATES[g]
+            tgt = tgt(results) if callable(tgt) else tgt
+            passed = _gate_ok(g, results[g], tgt)
+            checked[g] = {"value": results[g], "op": op,
+                          "target": round(tgt, 3), "passed": passed}
+            ok = ok and passed
+    results["gates"] = checked
+    results["targets_met"] = ok
+    print(json.dumps(results))
+    return ok
 
 
 if __name__ == "__main__":
     if os.environ.get("RAY_TPU_MICROBENCH_CHILD") == "trial":
         _run_trial()
         sys.exit(0)
+    if "--only" in sys.argv:
+        # targeted CI step: `microbench.py --only decode_mixed_traffic`
+        # (comma-separate for several rows) runs just those rows, judges
+        # just their gates, and exits nonzero on any failure. Defaults to
+        # CPU like the trial children (set before any row imports jax);
+        # an explicit JAX_PLATFORMS export wins.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        idx = sys.argv.index("--only")
+        if idx + 1 >= len(sys.argv):
+            print(f"usage: {sys.argv[0]} --only <row>[,<row>...]; "
+                  f"rows: {sorted(ROWS)}", file=sys.stderr)
+            sys.exit(2)
+        names = [n for n in sys.argv[idx + 1].split(",") if n]
+        sys.exit(0 if run_only(names) else 1)
     sys.exit(0 if main()["targets_met"] else 1)

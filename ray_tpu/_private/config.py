@@ -238,6 +238,14 @@ D("serve_kv_pool_mb", int, 0,
   "num_blocks = budget // block_bytes, so int8 pools hold ~2x the blocks "
   "of bf16 for the same bytes; 0 = use serve_kv_cache_blocks / the "
   "dense-equivalent default (explicit constructor args win over both)")
+D("serve_prefill_chunk_tokens", int, 0,
+  "chunked prefill: admit long prompts into the RUNNING batch in chunks "
+  "of this many tokens — each engine step advances one chunk while every "
+  "other slot decodes, so a 4k-token prompt never stalls in-flight "
+  "streams for its whole prefill (the head-of-line tail-latency fix for "
+  "mixed traffic). 0 = whole-prompt prefill at admission (the "
+  "lowest-latency path for a lone request); prompts at or under the "
+  "chunk size admit whole either way")
 D("serve_speculative_k", int, 0,
   "speculative decoding on the paged engine: a drafter proposes up to k "
   "tokens per slot per step and the target model verifies all k+1 "
@@ -263,6 +271,12 @@ D("serve_kv_prefix_cache", bool, True,
   "prompt prefixes (system prompts, few-shot headers) share physical "
   "blocks and skip prefill for the shared span; cache-held blocks are "
   "evicted LRU under pool pressure")
+D("train_dist_heartbeat_timeout_s", int, 30,
+  "upper bound on detecting a dead jax.distributed gang peer: the "
+  "coordination-service heartbeat interval/missing-count are derived "
+  "from this, so a hard-killed rank parks the surviving ranks' shutdown "
+  "barrier ~this long instead of jax's ~100s default — the gang-restart "
+  "latency floor (train/trainer.py). 0 = keep jax's defaults")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

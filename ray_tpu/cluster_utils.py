@@ -134,9 +134,19 @@ class Cluster:
         import ray_tpu
 
         ray_tpu.shutdown()
+        # one SIGTERM pass over every agent group FIRST: agents exit on it
+        # immediately (default handler), where the old per-proc wait(5)
+        # expired serially and SIGKILLed the group anyway — a flat
+        # multi-second tax on every cluster-using test's teardown
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    proc.terminate()
         for node_id, proc in list(self._procs.items()):
             try:
-                proc.wait(timeout=5)
+                proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
                 try:
                     os.killpg(os.getpgid(proc.pid), signal.SIGKILL)

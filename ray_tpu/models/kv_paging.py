@@ -272,6 +272,7 @@ class PagedDecodeEngine:
         chunk_blocks: Optional[int] = None,
         speculative_k: Optional[int] = None,
         drafter=None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -331,6 +332,17 @@ class PagedDecodeEngine:
                 f"chunk_blocks must be positive, got {chunk_blocks}"
             )
         self.chunk_blocks = chunk_blocks
+
+        prefill_chunk_tokens = int(
+            gcfg.serve_prefill_chunk_tokens if prefill_chunk_tokens is None
+            else prefill_chunk_tokens
+        )
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0 (0 = whole-prompt "
+                f"prefill), got {prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = prefill_chunk_tokens
 
         speculative_k = int(
             gcfg.serve_speculative_k if speculative_k is None
@@ -470,6 +482,10 @@ class PagedDecodeEngine:
         self._new_counts = np.zeros(B, np.int64)
         self._max_new = np.full(B, self.default_max_new_tokens, np.int64)
         self._history: List[Optional[List[int]]] = [None] * B
+        # chunked prefill: the slot's FULL prompt while its prefill is
+        # still streaming in chunks (committed span = _positions[slot]);
+        # None once the slot is generating
+        self._chunk_state: List[Optional[np.ndarray]] = [None] * B
         self._admit_seq = np.zeros(B, np.int64)
         self._seq = 0
         self._preempted: List[Tuple[int, Dict[str, Any]]] = []
@@ -478,6 +494,8 @@ class PagedDecodeEngine:
         self.tokens_generated = 0
         self.prefills = 0
         self.prefill_tokens = 0
+        self.prefill_chunks = 0     # paged-prefill dispatches (>= prefills)
+        self.chunked_prefills = 0   # admissions that streamed in chunks
         self.decode_steps = 0
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
@@ -533,6 +551,7 @@ class PagedDecodeEngine:
         self._row_blocks[slot] = 0
         self._live[slot] = False
         self._history[slot] = None
+        self._chunk_state[slot] = None
 
     def _reclaim(self, need: int) -> None:
         """Evict cache-only blocks until `need` blocks are free (best
@@ -602,8 +621,18 @@ class PagedDecodeEngine:
         budget = self.allocator.num_free + max(0, evictable)
         return budget >= worst - reusable
 
-    def admit(self, slot: int, request: Dict[str, Any]) -> Tuple[int, bool]:
+    def admit(
+        self, slot: int, request: Dict[str, Any]
+    ) -> Tuple[Optional[int], bool]:
         """Prefill `request` into `slot`, reusing cached prefix blocks.
+
+        With `prefill_chunk_tokens > 0` a prompt longer than one chunk
+        admits CHUNKED: only the first chunk prefills here and the call
+        returns (None, False) — step() advances one chunk per engine step
+        (interleaved with other slots' decode) until the prompt is
+        consumed and the first token samples. Shorter prompts (and
+        chunking off) prefill whole and return (first_token, done) as
+        before.
 
         Raises InsufficientBlocksError (retryable: the batcher parks the
         request) when the pool cannot cover the prompt itself."""
@@ -680,38 +709,95 @@ class PagedDecodeEngine:
         self._row_blocks[slot] = len(row)
         self._live[slot] = True
 
-        suffix = prompt[p_hit:length]
-        bucket = self._bucket(len(suffix))
-        padded = np.zeros(bucket, np.int32)
-        padded[:len(suffix)] = suffix
-        ctx_blocks = self._ctx_bucket_blocks(p_hit)
-        self.prefill_shapes.add((ctx_blocks, -(-bucket // bt)))
-        next_tok, _, self.pool = self._prefill(
-            self.params, self.pool, self._tables[slot],
-            padded[None], np.int32(len(suffix)), np.int32(p_hit),
-            self._next_key(), ctx_blocks,
-        )
-        tok = int(next_tok[0])
-
-        self._positions[slot] = length
-        self._last_tokens[slot] = tok
-        self._new_counts[slot] = 1
+        self._positions[slot] = p_hit  # committed span so far
         self._max_new[slot] = mnt
-        self._history[slot] = list(int(t) for t in prompt[:length]) + [tok]
+        self._new_counts[slot] = 0
+        self._history[slot] = [int(t) for t in prompt[:length]]
+        self._chunk_state[slot] = np.ascontiguousarray(
+            prompt[:length], dtype=np.int32
+        )
         self._seq += 1
         self._admit_seq[slot] = self._seq
         self.prefills += 1
-        self.prefill_tokens += len(suffix)
-        self.tokens_generated += 1
         if hit_blocks:
             self.prefix_hits += 1
             self.prefix_tokens_reused += p_hit
+
+        chunk = self.prefill_chunk_tokens
+        if chunk and length - p_hit > chunk:
+            # chunked admission: run the FIRST chunk now; step() advances
+            # one chunk per engine step, interleaved with everyone else's
+            # decode, until the prompt is consumed and the first token
+            # samples — so a long prompt never stalls in-flight streams
+            # for its whole prefill (the head-of-line latency fix)
+            self.chunked_prefills += 1
+            tok = self._run_prefill_chunk(slot)
+        else:
+            tok = self._run_prefill_chunk(slot, whole=True)
+        if tok is None:
+            return None, False
+        return tok, self._done(slot, tok)
+
+    def _run_prefill_chunk(self, slot: int, whole: bool = False) -> Optional[int]:
+        """Consume the next prompt span of the slot's pending prefill
+        (one prefill_chunk_tokens chunk, or the whole remainder with
+        `whole=True`) through ONE paged-prefill dispatch. Returns the
+        first sampled token when this call consumed the prompt's tail,
+        else None (still prefilling; intermediate dispatches compute a
+        throwaway sample — the B=1 unembed is noise next to the layers).
+
+        The committed span is self._positions[slot]. Mid-prompt chunk
+        boundaries need NOT be block-aligned: the prefill window math
+        handles a chunk straddling a physical block (the straddled block
+        is slot-owned — prefix-hit sharing is whole-block — so the quant
+        path's requantize-owned rule keeps the CoW invariant; tests pin
+        the straddle edge)."""
+        import jax
+
+        bt = self.block_tokens
+        prompt = self._chunk_state[slot]
+        ctx = int(self._positions[slot])
+        length = int(prompt.size)
+        rem = length - ctx
+        take = rem if whole else min(self.prefill_chunk_tokens, rem)
+        last = take == rem
+        bucket = self._bucket(take)
+        padded = np.zeros(bucket, np.int32)
+        padded[:take] = prompt[ctx:ctx + take]
+        ctx_blocks = self._ctx_bucket_blocks(ctx)
+        self.prefill_shapes.add((ctx_blocks, -(-bucket // bt)))
+        # intermediate chunks sample a throwaway token — give them a FIXED
+        # key so only the completing dispatch consumes the engine's RNG
+        # stream: one key per admission regardless of chunking, which is
+        # what keeps temperature > 0 tokens invariant to the chunk config
+        # (greedy never reads the key at all)
+        key = self._next_key() if last else jax.random.PRNGKey(0)
+        next_tok, _, self.pool = self._prefill(
+            self.params, self.pool, self._tables[slot],
+            padded[None], np.int32(take), np.int32(ctx),
+            key, ctx_blocks,
+        )
+        self._positions[slot] = ctx + take
+        self.prefill_tokens += take
+        self.prefill_chunks += 1
+        if not last:
+            return None
+        tok = int(next_tok[0])
+        self._chunk_state[slot] = None
+        self._last_tokens[slot] = tok
+        self._new_counts[slot] = 1
+        hist = self._history[slot]
+        if hist is not None:
+            hist.append(tok)
+        self.tokens_generated += 1
         # make this prompt's full blocks (hit + freshly computed) reusable
         if self.prefix_cache is not None:
             reg = (length - 1) // bt
             if reg:
-                self.prefix_cache.register(prompt, row[:reg])
-        return tok, self._done(slot, tok)
+                self.prefix_cache.register(
+                    prompt, [int(b) for b in self._tables[slot, :reg]]
+                )
+        return tok
 
     def fork(self, src: int, dst: int) -> None:
         """Share ALL of src's blocks (including the partial tail) with dst:
@@ -719,6 +805,10 @@ class PagedDecodeEngine:
         block triggers copy-on-write in step()."""
         if not self._live[src]:
             raise ValueError(f"fork source slot {src} is not live")
+        if self._chunk_state[src] is not None:
+            raise ValueError(
+                f"fork source slot {src} is still prefilling (chunked)"
+            )
         if self._live[dst]:
             self._release_blocks(dst)
         self._tables[dst] = self._tables[src].copy()
@@ -741,6 +831,11 @@ class PagedDecodeEngine:
         pending sampled token — tests and speculative-decode hooks)."""
         if not self._live[slot]:
             raise ValueError(f"slot {slot} is not live")
+        if self._chunk_state[slot] is not None:
+            raise ValueError(
+                f"slot {slot} is still prefilling (chunked) — no pending "
+                "sampled token to replace"
+            )
         self._last_tokens[slot] = int(token)
         hist = self._history[slot]
         if hist:
@@ -754,15 +849,36 @@ class PagedDecodeEngine:
         Without speculation each slot's result is (token, done). With
         `speculative_k > 0` a step that verified drafts returns
         ([token, ...], done) — 1..k+1 tokens per slot — and steps where no
-        slot drafted fall back to the plain single-token result."""
+        slot drafted fall back to the plain single-token result.
+
+        Slots still streaming a chunked prefill advance by ONE chunk per
+        step and report ([], False) until their prompt is consumed (the
+        completing chunk reports ([tok], done) with the first sampled
+        token); every other slot decodes in the same step — chunk work
+        and decode work interleave, so no decode stream ever waits for a
+        whole long prompt."""
         surviving = [s for s in sorted(set(slots)) if self._live[s]]
         if not surviving:
             return {}
-        if self.speculative_k:
-            drafts = self._propose(surviving)
-            if any(drafts.values()):
-                return self._spec_step(surviving, drafts)
-        return self._plain_step(surviving)
+        out: Dict[int, Tuple[Any, bool]] = {}
+        prefilling = [
+            s for s in surviving if self._chunk_state[s] is not None
+        ]
+        for s in prefilling:
+            tok = self._run_prefill_chunk(s)
+            out[s] = ([], False) if tok is None else (
+                [tok], self._done(s, tok)
+            )
+        decoding = [s for s in surviving if self._chunk_state[s] is None
+                    and s not in out]
+        if decoding:
+            if self.speculative_k:
+                drafts = self._propose(decoding)
+                if any(drafts.values()):
+                    out.update(self._spec_step(decoding, drafts))
+                    return out
+            out.update(self._plain_step(decoding))
+        return out
 
     def _span_need(self, surviving: List[int], block_span) -> int:
         """Blocks the write spans require right now: unallocated entries
@@ -787,14 +903,32 @@ class PagedDecodeEngine:
         preemptions). Note _reclaim cannot change the spans' own need
         (eviction only frees cache-ONLY blocks, refcount 1 — a span
         block is always also held by its slot), so need is computed once
-        per pass."""
+        per pass.
+
+        Newest-first is GLOBAL: slots mid-chunked-prefill are not in
+        `surviving` (they allocated at admission and never step here) but
+        they ARE preemption candidates — a freshly admitted long prompt
+        is the newest work with the least to recompute, and exempting it
+        would let one prefill serially evict every older decode stream
+        (the exact head-of-line inversion chunking exists to fix). A
+        preempted prefilling slot parks its full prompt and readmits like
+        any other preemption."""
+        prefilling = [
+            s for s in range(self.max_batch_size)
+            if self._live[s] and self._chunk_state[s] is not None
+            and s not in surviving
+        ]
         while True:
             need = self._span_need(surviving, block_span)
             self._reclaim(need)
             if need <= self.allocator.num_free:
                 break
-            victim = max(surviving, key=lambda s: self._admit_seq[s])
+            victim = max(surviving + prefilling,
+                         key=lambda s: self._admit_seq[s])
             self._preempt(victim)
+            if victim in prefilling:
+                prefilling.remove(victim)
+                continue
             surviving.remove(victim)
             if not surviving:
                 return surviving
@@ -1037,6 +1171,13 @@ class PagedDecodeEngine:
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "prefill_tokens": self.prefill_tokens,
+            # chunked prefill: 0 chunk tokens = whole-prompt admission
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "prefill_chunks": self.prefill_chunks,
+            "chunked_prefills": self.chunked_prefills,
+            "prefilling": sum(
+                1 for st in self._chunk_state if st is not None
+            ),
             "decode_steps": self.decode_steps,
             "max_batch_size": self.max_batch_size,
             "block_tokens": self.block_tokens,

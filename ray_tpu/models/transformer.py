@@ -736,11 +736,15 @@ def make_paged_decoder(
 
     paged_prefill(params, pool, table[Nmax], tokens[1,Sb], length, ctx_len,
                   key, ctx_blocks) -> (next_token[1], logits[1,V], pool)
-      B=1 prefill of a prompt SUFFIX whose first `ctx_len` tokens (a block
-      multiple) are already in the pool (prefix-cache hit; 0 for a cold
-      prompt). Suffix K/V is scattered into the slot's table blocks and
-      attention runs over the gathered block window, so the shared span is
-      never recomputed. `ctx_blocks` is STATIC (bucketed by the caller —
+      B=1 prefill of a prompt SUFFIX whose first `ctx_len` tokens are
+      already in the pool — a prefix-cache hit (block multiple), a prior
+      prefill CHUNK of the same prompt (any offset; kv_paging's chunked
+      admission calls this once per chunk), or 0 for a cold prompt.
+      Suffix K/V is scattered into the slot's table blocks — a chunk
+      boundary may land mid-block; the straddled block is slot-owned —
+      and attention runs over the block window (gathered under "gather",
+      walked in place under "fused"), so the committed span is never
+      recomputed. `ctx_blocks` is STATIC (bucketed by the caller —
       kv_paging pads block counts to the same bucket boundaries as prompt
       lengths) and keys the compile cache together with the suffix bucket.
 
@@ -764,15 +768,15 @@ def make_paged_decoder(
       position i-1 and every earlier draft survived), and ONLY the
       accepted inputs' K/V commit to the pool — rejected entries route to
       the null block, so there is nothing in the pool to roll back.
-      Attention reuses the paged-prefill window machinery: the slot's
-      cached window is gathered through its table and the K1 in-flight
-      K/V are appended past it with a causal tail mask, so no pool write
-      precedes acceptance. Like prefill, the verify step ALWAYS runs this
-      gather-window formulation — `attention_impl="fused"` covers only
-      the single-token decode step (the Pallas kernel is single-query);
-      extending the fused walk to the k+1-query verify is the named TPU
-      follow-up, and until then long-context speculation re-pays part of
-      the gather cost the fused kernel removed.
+      Attention never writes before acceptance: under
+      `attention_impl="gather"` the slot's cached window is gathered
+      through its table and the K1 in-flight K/V are appended past it
+      with a causal tail mask; under `attention_impl="fused"` the cached
+      window runs the multi-query fused walk (kv_len = positions keeps
+      the unwritten span invisible) and the K1 x K1 in-flight tail folds
+      in as a second online-softmax partial via the log-sum-exp merge —
+      so long-context speculation keeps the fused win instead of
+      re-paying the gather cost.
       Compiled once per (B, K1, Nmax) — the engine
       buckets K1 (kv_paging) so draft-length jitter cannot churn the jit
       cache. Greedy-only: with temperature > 0 the per-position samples
@@ -799,17 +803,18 @@ def make_paged_decoder(
     int8 engine is self-consistent even though it is not bit-identical to
     the fp reference path (which stays exact under the default dtype).
 
-    `attention_impl` picks the decode-step attention:
+    `attention_impl` picks the attention for EVERY phase — decode (q=1),
+    prefill (q=suffix chunk) and speculative verify (q=k+1):
       "gather"  gather each slot's window [B, Nmax*bt] through its block
                 table, then dense masked softmax — the exact reference
                 path (bit-identical to the dense engine in fp).
       "fused"   ops/paged_attention.py walks the block table and attends
-                block-in-place (Pallas kernel on TPU, chunked online
-                softmax under XLA elsewhere; `fused_impl` forces one).
-                Composes with KV_CACHE_AXES sharding via shard_map:
-                block-sharded pools run per-shard with a log-sum-exp
-                merge across the block axes; tp-sharded kv_heads need no
-                merge.
+                block-in-place with a q-tile grid axis (Pallas kernel on
+                TPU, chunked online softmax under XLA elsewhere;
+                `fused_impl` forces one). Composes with KV_CACHE_AXES
+                sharding via shard_map: block-sharded pools run per-shard
+                with a log-sum-exp merge across the block axes;
+                tp-sharded kv_heads need no merge.
 
     `chunk_blocks` tunes the fused-XLA walk only (blocks folded per
     online-softmax chunk — larger amortizes gather dispatch, smaller caps
@@ -899,8 +904,20 @@ def make_paged_decoder(
             axes = (axes,)
         return tuple(a for a in axes if a in mesh.shape)
 
-    def _fused_attend(q1, kc, vc, ksc, vsc, tables, positions):
-        """q1 [B, H, D] against the (possibly sharded) per-layer pool."""
+    def _fused_attend(qx, kc, vc, ksc, vsc, tables, positions, kv_len=None,
+                      partial=False):
+        """qx [B, Q, H, D] against the (possibly sharded) per-layer pool.
+
+        One fused formulation for every phase: decode (Q=1), prefill
+        (Q=chunk) and speculative verify (Q=k+1) — query i of slot b sits
+        at positions[b]+i and `kv_len` caps the live cached window (verify
+        passes kv_len=positions so the not-yet-written in-flight span
+        stays invisible; see ops/paged_attention.py).
+
+        `partial=True` returns the unnormalized (acc, m, l) online-softmax
+        triple — already combined across block-sharded pool shards, so the
+        caller can log-sum-exp-merge extra non-pool keys (the verify
+        step's in-flight K1 tail) before normalizing."""
         from jax.sharding import PartitionSpec as P
 
         from ..ops.paged_attention import merge_partials, paged_attention
@@ -910,23 +927,27 @@ def make_paged_decoder(
         block_axes = _flat_axes("batch")
         kv_axes = _flat_axes("kv_heads")
         q_axes = _flat_axes("heads")
+        if kv_len is None:
+            kv_len = positions + qx.shape[1]
         if not block_axes and not kv_axes:
             return paged_attention(
-                q1, kc, vc, tables, positions, scale=scale,
-                impl=fused_impl, chunk_blocks=chunk_blocks, **scales,
+                qx, kc, vc, tables, positions, scale=scale,
+                impl=fused_impl, chunk_blocks=chunk_blocks, kv_len=kv_len,
+                partial_out=partial, **scales,
             )
 
-        def inner(q1, kc, vc, *rest):
+        def inner(qx, kc, vc, *rest):
             if quant:
                 (ksc, vsc), rest = rest[:2], rest[2:]
                 sc = dict(k_scale=ksc, v_scale=vsc)
             else:
                 sc = {}
-            tables, positions = rest
+            tables, positions, kv_len = rest
             if not block_axes:
                 return paged_attention(
-                    q1, kc, vc, tables, positions, scale=scale,
-                    impl=fused_impl, chunk_blocks=chunk_blocks, **sc,
+                    qx, kc, vc, tables, positions, scale=scale,
+                    impl=fused_impl, chunk_blocks=chunk_blocks,
+                    kv_len=kv_len, partial_out=partial, **sc,
                 )
             # blocks are sharded: remap global table entries to this
             # shard's local ids (others masked dead), attend locally, and
@@ -939,28 +960,73 @@ def make_paged_decoder(
             live = (tables > 0) & (tables >= lo) & (tables < lo + nloc)
             ptab = jnp.where(live, tables - lo, -1).astype(jnp.int32)
             acc, m, l = paged_attention(
-                q1, kc, vc, ptab, positions, scale=scale, impl=fused_impl,
+                qx, kc, vc, ptab, positions, scale=scale, impl=fused_impl,
                 signed_tables=True, partial_out=True,
-                chunk_blocks=chunk_blocks, **sc,
+                chunk_blocks=chunk_blocks, kv_len=kv_len, **sc,
             )
+            if partial:
+                # fold the shards into ONE globally-valid partial triple
+                # (replicated over the block axes): pmax the running max,
+                # rescale, psum — the caller still owns normalization
+                m_g = lax.pmax(m, block_axes)
+                e = jnp.exp(m - m_g)
+                num = lax.psum(acc * e[..., None], block_axes)
+                den = lax.psum(l * e, block_axes)
+                return num, m_g, den
             return merge_partials(
-                acc, m, l, axis_names=block_axes, out_dtype=q1.dtype
+                acc, m, l, axis_names=block_axes, out_dtype=qx.dtype
             )
 
         bspec = tuple(block_axes) if block_axes else None
         kvspec = tuple(kv_axes) if kv_axes else None
-        qspec = P(None, tuple(q_axes) if q_axes else None, None)
+        hspec = tuple(q_axes) if q_axes else None
+        qspec = P(None, None, hspec, None)
         in_specs = [qspec, P(bspec, None, kvspec, None), P(bspec, None, kvspec, None)]
-        args = [q1, kc, vc]
+        args = [qx, kc, vc]
         if quant:
             in_specs += [P(bspec, kvspec)] * 2
             args += [ksc, vsc]
-        in_specs += [P(None, None), P(None)]
-        args += [tables, positions]
+        in_specs += [P(None, None), P(None), P(None)]
+        args += [tables, positions, kv_len]
         manual = set(block_axes) | set(kv_axes) | set(q_axes)
+        out_specs = (
+            (qspec, P(None, None, hspec), P(None, None, hspec))
+            if partial else qspec
+        )
         return shard_map_compat(
-            inner, mesh, tuple(in_specs), qspec, manual
+            inner, mesh, tuple(in_specs), out_specs, manual
         )(*args)
+
+    def _merge_inflight(q, acc_w, m_w, l_w, k_infl, v_infl, fmask):
+        """Fold the verify step's K1 in-flight keys (appended past the
+        cached window, never yet in the pool) into the fused window
+        partial: a tiny dense causal pass produces its own (acc, m, l)
+        and the log-sum-exp combine yields the exact softmax over
+        window + in-flight — no gathered window ever exists.
+
+        q [B,K1,H,D]; k_infl/v_infl [B,K1,KV,D]; fmask [B,K1,K1]."""
+        from ..ops.paged_attention import merge_partials
+
+        kr = _repeat_kv(k_infl, n_rep).astype(jnp.float32)
+        vr = _repeat_kv(v_infl, n_rep).astype(jnp.float32)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kr,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = fmask[:, None, :, :]  # [B,1,K1,K1]
+        s = jnp.where(mask, s, NEG_INF)
+        m_f = jnp.max(s, axis=-1)                      # [B,H,K1]
+        p = jnp.where(mask, jnp.exp(s - m_f[..., None]), 0.0)
+        l_f = jnp.sum(p, axis=-1)                      # [B,H,K1]
+        acc_f = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vr, preferred_element_type=jnp.float32
+        )
+        m_f = m_f.transpose(0, 2, 1)                   # [B,K1,H]
+        l_f = l_f.transpose(0, 2, 1)
+        return merge_partials(
+            jnp.stack([acc_w, acc_f]), jnp.stack([m_w, m_f]),
+            jnp.stack([l_w, l_f]), out_dtype=cfg.dtype,
+        )
 
     def _prefill_body(G, params, pool, table, tokens, length, ctx_len, key):
         params = _cast_matmul_params(cfg, params)
@@ -1025,17 +1091,33 @@ def make_paged_decoder(
             q = apply_rope(q, cos, sin, positions=qpos[None])
             k = apply_rope(k, cos, sin, positions=qpos[None])
             q = _constrain(q, "batch", "seq", "heads", "head_dim")
-            # write the suffix K/V, then gather the window back — suffix
-            # keys come from the pool, so cache content is authoritative
+            # write the suffix K/V first — suffix keys are then read back
+            # from the pool, so cache content is authoritative either way
             if quant:
                 kc, ksc, kw = _write_suffix_quant(kc, ksc, k[0])
                 vc, vsc, vw = _write_suffix_quant(vc, vsc, v[0])
             else:
                 kc = kc.at[w_phys, w_off].set(k[0].astype(kc.dtype))
                 vc = vc.at[w_phys, w_off].set(v[0].astype(vc.dtype))
-                kw = kc[window].reshape(1, G * bt, *kc.shape[2:])
-                vw = vc[window].reshape(1, G * bt, *vc.shape[2:])
-            attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
+                kw = vw = None
+            if attention_impl == "fused":
+                # multi-query fused walk over the window blocks in place:
+                # query i sits at ctx_len + i, kv_len caps recycled-block
+                # positions past the live span (quant kw/vw are unused —
+                # the kernel dequantizes from the pool itself)
+                attn = _fused_attend(
+                    q, kc, vc, ksc if quant else None,
+                    vsc if quant else None, window[None],
+                    jnp.reshape(jnp.asarray(ctx_len, jnp.int32), (1,)),
+                    kv_len=jnp.reshape(
+                        jnp.asarray(ctx_len + length, jnp.int32), (1,)
+                    ),
+                )
+            else:
+                if not quant:
+                    kw = kc[window].reshape(1, G * bt, *kc.shape[2:])
+                    vw = vc[window].reshape(1, G * bt, *vc.shape[2:])
+                attn = _cached_attend(q, kw, vw, kmask, scale, n_rep)
             x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"])
             x = x + _mlp(h2, lp, cfg, _constrain)
@@ -1102,10 +1184,13 @@ def make_paged_decoder(
                 kc = kc.at[write_phys, write_off].set(k[:, 0].astype(kc.dtype))
                 vc = vc.at[write_phys, write_off].set(v[:, 0].astype(vc.dtype))
             if attention_impl == "fused":
-                # block-in-place attention: no [B, W] gather exists
+                # block-in-place attention: no [B, W] gather exists. This
+                # token's K/V was just written, so the live window is
+                # positions + 1 keys deep
                 attn = _fused_attend(
-                    q[:, 0], kc, vc, ksc, vsc, tables, positions
-                )[:, None]
+                    q, kc, vc, ksc, vsc, tables, positions,
+                    kv_len=positions + 1,
+                )
             else:
                 if quant:
                     kw = _dequant(kc[tables], ksc[tables]).reshape(
@@ -1205,19 +1290,32 @@ def make_paged_decoder(
             q = apply_rope(q, cos, sin, positions=rope_pos)
             k = apply_rope(k, cos, sin, positions=rope_pos)
             q = _constrain(q, "batch", "seq", "heads", "head_dim")
-            if quant:
-                kw = _dequant(kc[tables], ksc[tables]).reshape(
-                    B, W, *kc.shape[2:]
+            if attention_impl == "fused":
+                # multi-query fused walk over the cached window (kv_len =
+                # positions keeps the not-yet-written span invisible and
+                # masks recycled-block staleness), then the K1 in-flight
+                # keys fold in as a second online-softmax partial — the
+                # gather-window concat never materializes
+                acc_w, m_w, l_w = _fused_attend(
+                    q, kc, vc, ksc if quant else None,
+                    vsc if quant else None, tables, positions,
+                    kv_len=positions, partial=True,
                 )
-                vw = _dequant(vc[tables], vsc[tables]).reshape(
-                    B, W, *vc.shape[2:]
-                )
+                attn = _merge_inflight(q, acc_w, m_w, l_w, k, v, fmask)
             else:
-                kw = kc[tables].reshape(B, W, *kc.shape[2:])
-                vw = vc[tables].reshape(B, W, *vc.shape[2:])
-            kcat = jnp.concatenate([kw, k.astype(kw.dtype)], axis=1)
-            vcat = jnp.concatenate([vw, v.astype(vw.dtype)], axis=1)
-            attn = _cached_attend(q, kcat, vcat, mask, scale, n_rep)
+                if quant:
+                    kw = _dequant(kc[tables], ksc[tables]).reshape(
+                        B, W, *kc.shape[2:]
+                    )
+                    vw = _dequant(vc[tables], vsc[tables]).reshape(
+                        B, W, *vc.shape[2:]
+                    )
+                else:
+                    kw = kc[tables].reshape(B, W, *kc.shape[2:])
+                    vw = vc[tables].reshape(B, W, *vc.shape[2:])
+                kcat = jnp.concatenate([kw, k.astype(kw.dtype)], axis=1)
+                vcat = jnp.concatenate([vw, v.astype(vw.dtype)], axis=1)
+                attn = _cached_attend(q, kcat, vcat, mask, scale, n_rep)
             x = x + jnp.einsum("bshd,hde->bse", attn, lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"])
             x = x + _mlp(h2, lp, cfg, _constrain)

@@ -1,17 +1,23 @@
-"""Fused paged-attention decode for TPU in Pallas.
+"""Fused multi-query paged attention for TPU in Pallas.
 
 The paged decode step (ray_tpu/models/transformer.py make_paged_decoder)
 historically gathered every slot's logical sequence through its block
 table inside the jit — materializing [B, Nmax*block_tokens] keys AND
 values per layer before attending. At long contexts that gather, not the
-matmuls, is what caps tokens/s/chip: decode attention reads every live KV
-byte once per token, so doubling the traffic halves the rate.
+matmuls, is what caps tokens/s/chip: attention reads every live KV byte
+once per step, so doubling the traffic halves the rate.
 
-This kernel attends block-in-place over the pool layout instead:
+This kernel attends block-in-place over the pool layout instead, for ANY
+number of queries per slot — one fused implementation serves
 
-  grid = (batch, block)   block innermost, so the online-softmax scratch
-                          (f32 acc / running max / denominator) persists
-                          across one slot's walk of its block table
+  decode            q = 1   (the original single-query walk)
+  speculative verify q = k+1 (the draft window scored in one pass)
+  prefill           q = chunk (chunked prefill of a prompt span)
+
+  grid = (batch, q_tile, block)   block innermost, so the online-softmax
+                          scratch (f32 acc / running max / denominator)
+                          persists across one (slot, q-tile)'s walk of the
+                          slot's block table
   k/v BlockSpec           index_map reads the slot's block table (a
                           scalar-prefetch operand) and DMAs physical
                           block `table[b, j]` directly from the pool —
@@ -20,10 +26,18 @@ This kernel attends block-in-place over the pool layout instead:
                           out-of-shard blocks) clamp to block 0 in the
                           index map — Pallas skips the re-fetch when the
                           block index repeats — and are masked in-body
-  past-length masking     key position j*block + t attends iff <= pos[b]
+  causal masking          query i sits at global position positions[b]+i;
+                          key position j*block + t is visible iff
+                          t' <= positions[b]+i AND t' < kv_len[b]. The
+                          kv_len cap is what lets the verify step attend a
+                          window that does NOT yet contain the in-flight
+                          tokens (kv_len = positions, strictly before the
+                          first query), while prefill uses pure causality
+                          over keys its own layer pass just wrote.
 
-GQA never materializes repeated KV heads: q is reshaped [KV, n_rep, D]
-and both matmuls run batched over the kv-head dim.
+GQA never materializes repeated KV heads: q is reshaped so both matmuls
+run batched over the kv-head dim, with the query tile folded into the
+repeat dim.
 
 int8 pools (per-block, per-kv-head fp32 scales — see
 transformer.init_paged_kv_cache) dequantize INSIDE the kernel: the HBM
@@ -34,7 +48,8 @@ per-shard with `partial_out=True`: the kernel returns the unnormalized
 accumulator plus the online-softmax (m, l) statistics, and the caller
 merges shards with the standard log-sum-exp combine (see
 `merge_partials`). kv_heads sharded on tp need no merge — heads are
-independent.
+independent. The same partial triple is how the verify step folds its
+tiny in-flight K1 x K1 causal tail into the fused window pass.
 
 A chunked XLA implementation (`impl="xla"`) computes the identical
 online-softmax walk without Pallas — the CPU/CI path (interpret-mode
@@ -74,8 +89,8 @@ _LAST_IMPL: Optional[str] = None
 
 
 def _group_scores(q, k):
-    """[KV, n_rep, D] x [bt, KV, D] -> [KV, n_rep, bt] without repeating
-    KV heads (batched over the kv-head dim)."""
+    """[KV, R, D] x [bt, KV, D] -> [KV, R, bt] without repeating KV heads
+    (batched over the kv-head dim; R folds n_rep and the query tile)."""
     kt = k.transpose(1, 0, 2)  # [KV, bt, D]
     return lax.dot_general(
         q, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
@@ -83,15 +98,15 @@ def _group_scores(q, k):
 
 
 def _group_values(p, v):
-    """[KV, n_rep, bt] x [bt, KV, D] -> [KV, n_rep, D]."""
+    """[KV, R, bt] x [bt, KV, D] -> [KV, R, D]."""
     vt = v.transpose(1, 0, 2)  # [KV, bt, D]
     return lax.dot_general(
         p, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
     )
 
 
-def _pa_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
-               bt, n_rep, scale, quantized, partial_out, out_dtype):
+def _pa_kernel(tables_ref, pos_ref, kvlen_ref, q_ref, k_ref, v_ref, *rest,
+               bt, qb, n_rep, scale, quantized, partial_out, out_dtype):
     if quantized:
         ks_ref, vs_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -102,8 +117,11 @@ def _pa_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref = rest[0]
         acc, m_i, l_i = rest[1:]
     b = pl.program_id(0)
-    j = pl.program_id(1)
-    nj = pl.num_programs(1)
+    qt = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    kv_heads = k_ref.shape[2]
+    rows = qb * n_rep  # scratch rows per kv head (query tile x GQA repeat)
 
     @pl.when(j == 0)
     def _init():
@@ -113,7 +131,14 @@ def _pa_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
 
     entry = tables_ref[b, j]
     pos = pos_ref[b]
-    live = jnp.logical_and(entry >= 0, j * bt <= pos)
+    kvl = kvlen_ref[b]
+    qbase = pos + qt * qb  # global position of this tile's first query
+    # the block matters iff any of the tile's queries can see any key in it:
+    # its first key must precede both the kv_len cap and the LAST query
+    live = jnp.logical_and(
+        entry >= 0,
+        jnp.logical_and(j * bt < kvl, j * bt <= qbase + qb - 1),
+    )
 
     @pl.when(live)
     def _attend():
@@ -126,49 +151,74 @@ def _pa_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
         else:
             k = k.astype(jnp.float32)
             v = v.astype(jnp.float32)
-        kv_heads = k.shape[1]
         d = k.shape[2]
-        h = kv_heads * n_rep
-        qr = q_ref[0].astype(jnp.float32).reshape(kv_heads, n_rep, d)
-        s = _group_scores(qr, k).reshape(h, bt) * scale
-        kpos = j * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
-        s = jnp.where(kpos <= pos, s, NEG_INF)
+        # [qb, H, D] -> [KV, qb*n_rep, D]: fold the query tile into the GQA
+        # repeat dim so both matmuls stay batched over kv heads
+        qr = q_ref[0].astype(jnp.float32)
+        qr = qr.reshape(qb, kv_heads, n_rep, d).transpose(1, 0, 2, 3)
+        qr = qr.reshape(kv_heads, rows, d)
+        s = (_group_scores(qr, k) * scale).reshape(kv_heads * rows, bt)
+        # flat row = g*rows + qi*n_rep + r  ->  query index (row % rows)//n_rep
+        kpos = j * bt + jax.lax.broadcasted_iota(
+            jnp.int32, (kv_heads * rows, bt), 1
+        )
+        qi = (jax.lax.broadcasted_iota(
+            jnp.int32, (kv_heads * rows, bt), 0
+        ) % rows) // n_rep
+        mask = jnp.logical_and(kpos <= qbase + qi, kpos < kvl)
+        s = jnp.where(mask, s, NEG_INF)
         m_prev = m_i[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # masked p, not exp(NEG_INF - m): a row whose every key this block
+        # is masked (an early query under a later block) keeps m_new at
+        # NEG_INF, and exp(s - m_new) would be exp(0) = 1 garbage
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_i[:] = alpha * l_i[:] + jnp.sum(p, axis=1, keepdims=True)
         m_i[:] = m_new
-        pv = _group_values(p.reshape(kv_heads, n_rep, bt), v)
-        acc[:] = acc[:] * alpha + pv.reshape(h, d)
+        pv = _group_values(p.reshape(kv_heads, rows, bt), v)
+        acc[:] = acc[:] * alpha + pv.reshape(kv_heads * rows, d)
 
     @pl.when(j == nj - 1)
     def _finalize():
+        def unflat(x):  # [KV*qb*n_rep, X] -> [qb, H, X]
+            x = x.reshape(kv_heads, qb, n_rep, x.shape[-1])
+            return x.transpose(1, 0, 2, 3).reshape(
+                qb, kv_heads * n_rep, x.shape[-1]
+            )
+
         if partial_out:
-            o_ref[0] = acc[:]
-            m_ref[0] = m_i[:]
-            l_ref[0] = l_i[:]
+            o_ref[0] = unflat(acc[:])
+            m_ref[0] = unflat(m_i[:])
+            l_ref[0] = unflat(l_i[:])
         else:
             l = l_i[:]
             safe_l = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0] = (acc[:] / safe_l).astype(out_dtype)
+            o_ref[0] = unflat(acc[:] / safe_l).astype(out_dtype)
 
 
-def _paged_attention_pallas(q, k_pool, v_pool, ptable, positions,
-                            k_scale, v_scale, scale, partial_out, interpret):
-    b, h, d = q.shape
+def _paged_attention_pallas(q, k_pool, v_pool, ptable, positions, kv_len,
+                            k_scale, v_scale, scale, partial_out, interpret,
+                            block_q):
+    b, Q, h, d = q.shape
     _, bt, kv, _ = k_pool.shape
     nmax = ptable.shape[1]
     n_rep = h // kv
     quantized = k_scale is not None
-    grid = (b, nmax)
+    qb = max(1, min(int(block_q), Q))
+    qp = -(-Q // qb) * qb
+    if qp != Q:
+        # padded queries sit past every real one; their rows mask to zeros
+        # and are sliced off below
+        q = jnp.pad(q, ((0, 0), (0, qp - Q), (0, 0), (0, 0)))
+    grid = (b, qp // qb, nmax)
 
-    q_spec = pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0))
+    q_spec = pl.BlockSpec((1, qb, h, d), lambda b_, qt_, j_, *_: (b_, qt_, 0, 0))
     kv_spec = pl.BlockSpec(
         (1, bt, kv, d),
         # dead entries (< 0) clamp to block 0: repeated indices skip the
         # DMA, so a slot's padding tail costs one null-block fetch total
-        lambda b_, j_, tbl, pos: (jnp.maximum(tbl[b_, j_], 0), 0, 0, 0),
+        lambda b_, qt_, j_, tbl, pos, kvl: (jnp.maximum(tbl[b_, j_], 0), 0, 0, 0),
     )
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [q, k_pool, v_pool]
@@ -177,60 +227,61 @@ def _paged_attention_pallas(q, k_pool, v_pool, ptable, positions,
         # in-body — a (1, KV) block would fight the sublane tiling rules
         in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
         operands += [k_scale, v_scale]
+    o_map = lambda b_, qt_, j_, *_: (b_, qt_, 0, 0)
     if partial_out:
         out_specs = [
-            pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0)),
-            pl.BlockSpec((1, h, 1), lambda b_, j_, *_: (b_, 0, 0)),
-            pl.BlockSpec((1, h, 1), lambda b_, j_, *_: (b_, 0, 0)),
+            pl.BlockSpec((1, qb, h, d), o_map),
+            pl.BlockSpec((1, qb, h, 1), o_map),
+            pl.BlockSpec((1, qb, h, 1), o_map),
         ]
         out_shape = [
-            jax.ShapeDtypeStruct((b, h, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, qp, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, qp, h, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, qp, h, 1), jnp.float32),
         ]
     else:
-        out_specs = [pl.BlockSpec((1, h, d), lambda b_, j_, *_: (b_, 0, 0))]
-        out_shape = [jax.ShapeDtypeStruct((b, h, d), q.dtype)]
+        out_specs = [pl.BlockSpec((1, qb, h, d), o_map)]
+        out_shape = [jax.ShapeDtypeStruct((b, qp, h, d), q.dtype)]
 
     kernel = functools.partial(
-        _pa_kernel, bt=bt, n_rep=n_rep, scale=scale, quantized=quantized,
-        partial_out=partial_out, out_dtype=q.dtype,
+        _pa_kernel, bt=bt, qb=qb, n_rep=n_rep, scale=scale,
+        quantized=quantized, partial_out=partial_out, out_dtype=q.dtype,
     )
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
             in_specs=in_specs,
             out_specs=out_specs,
             scratch_shapes=[
-                pltpu.VMEM((h, d), jnp.float32),
-                pltpu.VMEM((h, 1), jnp.float32),
-                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((qb * h, d), jnp.float32),
+                pltpu.VMEM((qb * h, 1), jnp.float32),
+                pltpu.VMEM((qb * h, 1), jnp.float32),
             ],
         ),
         out_shape=out_shape,
-        # batch iterations are independent (scratch re-inits at j == 0);
-        # the block walk is sequential — it carries the online-softmax
-        # scratch. Telling Mosaic lets it parallelize/pipeline over b
-        # while keeping each slot's walk ordered.
-        compiler_params=_mosaic_params(("parallel", "arbitrary")),
+        # batch and q-tile iterations are independent (scratch re-inits at
+        # j == 0); the block walk is sequential — it carries the
+        # online-softmax scratch. Telling Mosaic lets it
+        # parallelize/pipeline over (b, qt) while keeping each walk ordered.
+        compiler_params=_mosaic_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(ptable, positions, *operands)
+    )(ptable, positions, kv_len, *operands)
     if partial_out:
         acc, m, l = outs
-        return acc, m[..., 0], l[..., 0]
-    return outs[0]
+        return acc[:, :Q], m[:, :Q, :, 0], l[:, :Q, :, 0]
+    return outs[0][:, :Q]
 
 
-def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
+def _paged_attention_xla(q, k_pool, v_pool, ptable, positions, kv_len,
                          k_scale, v_scale, scale, partial_out, chunk_blocks):
     """The same block walk as the kernel, chunked for XLA: each chunk
     gathers `chunk_blocks` physical blocks and folds them into the online
     softmax. Never materializes the full [B, Nmax*bt] window or repeated
     KV heads — on CPU this beats the gather path on exactly the traffic
     the kernel saves on TPU."""
-    b, h, d = q.shape
+    b, Q, h, d = q.shape
     _, bt, kv, _ = k_pool.shape
     nmax = ptable.shape[1]
     n_rep = h // kv
@@ -240,11 +291,12 @@ def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
     if nch * cb != nmax:
         ptable = jnp.pad(ptable, ((0, 0), (0, nch * cb - nmax)),
                          constant_values=-1)
-    qr = (q.astype(jnp.float32) * scale).reshape(b, kv, n_rep, d)
-    m = jnp.full((b, h, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, 1), jnp.float32)
-    acc = jnp.zeros((b, h, d), jnp.float32)
-    pos2 = positions.astype(jnp.int32)[:, None, None]
+    qr = (q.astype(jnp.float32) * scale).reshape(b, Q, kv, n_rep, d)
+    m = jnp.full((b, Q, h, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, Q, h, 1), jnp.float32)
+    acc = jnp.zeros((b, Q, h, d), jnp.float32)
+    qpos = positions[:, None].astype(jnp.int32) + jnp.arange(Q)[None, :]
+    kvl = kv_len.astype(jnp.int32)[:, None, None, None]
     for c in range(nch):
         tb = ptable[:, c * cb:(c + 1) * cb]  # [B, cb]
         idx = jnp.maximum(tb, 0)
@@ -256,11 +308,15 @@ def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
         kc = kc.astype(jnp.float32).reshape(b, cb * bt, kv, d)
         vc = vc.astype(jnp.float32).reshape(b, cb * bt, kv, d)
         s = jnp.einsum(
-            "bgnd,btgd->bgnt", qr, kc, preferred_element_type=jnp.float32
-        ).reshape(b, h, cb * bt)
-        kpos = c * cb * bt + jnp.arange(cb * bt)[None, None, :]
-        live = jnp.repeat(tb >= 0, bt, axis=1)[:, None, :]
-        mask = live & (kpos <= pos2)
+            "bqgnd,btgd->bqgnt", qr, kc, preferred_element_type=jnp.float32
+        ).reshape(b, Q, h, cb * bt)
+        kpos = c * cb * bt + jnp.arange(cb * bt)
+        live = jnp.repeat(tb >= 0, bt, axis=1)[:, None, None, :]
+        mask = (
+            live
+            & (kpos[None, None, None, :] <= qpos[:, :, None, None])
+            & (kpos[None, None, None, :] < kvl)
+        )
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # NEG_INF is finite: a fully-masked row would otherwise see
@@ -269,9 +325,9 @@ def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         pv = jnp.einsum(
-            "bgnt,btgd->bgnd", p.reshape(b, kv, n_rep, cb * bt), vc,
+            "bqgnt,btgd->bqgnd", p.reshape(b, Q, kv, n_rep, cb * bt), vc,
             preferred_element_type=jnp.float32,
-        ).reshape(b, h, d)
+        ).reshape(b, Q, h, d)
         acc = acc * alpha + pv
         m = m_new
     if partial_out:
@@ -281,11 +337,11 @@ def _paged_attention_xla(q, k_pool, v_pool, ptable, positions,
 
 
 def paged_attention(
-    q: jnp.ndarray,        # [B, H, D] one decode token per slot
+    q: jnp.ndarray,        # [B, H, D] one query per slot, or [B, Q, H, D]
     k_pool: jnp.ndarray,   # [N, block_tokens, KV, D] physical blocks
     v_pool: jnp.ndarray,   # [N, block_tokens, KV, D]
     tables: jnp.ndarray,   # [B, Nmax] int32 block table per slot
-    positions: jnp.ndarray,  # [B] int32 current position (this token's)
+    positions: jnp.ndarray,  # [B] int32 global position of query 0
     *,
     k_scale: Optional[jnp.ndarray] = None,  # [N, KV] f32 (int8 pools)
     v_scale: Optional[jnp.ndarray] = None,
@@ -297,18 +353,31 @@ def paged_attention(
                                    # the null-block sentinel
     partial_out: bool = False,     # return (acc, m, l) for cross-shard merge
     chunk_blocks: int = 8,
+    kv_len: Optional[jnp.ndarray] = None,  # [B] live cached keys; keys at
+                                   # kpos >= kv_len are dead regardless of
+                                   # causality (verify: kv_len = positions;
+                                   # default positions + Q covers decode
+                                   # and prefill, whose own K/V is written)
+    block_q: int = 16,             # kernel query-tile size (q axis padded
+                                   # to a multiple; XLA handles Q whole)
 ) -> jnp.ndarray | Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Paged-attention decode over a block pool (module docstring).
+    """Multi-query paged attention over a block pool (module docstring).
 
-    Returns out [B, H, D] in q's dtype, or with `partial_out=True` the
-    unnormalized f32 (acc [B, H, D], m [B, H], l [B, H]) triple for
-    `merge_partials`. Slots whose table is fully dead return zeros."""
+    Query i of slot b sits at global position positions[b] + i and
+    attends key position t iff t <= positions[b] + i and t < kv_len[b].
+    Returns out in q's dtype and shape ([B, H, D] for 3-D q, else
+    [B, Q, H, D]), or with `partial_out=True` the unnormalized f32
+    (acc, m, l) triple for `merge_partials` (m/l drop the head_dim axis).
+    Slots whose table is fully dead return zeros."""
     global _LAST_IMPL
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together")
-    if q.shape[1] % k_pool.shape[2]:
+    if q.shape[2] % k_pool.shape[2]:
         raise ValueError(
-            f"q heads {q.shape[1]} not a multiple of kv heads {k_pool.shape[2]}"
+            f"q heads {q.shape[2]} not a multiple of kv heads {k_pool.shape[2]}"
         )
     if impl not in ("auto", "kernel", "xla"):
         raise ValueError(f"impl must be auto|kernel|xla, got {impl!r}")
@@ -320,27 +389,39 @@ def paged_attention(
     else:
         ptable = jnp.where(tables > 0, tables, -1).astype(jnp.int32)
     positions = positions.astype(jnp.int32)
+    if kv_len is None:
+        kv_len = positions + q.shape[1]
+    kv_len = kv_len.astype(jnp.int32)
     _LAST_IMPL = impl
     if impl == "kernel":
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
-        return _paged_attention_pallas(
-            q, k_pool, v_pool, ptable, positions, k_scale, v_scale, scale,
-            partial_out, interpret,
+        out = _paged_attention_pallas(
+            q, k_pool, v_pool, ptable, positions, kv_len, k_scale, v_scale,
+            scale, partial_out, interpret, block_q,
         )
-    return _paged_attention_xla(
-        q, k_pool, v_pool, ptable, positions, k_scale, v_scale, scale,
-        partial_out, chunk_blocks,
-    )
+    else:
+        out = _paged_attention_xla(
+            q, k_pool, v_pool, ptable, positions, kv_len, k_scale, v_scale,
+            scale, partial_out, chunk_blocks,
+        )
+    if squeeze:
+        if partial_out:
+            acc, m, l = out
+            return acc[:, 0], m[:, 0], l[:, 0]
+        return out[:, 0]
+    return out
 
 
 def merge_partials(acc, m, l, axis_names=None, out_dtype=jnp.float32):
     """Combine per-shard online-softmax partials into the final output.
 
-    acc [B, H, D] unnormalized, m/l [B, H]. With `axis_names`, the combine
-    runs across those shard_map axes (pmax + psum); without, acc/m/l carry
-    a leading shard dim to reduce over. Rows with no live keys anywhere
-    (l == 0 everywhere) come out zero, mirroring the kernel."""
+    acc [..., D] unnormalized, m/l [...] (any shared leading shape —
+    [B, H] for single-query, [B, Q, H] for multi-query). With
+    `axis_names`, the combine runs across those shard_map axes (pmax +
+    psum); without, acc/m/l carry a leading shard dim to reduce over.
+    Rows with no live keys anywhere (l == 0 everywhere) come out zero,
+    mirroring the kernel."""
     if axis_names:
         m_g = lax.pmax(m, axis_names)
         e = jnp.exp(m - m_g)

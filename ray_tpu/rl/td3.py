@@ -159,7 +159,21 @@ class TD3Learner(Learner):
             params = {"nets": nets, "target": target, "it": it}
             return TrainState(params, opt_state, rng), metrics
 
-        return jax.jit(update, donate_argnums=(0,))
+        # NOT donated: on this rig's jax build (0.4.37 CPU), THIS executable
+        # comes back from the persistent compilation cache (tests/conftest.py)
+        # with its donated-input aliasing broken — nets/target outputs return
+        # the unmodified inputs (targets never move) while `it` and the
+        # metrics are correct. A fresh compile is right; only the
+        # deserialized executable is wrong, so the failure appeared only on
+        # cache-hit runs. The fix stays LOCAL because the corruption is:
+        # every other donated jit (other learners, the paged-decode pools)
+        # is exercised with token/numeric-exactness assertions on warm-cache
+        # runs and none reproduces it — dropping donation fleet-wide would
+        # trade real decode HBM for a failure only ever observed here. The
+        # signature to watch for elsewhere: a cache-hit-only failure where a
+        # donated output equals its unmodified input. The nets here are
+        # tiny — donation bought nothing.
+        return jax.jit(update)
 
     def update(self, buffer: ReplayBuffer) -> Dict[str, float]:
         samples = [buffer.sample(self.minibatch_size) for _ in range(self.num_sgd_iter)]

@@ -254,6 +254,16 @@ class ContinuousBatcher:
     stream individually, so SSE consumers see the whole accepted burst
     and deadlines/drain/preemption still cut at token granularity.
 
+    Chunked prefill (PagedDecodeEngine with prefill_chunk_tokens > 0)
+    stretches the contract the other way: admit() may return
+    (None, False) — nothing is pushed — and subsequent steps return
+    ([], False) for that slot while its prompt streams in chunk-per-step,
+    INTERLEAVED with everyone else's decode in the same engine step. The
+    first sampled token arrives through step() once the prompt is
+    consumed. The batcher needs no scheduling changes for this: the
+    engine owns the chunk/decode interleave; empty token lists simply
+    push nothing.
+
     One loop thread owns the engine. Requests submitted while the batch is
     full wait in a queue and are admitted the moment a slot retires —
     mid-generation of everyone else (that is the whole point). The
@@ -399,6 +409,8 @@ class ContinuousBatcher:
                           "kv_blocks_cached", "preemptions", "prefix_hits",
                           "kv_block_bytes", "kv_pool_bytes",
                           "kv_cache_dtype", "attention_impl",
+                          "prefill_chunk_tokens", "prefill_chunks",
+                          "chunked_prefills", "prefilling",
                           "spec_k", "spec_steps", "spec_slot_steps",
                           "spec_proposed_tokens", "spec_accepted_tokens",
                           "spec_emitted_tokens", "spec_accept_rate",
@@ -500,7 +512,11 @@ class ContinuousBatcher:
             stream._finish(error=e)
             self._retire(slot)
             return True
-        stream._push(tok)
+        # a chunked-prefill admission (PagedDecodeEngine with
+        # prefill_chunk_tokens) returns no token yet — the prompt streams
+        # in chunk-per-step and the first sampled token arrives via step()
+        if tok is not None:
+            stream._push(tok)
         if done:
             stream._finish()
             self._retire(slot)

@@ -263,6 +263,7 @@ class Replica:
         kv_total = kv_free = preempt = kv_bytes = 0
         spec_k = spec_slot_steps = spec_proposed = 0
         spec_accepted = spec_emitted = 0
+        chunk_tokens = prefilling = chunked_prefills = prefill_chunks = 0
         for v in self._drainables():
             get_stats = getattr(v, "stats", None)
             if get_stats is None:
@@ -302,10 +303,22 @@ class Replica:
             spec_proposed += int(s.get("spec_proposed_tokens", 0))
             spec_accepted += int(s.get("spec_accepted_tokens", 0))
             spec_emitted += int(s.get("spec_emitted_tokens", 0))
+            # chunked prefill: slots mid-prompt right now (load the
+            # controller can see next to slot/block saturation), how many
+            # admissions streamed chunked, and total chunk dispatches
+            chunk_tokens = max(chunk_tokens,
+                               int(s.get("prefill_chunk_tokens", 0)))
+            prefilling += int(s.get("prefilling", 0))
+            chunked_prefills += int(s.get("chunked_prefills", 0))
+            prefill_chunks += int(s.get("prefill_chunks", 0))
         return {"batch_slots": slots, "batch_active": active,
                 "batch_queued": queued, "kv_blocks_total": kv_total,
                 "kv_blocks_free": kv_free, "kv_preemptions": preempt,
                 "kv_pool_bytes": kv_bytes,
+                "prefill_chunk_tokens": chunk_tokens,
+                "prefilling": prefilling,
+                "chunked_prefills": chunked_prefills,
+                "prefill_chunks": prefill_chunks,
                 "spec_k": spec_k,
                 "spec_accept_rate": round(
                     spec_accepted / max(1, spec_proposed), 4),

@@ -203,6 +203,162 @@ def test_partial_merge_equals_full(impl, kw):
     )
 
 
+def _dense_reference_mq(q, kp, vp, tables, positions, kv_len=None):
+    """Multi-query twin of _dense_reference: q [B, Q, H, D], query i of
+    slot b at global position positions[b] + i, keys visible iff
+    kpos <= positions[b] + i AND kpos < kv_len[b]."""
+    b, Q, h, d = q.shape
+    _, bt, kv, _ = kp.shape
+    n_max = tables.shape[1]
+    n_rep = h // kv
+    kw = kp[tables].reshape(b, n_max * bt, kv, d)
+    vw = vp[tables].reshape(b, n_max * bt, kv, d)
+    kr = jnp.repeat(kw, n_rep, axis=2)
+    vr = jnp.repeat(vw, n_rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * (d ** -0.5)
+    live = jnp.repeat(tables > 0, bt, axis=1)
+    qpos = positions[:, None] + jnp.arange(Q)[None, :]
+    mask = (
+        live[:, None, :]
+        & (jnp.arange(n_max * bt)[None, None, :] <= qpos[:, :, None])
+    )
+    if kv_len is not None:
+        mask = mask & (
+            jnp.arange(n_max * bt)[None, None, :] < kv_len[:, None, None]
+        )
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(mask[:, None].any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vr)
+
+
+def _setup_mq(Q=5, b=2, h=4, kv=2, d=16, bt=8, n_pool=12, n_max=5, seed=1):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, Q, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_pool, bt, kv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_pool, bt, kv, d)), jnp.float32)
+    tables = np.zeros((b, n_max), np.int32)
+    # slot 0: prefill-chunk shape — 3 live blocks, queries straddle the
+    # block 1 -> 2 boundary (first query mid-block 1)
+    tables[0, :3] = [3, 7, 9]
+    # slot 1: verify shape — full table, queries at the very tail
+    tables[1, :n_max] = rng.choice(
+        np.arange(1, n_pool), size=n_max, replace=False
+    )
+    positions = jnp.asarray([bt + 3, n_max * bt - Q], jnp.int32)
+    return q, kp, vp, jnp.asarray(tables), positions
+
+
+@pytest.mark.parametrize("impl,kw", [("xla", {}), ("kernel", {"interpret": True})])
+def test_multiquery_matches_reference(impl, kw):
+    """The q-tile grid axis (ISSUE 13): Q=5 queries per slot, causal
+    within the window, one straddling a block boundary — both impls must
+    match the multi-query dense reference."""
+    q, kp, vp, tables, positions = _setup_mq()
+    ref = _dense_reference_mq(q, kp, vp, tables, positions)
+    out = paged_attention(q, kp, vp, tables, positions, impl=impl, **kw)
+    assert pa_mod._LAST_IMPL == impl
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_multiquery_q_tile_padding():
+    """Q not a multiple of block_q: the kernel pads the q axis and the
+    padded rows must be sliced off without touching real outputs."""
+    q, kp, vp, tables, positions = _setup_mq(Q=5)
+    ref = _dense_reference_mq(q, kp, vp, tables, positions)
+    for bq in (1, 2, 4, 16):
+        out = paged_attention(
+            q, kp, vp, tables, positions, impl="kernel", interpret=True,
+            block_q=bq,
+        )
+        assert out.shape == q.shape, bq
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=f"block_q={bq}",
+        )
+
+
+@pytest.mark.parametrize("impl,kw", [("xla", {}), ("kernel", {"interpret": True})])
+def test_multiquery_kv_len_hides_unwritten_span(impl, kw):
+    """Verify semantics: kv_len = positions means the cached window ends
+    strictly BEFORE the first query (its K/V is in-flight, not yet
+    written). Poison every pool position at or past kv_len — outputs must
+    match a reference masked the same way, and must NOT equal the
+    default (kv_len = positions + Q) formulation."""
+    q, kp, vp, tables, positions = _setup_mq()
+    # pin slot 1's table away from the poisoned blocks so the poison hits
+    # ONLY positions the kv_len cap must hide (its own tail block aside)
+    tables = tables.at[1].set(jnp.asarray([1, 2, 4, 5, 6], jnp.int32))
+    kv_len = positions  # strictly before the first query
+    ref = _dense_reference_mq(q, kp, vp, tables, positions, kv_len=kv_len)
+    # poison the span [kv_len, ...) of each slot's own blocks: slot 0's
+    # block 1 (positions 8..15, kv_len=11) + block 2 entirely, and slot
+    # 1's last block past offset 3 (positions 35..39, kv_len=35)
+    kp_p = kp.at[7, 3:].set(1e4).at[9].set(1e4).at[6, 3:].set(1e4)
+    vp_p = vp.at[7, 3:].set(1e4).at[9].set(1e4).at[6, 3:].set(1e4)
+    out = paged_attention(
+        q, kp_p, vp_p, tables, positions, kv_len=kv_len, impl=impl, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+    # sanity: the cap actually excluded something a causal-only mask sees
+    causal = paged_attention(q, kp, vp, tables, positions, impl=impl, **kw)
+    assert not np.allclose(np.asarray(out), np.asarray(causal), atol=1e-3)
+
+
+@pytest.mark.parametrize("impl,kw", [("xla", {}), ("kernel", {"interpret": True})])
+def test_multiquery_partial_merge_equals_full(impl, kw):
+    """Sharded-pool composition for the multi-query path: two pool
+    'shards' with partial_out merge to the full-pool answer — the exact
+    shard_map math fused prefill/verify run under dp/fsdp meshes."""
+    q, kp, vp, tables, positions = _setup_mq()
+    full = paged_attention(q, kp, vp, tables, positions, impl=impl, **kw)
+    half = kp.shape[0] // 2
+    accs, ms, ls = [], [], []
+    for sh in range(2):
+        lo = sh * half
+        local = jnp.where(
+            (tables > 0) & (tables >= lo) & (tables < lo + half),
+            tables - lo, -1,
+        )
+        a, m, l = paged_attention(
+            q, kp[lo:lo + half], vp[lo:lo + half], local, positions,
+            impl=impl, signed_tables=True, partial_out=True, **kw,
+        )
+        accs.append(a), ms.append(m), ls.append(l)
+    merged = merge_partials(jnp.stack(accs), jnp.stack(ms), jnp.stack(ls))
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(full), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_multiquery_int8_both_impls_agree():
+    """int8 dequant-in-kernel on the multi-query path: xla and interpret
+    kernel agree tightly with each other and within quantization
+    tolerance of the fp reference."""
+    q, kp, vp, tables, positions = _setup_mq()
+    ref = _dense_reference_mq(q, kp, vp, tables, positions)
+    k8, ks = _quantize_pool(kp)
+    v8, vs = _quantize_pool(vp)
+    outs = {}
+    for impl, kw in (("xla", {}), ("kernel", {"interpret": True})):
+        outs[impl] = paged_attention(
+            q, k8, v8, tables, positions, k_scale=ks, v_scale=vs,
+            impl=impl, **kw,
+        )
+        np.testing.assert_allclose(
+            np.asarray(outs[impl]), np.asarray(ref), atol=0.05, rtol=0.05,
+            err_msg=impl,
+        )
+    np.testing.assert_allclose(
+        np.asarray(outs["xla"]), np.asarray(outs["kernel"]),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
 def test_validation_errors():
     q, kp, vp, tables, positions = _setup()
     with pytest.raises(ValueError, match="together"):
