@@ -266,6 +266,23 @@ D("serve_model_path", str, "",
 D("serve_model_id", str, "",
   "model id the OpenAI-compatible endpoint advertises in /v1/models and "
   "completion responses; empty = the checkpoint directory's name")
+D("serve_telemetry", bool, True,
+  "serving telemetry plane (serve/telemetry.py): request-lifecycle "
+  "histograms/counters/gauges (TTFT, inter-token latency, queue wait, "
+  "request/error/preemption counters, KV-pool utilization, batch "
+  "occupancy, spec accept rate — tagged by deployment/replica) plus the "
+  "engine flight recorder. Read at engine/batcher construction in the "
+  "replica process; off = zero per-token/per-step telemetry work")
+D("serve_telemetry_recorder_events", int, 4096,
+  "flight-recorder ring capacity: step-level engine events (admit, "
+  "prefill_chunk, decode, verify, rollback, preempt, readmit, retire, "
+  "eos) kept per process, oldest dropped first — the post-mortem window "
+  "behind serve.telemetry.dump_timeline() / `ray_tpu timeline`; 0 "
+  "disables the recorder while keeping the metrics")
+D("serve_telemetry_push_s", float, 5.0,
+  "min interval between a process's flight-recorder pushes to the head "
+  "(piggybacked on replica stats/health polls; drain, engine faults and "
+  "dump_timeline() force an immediate push)")
 D("serve_kv_prefix_cache", bool, True,
   "keep full prompt blocks in a hash-trie after release so identical "
   "prompt prefixes (system prompts, few-shot headers) share physical "

@@ -342,6 +342,11 @@ class Head:
         self.objects.on_free_oid = self._on_object_freed
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
         self.metrics_store: Dict[str, dict] = {}
+        # serve flight-recorder snapshots (serve/telemetry.py): proc key ->
+        # {"ts", "events", "dropped"}. Deliberately NOT pruned at conn
+        # close — a reaped/crashed replica's last events are exactly the
+        # post-mortem this store exists for; bounded by proc count instead.
+        self.serve_events_store: Dict[str, dict] = {}
         # named-channel pubsub (reference: src/ray/pubsub publisher.h:307 /
         # subscriber.h:329; serve's long-poll rides the same channels,
         # serve/_private/long_poll.py:68). Per channel: latest (seq, data)
@@ -2852,6 +2857,49 @@ class Head:
 
     async def _h_get_metrics(self, conn, msg):
         return dict(self.metrics_store)
+
+    async def _h_push_serve_events(self, conn, msg):
+        # pushes are DELTAS (events past the proc's last pushed seq, see
+        # serve/telemetry.py flush_events): append by seq, bounded per
+        # proc — the head's window can outlive the pusher's local ring
+        prev = self.serve_events_store.get(msg["proc"])
+        events = msg.get("events", [])
+        if prev is not None and events:
+            last = prev["events"][-1].get("seq", 0) if prev["events"] else 0
+            fresh = [e for e in events if e.get("seq", 0) > last]
+            if fresh:
+                merged = prev["events"] + fresh
+            else:
+                # seq RESTARTED under a reused proc key (pid reuse, or a
+                # rebuilt recorder): a non-empty batch entirely at-or-
+                # below the stored seq is a new generation — replace, or
+                # the new process's recorder would never reach the head
+                merged = list(events)
+        else:
+            merged = list(events) if events else (
+                prev["events"] if prev is not None else []
+            )
+        self.serve_events_store[msg["proc"]] = {
+            "ts": time.time(),
+            "events": merged[-8192:],
+            "dropped": msg.get("dropped", 0),
+        }
+        # proc-count bound: prefer evicting entries stale for a while
+        # (their post-mortem window has had time to be read); a crashed
+        # replica's FINAL snapshot must not be the first thing churn
+        # evicts, so fresh-but-silent entries go only when nothing stale
+        # remains
+        while len(self.serve_events_store) > 256:
+            now = time.time()
+            stale = [p for p, v in self.serve_events_store.items()
+                     if now - v["ts"] > 900.0]
+            pool = stale or list(self.serve_events_store)
+            oldest = min(pool,
+                         key=lambda p: self.serve_events_store[p]["ts"])
+            del self.serve_events_store[oldest]
+
+    async def _h_get_serve_events(self, conn, msg):
+        return dict(self.serve_events_store)
 
     # ------------------------------------------------------------------
     # scheduling + worker pool

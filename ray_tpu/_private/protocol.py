@@ -250,10 +250,41 @@ class Connection:
                 mtype = msg.get("t")
 
                 async def _watch():
+                    recorded = False
                     while not fut.done():
                         await asyncio.sleep(warn_after_s)
                         if fut.done():
                             return
+                        if not recorded:
+                            # once per orphaned request: the wedge lands in
+                            # the telemetry plane too — a
+                            # data_plane_orphaned_requests_total increment
+                            # (visible at /metrics) and a flight-recorder
+                            # instant, force-flushed so the head holds the
+                            # evidence even if this process hangs next.
+                            # The serve stack is only used when ALREADY
+                            # imported (serving processes): a training/data
+                            # worker's watchdog must not pull the whole
+                            # serve package onto its event loop mid-wedge —
+                            # it still gets the counter via util/metrics.
+                            recorded = True
+                            try:
+                                import sys as _sys
+
+                                tmod = _sys.modules.get(
+                                    "ray_tpu.serve.telemetry")
+                                if tmod is not None:
+                                    tmod.record_orphaned_request(
+                                        mtype, rid, warn_tag or "")
+                                else:
+                                    from ray_tpu.util import metrics as _m
+
+                                    _m.data_plane_orphaned_counter().inc(
+                                        tags={
+                                            "kind": warn_tag or str(mtype)})
+                                    _m.flush()
+                            except Exception:
+                                pass
                         outstanding = sorted(
                             r for r in self._pending if r != rid
                         )

@@ -397,6 +397,9 @@ class Dashboard:
             "cluster": {"t": "cluster_resources"},
             "timeline": {"t": "timeline"},
             "metrics": {"t": "get_metrics"},
+            # serve engine flight recorders (serve/telemetry.py): the raw
+            # per-process event rings replicas push to the head
+            "serve_events": {"t": "get_serve_events"},
             "event_stats": {"t": "event_stats"},
             "pgs": {"t": "pg_table"},
             "node_history": {"t": "node_history"},

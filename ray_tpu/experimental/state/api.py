@@ -78,6 +78,30 @@ def summarize_tasks() -> Dict[str, int]:
     return counts
 
 
+def list_serve_events(
+    filters: Optional[List[Filter]] = None, limit: int = 1000
+) -> List[dict]:
+    """Flat view of the serve engine flight recorders the head holds
+    (serve/telemetry.py): one row per event, newest last, with the owning
+    process as `proc`. Filter like the other listings, e.g.
+    [("name", "=", "preempt")]."""
+    store = _request({"t": "get_serve_events"}) or {}
+    rows: List[dict] = []
+    for proc in sorted(store, key=lambda p: store[p].get("ts", 0.0)):
+        for ev in store[proc].get("events", []):
+            rows.append({"proc": proc, **ev})
+    rows.sort(key=lambda r: r.get("ts", 0.0))
+    return _apply_filters(rows, filters)[-limit:]
+
+
+def summarize_serve_events() -> Dict[str, int]:
+    """Event counts by name across every pushed flight recorder."""
+    counts: Dict[str, int] = {}
+    for ev in list_serve_events(limit=10**9):
+        counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    return counts
+
+
 def profile_worker(
     worker_id: str,
     *,

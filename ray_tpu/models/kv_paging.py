@@ -56,6 +56,7 @@ stats() reads are safe from other threads (plain int reads).
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -273,11 +274,34 @@ class PagedDecodeEngine:
         speculative_k: Optional[int] = None,
         drafter=None,
         prefill_chunk_tokens: Optional[int] = None,
+        telemetry=None,
     ):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu._private.config import GLOBAL_CONFIG as gcfg
+
+        # telemetry plane (serve/telemetry.py): None resolves the process
+        # singleton per the serve_telemetry flag, False disables for this
+        # engine (benches compare on-vs-off), an object is used AS-IS.
+        # The None case only consults serve telemetry when that module is
+        # ALREADY imported (serving processes are — Replica.__init__
+        # loads it before user code builds engines): a bare engine in a
+        # training/bench process must not pull the whole serve package in
+        # at construction, and an injected object can never be dropped by
+        # a serve import fault.
+        if telemetry is None:
+            try:
+                import sys as _sys
+
+                tmod = _sys.modules.get("ray_tpu.serve.telemetry")
+                telemetry = (
+                    tmod.get_telemetry() if tmod is not None else None
+                )
+            except Exception:
+                telemetry = None
+        self._tel = telemetry or None
+        self._rec = self._tel.recorder if self._tel is not None else None
 
         self.cfg = cfg
         self.max_batch_size = int(max_batch_size)
@@ -571,6 +595,9 @@ class PagedDecodeEngine:
         }
         self._preempted.append((slot, parked))
         self.preemptions += 1
+        if self._rec is not None:
+            self._rec.record("preempt", slot=slot,
+                             args={"tokens": len(parked["tokens"])})
         self._release_blocks(slot)
 
     # ----------------------------------------------------------- engine API
@@ -722,6 +749,9 @@ class PagedDecodeEngine:
         if hit_blocks:
             self.prefix_hits += 1
             self.prefix_tokens_reused += p_hit
+        if self._rec is not None:
+            self._rec.record("admit", slot=slot,
+                             args={"prompt": length, "hit_tokens": p_hit})
 
         chunk = self.prefill_chunk_tokens
         if chunk and length - p_hit > chunk:
@@ -754,6 +784,7 @@ class PagedDecodeEngine:
         the straddle edge)."""
         import jax
 
+        t0 = time.monotonic() if self._tel is not None else 0.0
         bt = self.block_tokens
         prompt = self._chunk_state[slot]
         ctx = int(self._positions[slot])
@@ -780,6 +811,14 @@ class PagedDecodeEngine:
         self._positions[slot] = ctx + take
         self.prefill_tokens += take
         self.prefill_chunks += 1
+        if self._tel is not None:
+            dur = time.monotonic() - t0
+            self._tel.observe_phase("prefill", dur)
+            if self._rec is not None:
+                self._rec.record(
+                    "prefill_chunk", slot=slot, dur=dur,
+                    args={"tokens": take, "ctx": ctx, "last": bool(last)},
+                )
         if not last:
             return None
         tok = int(next_tok[0])
@@ -957,6 +996,7 @@ class PagedDecodeEngine:
 
     def _plain_step(self, surviving: List[int]) -> Dict[int, Tuple[int, bool]]:
         bt = self.block_tokens
+        t0 = time.monotonic() if self._tel is not None else 0.0
 
         # resolve this step's block needs (new block at a block boundary,
         # copy-on-write when the write block is shared) under pool pressure
@@ -989,8 +1029,17 @@ class PagedDecodeEngine:
             if hist is not None:
                 hist.append(tok)
             out[s] = (tok, self._done(s, tok))
+            if (self._rec is not None and self.eos_id is not None
+                    and tok == self.eos_id):
+                self._rec.record("eos", slot=s)
         self.decode_steps += 1
         self.tokens_generated += len(surviving)
+        if self._tel is not None:
+            dur = time.monotonic() - t0
+            self._tel.observe_phase("decode", dur)
+            if self._rec is not None:
+                self._rec.record("decode", dur=dur,
+                                 args={"slots": tuple(surviving)})
         return out
 
     # ----------------------------------------------------- speculative path
@@ -1064,6 +1113,7 @@ class PagedDecodeEngine:
         truncating the table — unused blocks go straight back to the
         allocator."""
         bt = self.block_tokens
+        t0 = time.monotonic() if self._tel is not None else 0.0
 
         def _span_blocks(s: int):
             p = int(self._positions[s])
@@ -1146,9 +1196,27 @@ class PagedDecodeEngine:
             self.spec_slot_steps += 1
             self.spec_proposed += int(draft_len[s])
             self.spec_accepted += a
+            if self._rec is not None:
+                if a < int(draft_len[s]):
+                    self._rec.record(
+                        "rollback", slot=s,
+                        args={"rejected": int(draft_len[s]) - a})
+                if (self.eos_id is not None and final
+                        and final[-1] == self.eos_id):
+                    self._rec.record("eos", slot=s)
         self.decode_steps += 1
         self.spec_steps += 1
         self.spec_shapes.add(K1)
+        if self._tel is not None:
+            dur = time.monotonic() - t0
+            self._tel.observe_phase("verify", dur)
+            if self._rec is not None:
+                self._rec.record(
+                    "verify", dur=dur,
+                    args={"slots": tuple(surviving),
+                          "proposed": int(draft_len[list(surviving)].sum()),
+                          "accepted": int(accepted[list(surviving)].sum())},
+                )
         return results
 
     def take_preempted(self) -> List[Tuple[int, Dict[str, Any]]]:
@@ -1162,12 +1230,22 @@ class PagedDecodeEngine:
         """Free a slot's blocks (idempotent; cache-registered blocks stay
         resident under the cache's own reference until evicted)."""
         if self._live[slot]:
+            if self._rec is not None:
+                self._rec.record(
+                    "retire", slot=slot,
+                    args={"tokens": int(self._new_counts[slot])})
             self._release_blocks(slot)
         self._new_counts[slot] = 0
 
     def stats(self) -> Dict[str, Any]:
         used = self.allocator.num_usable - self.allocator.num_free
         return {
+            # flight recorder (serve/telemetry.py): events currently held
+            # in the ring + lifetime total (dropped = total - held)
+            "flight_events": len(self._rec) if self._rec is not None else 0,
+            "flight_events_total": (
+                self._rec.total if self._rec is not None else 0
+            ),
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "prefill_tokens": self.prefill_tokens,

@@ -155,10 +155,29 @@ def cmd_list(obs: _Observer, args) -> None:
 
 
 def cmd_timeline(obs: _Observer, args) -> None:
+    """Chrome-trace dump: head task events + the serve engine flight
+    recorders (replicas push their rings to the head periodically and on
+    drain/fault; `serve.telemetry.dump_timeline()` from a driver forces a
+    fresh push first — the observer takes what the head has)."""
     events = obs.request({"t": "timeline"})
+    n_tasks = len(events)
+    n_serve = 0
+    try:
+        store = obs.request({"t": "get_serve_events"})
+        if store:
+            from ray_tpu.serve.telemetry import to_chrome_trace
+
+            serve_events = to_chrome_trace(
+                {p: e.get("events", []) for p, e in store.items()}
+            )
+            n_serve = len(serve_events)
+            events = list(events) + serve_events
+    except Exception:
+        pass  # older head / serve never used: task timeline alone
     with open(args.output, "w") as f:
         json.dump(events, f)
-    print(f"wrote {len(events)} events to {args.output} (open in chrome://tracing)")
+    print(f"wrote {n_tasks} task + {n_serve} serve-engine events to "
+          f"{args.output} (open in chrome://tracing)")
 
 
 def cmd_profile(obs: _Observer, args) -> None:

@@ -31,6 +31,7 @@ from .http_proxy import (  # noqa: F401
     StreamingResponse,
     sse_stream,
 )
+from . import telemetry  # noqa: F401  (serve.telemetry.dump_timeline etc.)
 from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .openai_api import OpenAICompletions, openai_app  # noqa: F401

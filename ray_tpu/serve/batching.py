@@ -166,28 +166,94 @@ class GenerationStream:
         self.request = request
         self.cut = False        # drain deadline truncated this generation
         self.cancelled = False  # consumer went away
+        # cancel(completed=True): an API layer ended the generation as a
+        # SUCCESS (stop-sequence match, eos decided mid-burst) — the slot
+        # frees like any cancel, but metrics count the request as "ok",
+        # not as a client abort
+        self.cancel_completed = False
         self.preempted = False  # evicted under KV pressure, parked to resume
         self._q: "queue.Queue" = queue.Queue()
         self._finished = threading.Event()
         self._error: Optional[BaseException] = None
         self._drained = False   # END consumed; only the error (if any) left
+        # lifecycle timestamps (monotonic): kept unconditionally (they're
+        # one clock read per token) so API layers can report TTFT even
+        # with the metrics plane off; _tel is set by the owning batcher
+        self.t_submit = time.monotonic()
+        self.t_enqueue = self.t_submit  # re-stamped on preemption re-parks
+        self.t_first: Optional[float] = None
+        self._t_last = self.t_submit
+        self.n_tokens = 0
+        self._tel = None
+        # finalize-once guard is a real lock: close() (caller thread) and
+        # the batcher loop can race _finish on the same stream, and a
+        # check-then-set would double-count request metrics
+        self._finalized = False
+        self._final_lock = threading.Lock()
 
     # -- producer side (batcher loop thread)
 
     def _push(self, token) -> None:
+        now = time.monotonic()
+        tel = self._tel
+        if tel is not None:
+            if self.n_tokens == 0:
+                tel.ttft.observe(now - self.t_submit)
+            else:
+                tel.inter_token.observe(now - self._t_last)
+        if self.n_tokens == 0:
+            self.t_first = now
+        self._t_last = now
+        self.n_tokens += 1
         self._q.put(token)
+
+    def _outcome(self) -> str:
+        if self._error is not None:
+            from .replica import ReplicaDrainingError
+
+            return ("draining" if isinstance(self._error, ReplicaDrainingError)
+                    else "error")
+        if self.cut:
+            return "cut"
+        if self.cancelled and not self.cancel_completed:
+            return "cancelled"
+        return "ok"
 
     def _finish(self, error: Optional[BaseException] = None,
                 cut: bool = False) -> None:
-        self._error = error
-        self.cut = cut or self.cut
+        # FIRST finish wins the terminal state — close()/drain racing the
+        # loop thread's own _finish must neither clear a recorded engine
+        # fault (self._error = None would turn it into a silent clean
+        # cut) nor double-count the request's metrics. State is published
+        # INSIDE the lock and losers return before touching the queue, so
+        # a loser's END can never release the consumer ahead of the
+        # winner's error write.
+        with self._final_lock:
+            if self._finalized:
+                return
+            self._finalized = True
+            self._error = error
+            self.cut = cut or self.cut
+        tel = self._tel
+        if tel is not None:
+            tel.request_latency.observe(time.monotonic() - self.t_submit)
+            tel.requests.inc(tags={"outcome": self._outcome()})
+            if self.n_tokens:
+                # counted at retirement, not per token: one Counter.inc
+                # per request keeps the per-token hot path to exactly
+                # one histogram observe
+                tel.tokens.inc(self.n_tokens)
         self._finished.set()
         self._q.put(self._END)
 
     # -- consumer side
 
-    def cancel(self) -> None:
-        """Consumer gone: the batcher retires the slot at the next step."""
+    def cancel(self, completed: bool = False) -> None:
+        """Consumer gone (or, with completed=True, the API layer closed a
+        SUCCESSFUL generation early — stop match): the batcher retires
+        the slot at the next step."""
+        if completed:
+            self.cancel_completed = True
         self.cancelled = True
 
     @property
@@ -295,9 +361,16 @@ class ContinuousBatcher:
         engine,
         max_batch_size: Optional[int] = None,
         batch_wait_timeout_s: Optional[float] = None,
+        telemetry=None,
     ):
         from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from .telemetry import resolve as _tel_resolve
 
+        # request-lifecycle metrics + flight recorder (serve/telemetry.py):
+        # None = process singleton per the serve_telemetry flag, False =
+        # off for this batcher (zero per-token work)
+        self._tel = _tel_resolve(telemetry)
+        self._rec = self._tel.recorder if self._tel is not None else None
         self.engine = engine
         engine_cap = getattr(engine, "max_batch_size", None)
         self.max_batch_size = int(
@@ -355,6 +428,7 @@ class ContinuousBatcher:
             if self._draining or self._shutdown:
                 raise ReplicaDrainingError()
             stream = GenerationStream(next(self._ids), request)
+            stream._tel = self._tel
             self._pending.put(stream)
         return stream
 
@@ -369,6 +443,9 @@ class ContinuousBatcher:
                 None if deadline_s is None else time.monotonic() + deadline_s
             )
         self._bounce_pending()
+        if self._tel is not None:
+            # drain precedes a reap: persist the post-mortem window now
+            self._tel.flush_events(force=True)
 
     def close(self) -> None:
         """Terminal stop: bounce queued requests AND cut active streams so
@@ -381,6 +458,8 @@ class ContinuousBatcher:
             self._active.clear()
         for stream in active:
             stream._finish(cut=True)
+        if self._tel is not None:
+            self._tel.flush_events(force=True)
 
     def occupancy_log(self) -> List[Tuple[int, int, Tuple[int, ...]]]:
         """[(step, n_active, request_ids active that step), ...]"""
@@ -405,7 +484,8 @@ class ContinuousBatcher:
             except Exception:
                 es = None
             if isinstance(es, dict):
-                for k in ("kv_blocks_total", "kv_blocks_free",
+                for k in ("flight_events", "flight_events_total",
+                          "kv_blocks_total", "kv_blocks_free",
                           "kv_blocks_cached", "preemptions", "prefix_hits",
                           "kv_block_bytes", "kv_pool_bytes",
                           "kv_cache_dtype", "attention_impl",
@@ -493,6 +573,9 @@ class ContinuousBatcher:
         with self._lock:
             slot = self._free.pop()
             self._active[slot] = stream
+        # queue wait ends where ADMISSION STARTS: admit() runs the prefill
+        # (possibly a whole long prompt), which must not read as queue time
+        t_admit = time.monotonic()
         try:
             tok, done = self.engine.admit(slot, request)
         except Exception as e:  # noqa: BLE001 — bad request must not kill the loop
@@ -512,6 +595,14 @@ class ContinuousBatcher:
             stream._finish(error=e)
             self._retire(slot)
             return True
+        if self._tel is not None:
+            self._tel.queue_wait.observe(t_admit - stream.t_enqueue)
+            if self._rec is not None:
+                # rid<->slot correlation for the timeline: the engine's own
+                # "admit" event knows the slot but not the request id
+                self._rec.record(
+                    "readmit" if stream.preempted else "request",
+                    slot=slot, args={"rid": stream.request_id})
         # a chunked-prefill admission (PagedDecodeEngine with
         # prefill_chunk_tokens) returns no token yet — the prompt streams
         # in chunk-per-step and the first sampled token arrives via step()
@@ -601,6 +692,11 @@ class ContinuousBatcher:
                 stream._finish()
                 continue
             stream.preempted = True
+            # queue wait for the READMISSION measures from this re-park,
+            # not the original submit (that span is request latency's job)
+            stream.t_enqueue = time.monotonic()
+            if self._tel is not None:
+                self._tel.preemptions.inc()
             with self._lock:
                 self._holdback.appendleft((stream, parked))
                 self._admission_dirty = True  # blocks freed by the eviction
@@ -650,6 +746,15 @@ class ContinuousBatcher:
             try:
                 results = self.engine.step(slots)
             except Exception as e:  # noqa: BLE001 — engine fault fails the batch
+                if self._tel is not None:
+                    if self._rec is not None:
+                        self._rec.record(
+                            "engine_fault",
+                            args={"error": repr(e)[:200],
+                                  "slots": tuple(slots)})
+                    # a faulting engine is exactly when the post-mortem
+                    # window matters: get it off this process NOW
+                    self._tel.flush_events(force=True)
                 # discard any preemptions staged before the fault: their
                 # streams are errored with everyone else's below, and a
                 # stale parked entry must never hijack the slot's NEXT
@@ -671,6 +776,22 @@ class ContinuousBatcher:
             self._absorb_preempted()
             self._steps += 1
             self._occupancy.append((self._steps, len(slots), ids))
+            if self._tel is not None and self._steps % 8 == 1:
+                # cheap occupancy/pool gauges (attribute reads, no
+                # engine.stats() call — that walks the prefix-cache trie),
+                # refreshed every 8th step: gauge freshness at sub-step
+                # granularity buys nothing, the hot loop's budget does
+                self._tel.occupancy.set(len(slots))
+                alloc = getattr(self.engine, "allocator", None)
+                if alloc is not None:
+                    self._tel.kv_util.set(
+                        (alloc.num_usable - alloc.num_free)
+                        / max(1, alloc.num_usable))
+                if getattr(self.engine, "speculative_k", 0):
+                    self._tel.spec_accept.set(
+                        self.engine.spec_accepted
+                        / max(1, self.engine.spec_proposed))
+                self._tel.flush_events()
             for slot, (tok, done) in results.items():
                 stream = self._active.get(slot)
                 if stream is None:

@@ -268,6 +268,28 @@ class ServeController:
     def get_ingress(self, app_name: str) -> str:
         return self._apps[app_name]["ingress"]
 
+    def flush_telemetry(self) -> int:
+        """Fan-out: every live replica force-pushes its flight recorder +
+        metrics to the head (serve.telemetry.dump_timeline's first step).
+        One shared deadline — a wedged replica costs one bounded wait.
+        Returns the number of replicas reached."""
+        import ray_tpu
+
+        with self._lock:
+            replicas = [
+                r for s in self._deployments.values() for r in s.replicas
+            ]
+        refs = []
+        for r in replicas:
+            try:
+                refs.append(r.flush_telemetry.remote())
+            except Exception:
+                pass
+        if not refs:
+            return 0
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+        return len(ready)
+
     def list_deployments(self) -> Dict[str, dict]:
         return {
             name: {

@@ -243,6 +243,13 @@ class HTTPProxyActor:
         self._pool = ThreadPoolExecutor(
             max_workers=_CALL_POOL_SIZE, thread_name_prefix="ingress-call"
         )
+        # /metrics gets its OWN single thread: a saturated call pool (the
+        # incident) must not make the proxy unobservable — scrapes never
+        # compete with replica calls, and the export's bounded head
+        # round-trip bounds this thread
+        self._scrape_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="metrics-scrape"
+        )
         self._loop = asyncio.new_event_loop()
         started = threading.Event()
 
@@ -469,6 +476,34 @@ class HTTPProxyActor:
         resp = route.handle.remote(*args)
         return resp, resp.result(timeout_s=self.request_timeout_s)
 
+    async def _pool_call(self, fn, timeout: float):
+        """Submit a blocking callable to the call pool with the shared
+        occupancy accounting: _ncalls mirrors POOL-THREAD occupancy, so
+        the slot is released only by the future's done callback — never
+        by the timeout path (a timed-out call's thread keeps blocking,
+        and the saturation cap must keep counting it). The shield means
+        wait_for abandons the WAIT on timeout, not the thread. One
+        helper, so the invariant cannot drift between dispatch sites."""
+        self._ncalls += 1
+        fut = self._loop.run_in_executor(self._pool, fn)
+
+        def _done(f):
+            self._ncalls -= 1
+            if not f.cancelled():
+                f.exception()  # retrieved: a post-timeout error must not warn
+
+        fut.add_done_callback(_done)
+        return await asyncio.wait_for(asyncio.shield(fut), timeout=timeout)
+
+    def _export_metrics(self) -> bytes:
+        """Cluster-wide Prometheus text (runs on the call pool: the merge
+        pulls every process's snapshot from the head over the worker
+        socket). The head round-trip is BOUNDED — a wedged head must cost
+        one failed scrape, never a permanently parked pool thread."""
+        from ray_tpu.util.metrics import export_prometheus
+
+        return export_prometheus(timeout=20.0).encode()
+
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], raw: bytes):
         from .handle import DeploymentUnavailableError
@@ -476,6 +511,27 @@ class HTTPProxyActor:
 
         parts = urlsplit(target)
         path = parts.path.rstrip("/") or "/"
+        if method == "GET" and path == "/metrics":
+            # Prometheus scrape endpoint (reference: the per-node metrics
+            # agent's exposition port). Reserved ahead of route matching —
+            # an app mounted at "/" cannot shadow the scrape — and served
+            # off a DEDICATED thread, outside the call pool and its
+            # saturation gate: the scrape must keep answering during the
+            # very incidents (pool saturation, SSE floods) the metrics
+            # exist to explain. Bounded by the export's own head timeout.
+            fut = self._loop.run_in_executor(
+                self._scrape_pool, self._export_metrics)
+            try:
+                payload = await asyncio.wait_for(fut, timeout=30.0)
+            except Exception as e:  # noqa: BLE001
+                await self._reply(writer, 500, "application/json",
+                                  json.dumps({"error": repr(e)}).encode())
+                return
+            await self._reply(
+                writer, 200,
+                "text/plain; version=0.0.4; charset=utf-8", payload,
+            )
+            return
         route = self._match(path)
         if route is None:
             await self._reply(writer, 404, "application/json",
@@ -506,28 +562,10 @@ class HTTPProxyActor:
                 extra_headers=self._retry_after(),
             )
             return
-        # _ncalls mirrors POOL-THREAD occupancy, not caller waits: a 504'd
-        # request's thread keeps blocking in the replica call, so the slot
-        # is only released by the future's done callback — never by the
-        # timeout path (else saturation undercounts and the cap stops
-        # protecting the pool)
-        self._ncalls += 1
-        fut = self._loop.run_in_executor(
-            self._pool, self._call_route, route, args
-        )
-
-        def _call_done(f):
-            self._ncalls -= 1
-            if not f.cancelled():
-                f.exception()  # retrieved: a post-504 error must not warn
-
-        fut.add_done_callback(_call_done)
         try:
-            # shield: on timeout we abandon the wait, NOT the thread —
-            # wait_for must not try to cancel (and then wait out) a
-            # running executor future
-            dresp, result = await asyncio.wait_for(
-                asyncio.shield(fut), timeout=self.request_timeout_s + 5.0
+            dresp, result = await self._pool_call(
+                lambda: self._call_route(route, args),
+                self.request_timeout_s + 5.0,
             )
         except asyncio.TimeoutError:
             await self._reply(writer, 504, "application/json",
@@ -650,11 +688,6 @@ class HTTPProxyActor:
                 timeout=self.request_timeout_s,
             )
 
-        def _pull_done(f):
-            self._ncalls -= 1
-            if not f.cancelled():
-                f.exception()
-
         while True:
             if replica is None:
                 if not head_written:
@@ -662,14 +695,10 @@ class HTTPProxyActor:
                         writer, 500, "application/json",
                         b'{"error": "stream lost its serving replica"}')
                 return
-            self._ncalls += 1
-            fut = self._loop.run_in_executor(
-                self._pool, _pull, replica, sh.stream_id
-            )
-            fut.add_done_callback(_pull_done)
             try:
-                chunks, done = await asyncio.wait_for(
-                    asyncio.shield(fut), timeout=self.request_timeout_s + 5.0
+                rep, sid = replica, sh.stream_id
+                chunks, done = await self._pool_call(
+                    lambda: _pull(rep, sid), self.request_timeout_s + 5.0
                 )
             except (asyncio.TimeoutError, GetTimeoutError):
                 # GetTimeoutError is the common spelling (the blocking
@@ -700,14 +729,9 @@ class HTTPProxyActor:
                     # submission: the retry call can block a pool thread
                     # for up to request_timeout_s and must be visible to
                     # the saturation gate
-                    self._ncalls += 1
-                    refut = self._loop.run_in_executor(
-                        self._pool, self._call_route, route, args
-                    )
-                    refut.add_done_callback(_pull_done)
-                    dresp, result = await asyncio.wait_for(
-                        asyncio.shield(refut),
-                        timeout=self.request_timeout_s + 5.0,
+                    dresp, result = await self._pool_call(
+                        lambda: self._call_route(route, args),
+                        self.request_timeout_s + 5.0,
                     )
                 except asyncio.TimeoutError:
                     await self._reply(writer, 504, "application/json",

@@ -113,15 +113,35 @@ class _StopBuffer:
 
 
 def _chunk_frame(cid: str, created: int, model: str, text: str,
-                 finish_reason: Optional[str]) -> str:
-    return "data: " + json.dumps({
+                 finish_reason: Optional[str],
+                 extra: Optional[Dict[str, Any]] = None) -> str:
+    frame = {
         "id": cid,
         "object": "text_completion",
         "created": created,
         "model": model,
         "choices": [{"text": text, "index": 0, "logprobs": None,
                      "finish_reason": finish_reason}],
-    }, ensure_ascii=False) + "\n\n"
+    }
+    if extra:
+        frame.update(extra)
+    return "data: " + json.dumps(frame, ensure_ascii=False) + "\n\n"
+
+
+def _timing_block(stream) -> Optional[Dict[str, float]]:
+    """TTFT + total latency off the GenerationStream's lifecycle
+    timestamps (kept even with the metrics plane off) — the per-response
+    twin of the serve_ttft_s histogram, so one request's latency is
+    auditable without a scrape. Extension field, absent from the OpenAI
+    schema; stock clients ignore unknown keys."""
+    t_first = getattr(stream, "t_first", None)
+    t_submit = getattr(stream, "t_submit", None)
+    if t_first is None or t_submit is None:
+        return None
+    return {
+        "ttft_ms": round((t_first - t_submit) * 1000, 2),
+        "latency_ms": round((time.monotonic() - t_submit) * 1000, 2),
+    }
 
 
 class _CompletionSSE:
@@ -136,7 +156,8 @@ class _CompletionSSE:
 
     def __init__(self, stream, tokenizer, eos_id: Optional[int],
                  model_id: str, cid: str, created: int,
-                 stops: List[str], echo_text: str = ""):
+                 stops: List[str], echo_text: str = "",
+                 n_prompt: int = 0):
         self._stream = stream
         self._detok = tokenizer.detokenizer()
         self._eos_id = eos_id
@@ -146,10 +167,13 @@ class _CompletionSSE:
         self._stop = _StopBuffer(stops)
         self._echo_text = echo_text
         self._done_sent = False
+        self._n_prompt = n_prompt
+        self._n_completion = 0
 
-    def _frame(self, text: str, finish: Optional[str] = None) -> str:
+    def _frame(self, text: str, finish: Optional[str] = None,
+               extra: Optional[Dict[str, Any]] = None) -> str:
         return _chunk_frame(self._cid, self._created, self._model, text,
-                            finish)
+                            finish, extra)
 
     def next_batch(self, max_items: int, wait_s: float) -> Tuple[List[str], bool]:
         if self._done_sent:
@@ -165,21 +189,29 @@ class _CompletionSSE:
             out.append(self._frame(self._echo_text))
             self._echo_text = ""
         finish: Optional[str] = None
-        text = ""
+        emit = ""
+        # per-token stop matching: counting must STOP at the token that
+        # completes a stop match (a burst pull — e.g. a speculative
+        # accept — may deliver tokens past it), or the streamed usage
+        # would diverge from the non-stream path's count for the same
+        # request
         for tok in items:
             if self._eos_id is not None and tok == self._eos_id:
                 finish = "stop"
                 break
-            text += self._detok.push(tok)
-        emit = self._stop.push(text)
-        if self._stop.matched:
-            finish = "stop"
+            self._n_completion += 1
+            emit += self._stop.push(self._detok.push(tok))
+            if self._stop.matched:
+                finish = "stop"
+                break
         if emit:
             out.append(self._frame(emit))
         if finish == "stop" and not done:
             # eos/stop decided the end before the engine did (stop match,
-            # or eos arrived mid-burst): stop pulling and free the slot
-            self.cancel()
+            # or eos arrived mid-burst): stop pulling and free the slot —
+            # a SUCCESSFUL completion, so metrics must not count it as a
+            # client abort
+            self._cancel_inner(completed=True)
             done = True
         if done:
             tail = "" if self._stop.matched else (
@@ -187,14 +219,32 @@ class _CompletionSSE:
             )
             if finish is None:
                 finish = ("stop" if self._stop.matched else "length")
-            out.append(self._frame(tail, finish))
+            # the finishing frame carries usage + timing (telemetry in the
+            # response itself): prompt/completion token accounting and the
+            # stream's measured TTFT/total latency
+            extra: Dict[str, Any] = {"usage": {
+                "prompt_tokens": self._n_prompt,
+                "completion_tokens": self._n_completion,
+                "total_tokens": self._n_prompt + self._n_completion,
+            }}
+            timing = _timing_block(self._stream)
+            if timing is not None:
+                extra["timing"] = timing
+            out.append(self._frame(tail, finish, extra))
             out.append("data: [DONE]\n\n")
             self._done_sent = True
         return out, done
 
     def cancel(self) -> None:
+        self._cancel_inner()
+
+    def _cancel_inner(self, completed: bool = False) -> None:
         cancel = getattr(self._stream, "cancel", None)
-        if cancel is not None:
+        if cancel is None:
+            return
+        try:
+            cancel(completed=completed)
+        except TypeError:  # plain iterables' cancel() takes no kwargs
             cancel()
 
 
@@ -380,6 +430,7 @@ class OpenAICompletions:
         sse = _CompletionSSE(
             self._submit(ids, max_tokens), self.bundle.tokenizer,
             self.bundle.eos_id, model_id, cid, created, stop, echo_text,
+            n_prompt=len(ids),
         )
         return StreamingResponse(
             sse, content_type="text/event-stream", buffered=False
@@ -426,7 +477,7 @@ class OpenAICompletions:
                 text += sb.push(detok.push(t))
                 if sb.matched:
                     finish = "stop"
-                    stream.cancel()
+                    stream.cancel(completed=True)
                     break
             if not sb.matched:
                 text += sb.push(detok.flush()) + sb.flush()
@@ -440,7 +491,7 @@ class OpenAICompletions:
                 "finish_reason": finish,
             })
         n_prompt = sum(len(p) for p in prompts)
-        return Response(200, {
+        body = {
             "id": cid,
             "object": "text_completion",
             "created": created,
@@ -451,7 +502,14 @@ class OpenAICompletions:
                 "completion_tokens": n_completion,
                 "total_tokens": n_prompt + n_completion,
             },
-        })
+        }
+        # measured per-request latency next to usage (extension field;
+        # multi-prompt requests report the first stream's TTFT — the
+        # moment the response started producing)
+        timing = _timing_block(streams[0]) if streams else None
+        if timing is not None:
+            body["timing"] = timing
+        return Response(200, body)
 
     # ------------------------------------------------------------- serving
 

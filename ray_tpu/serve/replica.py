@@ -84,6 +84,17 @@ class Replica:
         # sid -> why it was closed early (reaped/cancelled): a later pull
         # must surface the truncation, not fake a clean completion
         self._closed_early: Dict[int, str] = {}
+        # telemetry context BEFORE user __init__: engines/batchers built
+        # there pick up deployment/replica default tags on their metrics
+        # (one replica actor per worker process, so process scope is right)
+        try:
+            from . import telemetry
+
+            telemetry.set_context(
+                deployment=deployment_name, replica=f"pid-{os.getpid()}"
+            )
+        except Exception:
+            pass
         if inspect.isclass(func_or_class):
             self.callable = func_or_class(*init_args, **init_kwargs)
             self.is_function = False
@@ -247,6 +258,9 @@ class Replica:
                 v.drain(deadline_s)
             except Exception:
                 pass
+        # a draining replica is about to be reaped: persist its flight
+        # recorder on the head while the process still exists
+        self.flush_telemetry()
         return ongoing
 
     def num_ongoing(self) -> int:
@@ -335,10 +349,76 @@ class Replica:
             "ts": time.time(),
         }
         out.update(self._batcher_stats())
+        try:
+            from . import telemetry
+
+            tel = telemetry.get_telemetry()
+            if tel is not None and tel.recorder is not None:
+                # fallback only: an engine's own figures (forwarded via
+                # the batcher passthrough) stay authoritative — e.g. an
+                # engine built with telemetry=False must report 0 even
+                # while the process singleton records for others
+                out.setdefault("flight_events", len(tel.recorder))
+                out.setdefault("flight_events_total", tel.recorder.total)
+        except Exception:
+            pass
         return out
+
+    # ------------------------------------------------------------ telemetry
+
+    def flush_telemetry(self) -> bool:
+        """Force-push this replica's flight recorder (and metrics) to the
+        head — dump_timeline()'s fan-out target, also called on drain."""
+        try:
+            from ray_tpu.util import metrics
+
+            from . import telemetry
+
+            telemetry.flush_events(force=True)
+            metrics.flush()
+            # pushes are fire-and-forget on the worker socket: a round trip
+            # behind them barriers delivery, so a dump_timeline() reading
+            # the head right after this fan-out returns sees these events.
+            # BOUNDED: flush_telemetry sits on the drain path, and a
+            # wedged head must not park the replica's reap forever
+            try:
+                from ray_tpu._private.worker import global_worker
+
+                global_worker.request({"t": "ping"}, timeout=10)
+            except Exception:
+                pass
+            return True
+        except Exception:
+            return False
+
+    def dump_flight_recorder(self) -> List[Dict[str, Any]]:
+        """This replica process's flight-recorder snapshot (wall-clock
+        event dicts) — the direct-pull path for tests/debuggers."""
+        try:
+            from . import telemetry
+
+            tel = telemetry.get_telemetry()
+            if tel is not None and tel.recorder is not None:
+                return tel.recorder.snapshot()
+        except Exception:
+            pass
+        return []
 
     def check_health(self) -> bool:
         user_check = getattr(self.callable, "check_health", None)
         if user_check is not None and not self.is_function:
             user_check()
+        # piggyback the throttled telemetry pushes on the controller's
+        # periodic health probe: an idle replica's final observations (a
+        # finished request's counters) and its last N recorder events
+        # reach the head without a dedicated poller
+        try:
+            from ray_tpu.util import metrics
+
+            from . import telemetry
+
+            telemetry.flush_events()
+            metrics.pump()
+        except Exception:
+            pass
         return True

@@ -1,0 +1,398 @@
+"""Serving telemetry plane: request-lifecycle metrics + engine flight
+recorder with Chrome-trace export.
+
+Reference parity: Ray's per-node metrics agent -> Prometheus pipeline
+(python/ray/_private/metrics_agent.py), the Serve request metrics
+(serve/_private/metrics_utils.py: serve_request_latency/ttft/queue-wait
+families), and `ray timeline` (python/ray/_private/profiling.py) — here
+extended down to the DECODE ENGINE: a bounded, lock-cheap ring buffer of
+step-level events (admit, prefill_chunk, decode, verify, rollback,
+preempt, readmit, retire, eos) with monotonic timestamps and slot ids,
+dumpable as Chrome trace-event JSON.
+
+Three layers, all behind the `serve_telemetry` flag:
+
+  ServeTelemetry   per-process singleton bundling the metric handles
+                   (util/metrics.py Counters/Gauges/Histograms, tagged by
+                   deployment/replica[/phase/outcome]) and the flight
+                   recorder. Engines/batchers take it as `telemetry=`;
+                   `False` disables per-instance (zero per-token work),
+                   `None` resolves the process singleton per the flag.
+  FlightRecorder   deque(maxlen) ring of (ts, name, slot, dur, args)
+                   tuples — appends are GIL-atomic, no lock on the hot
+                   path; `snapshot()` converts to wall-clock dicts so
+                   recorders from many processes merge on one axis.
+  dump_timeline()  flush every live replica's recorder to the head
+                   (controller fan-out), pull the merged store, convert
+                   to Chrome trace events (`ph`/`ts`/`pid`/`tid`), write
+                   a chrome://tracing-loadable JSON file. The CLI twin is
+                   `python -m ray_tpu.scripts timeline` (which also
+                   merges the head's task timeline into the same file).
+
+The recorder is ALSO force-pushed by the paths that precede a post-mortem:
+replica drain, batcher close, engine-step faults, and the data-plane
+orphaned-request watchdog (protocol.Connection.request) — so the head
+holds the last `serve_telemetry_recorder_events` events of a wedged
+process even when nobody got to call dump_timeline() in time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# finer-than-default low end: TTFT/inter-token on a warm decode path sit
+# in the 1-50ms band; the default boundaries would dump them into 3 buckets
+LATENCY_BOUNDARIES = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+]
+
+
+class FlightRecorder:
+    """Bounded ring of step-level engine events.
+
+    record() is the hot path: one uncontended lock, one tuple build, one
+    deque append. Oldest events fall off the end — the recorder is a
+    crash/hang post-mortem window, not a complete log. `dur` is seconds
+    and dates the event's START at now-dur, so spans nest correctly in
+    the trace viewer."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._buf: "deque" = deque(maxlen=self.capacity)
+        self.total = 0
+        self._seq_lock = threading.Lock()
+        # monotonic->wall anchor: events are stamped monotonic (immune to
+        # clock steps) and converted once at snapshot so recorders from
+        # different processes merge on one wall-clock axis
+        self._wall_offset = time.time() - time.monotonic()
+
+    def record(self, name: str, slot: int = -1, dur: float = 0.0,
+               args: Optional[Dict[str, Any]] = None) -> None:
+        # total doubles as the event's sequence number, which the delta
+        # push + head merge key on — minting and appending happen under
+        # one (uncontended, ~100ns) lock so two racing recorders (batcher
+        # loop + a watchdog thread) can neither duplicate a seq nor
+        # append out of order, either of which would silently drop an
+        # event from the head's merge
+        with self._seq_lock:
+            self.total += 1
+            self._buf.append(
+                (time.monotonic() - dur, name, slot, dur, args, self.total))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.total - len(self._buf))
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Wall-clock event dicts, oldest first (safe from any thread:
+        list(deque) is atomic)."""
+        off = self._wall_offset
+        return [
+            {"ts": t + off, "name": n, "slot": s, "dur": d, "seq": q,
+             **({"args": a} if a else {})}
+            for t, n, s, d, a, q in list(self._buf)
+        ]
+
+
+class ServeTelemetry:
+    """Metric handles + flight recorder for one process. Handles are
+    registry-backed (util/metrics.py), so two instances with the same
+    metric names share values; `set_context` stamps deployment/replica
+    default tags on everything at replica construction."""
+
+    def __init__(self, recorder_capacity: Optional[int] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        cap = (int(cfg.serve_telemetry_recorder_events)
+               if recorder_capacity is None else int(recorder_capacity))
+        self.recorder = FlightRecorder(cap) if cap > 0 else None
+        base = ("deployment", "replica")
+        self.ttft = Histogram(
+            "serve_ttft_s", "time to first generated token",
+            boundaries=LATENCY_BOUNDARIES, tag_keys=base)
+        self.inter_token = Histogram(
+            "serve_inter_token_latency_s",
+            "gap between consecutive streamed tokens",
+            boundaries=LATENCY_BOUNDARIES, tag_keys=base)
+        self.queue_wait = Histogram(
+            "serve_queue_wait_s",
+            "submit->engine-admission wait (readmissions measure from "
+            "their re-enqueue)",
+            boundaries=LATENCY_BOUNDARIES, tag_keys=base)
+        self.request_latency = Histogram(
+            "serve_request_latency_s", "submit->finish generation latency",
+            boundaries=LATENCY_BOUNDARIES, tag_keys=base)
+        self.engine_step = Histogram(
+            "serve_engine_step_s", "engine dispatch latency by phase",
+            boundaries=LATENCY_BOUNDARIES, tag_keys=base + ("phase",))
+        self.requests = Counter(
+            "serve_requests_total", "finished generations by outcome",
+            tag_keys=base + ("outcome",))
+        self.preemptions = Counter(
+            "serve_preemptions_total",
+            "generations evicted under KV-pool pressure", tag_keys=base)
+        self.tokens = Counter(
+            "serve_tokens_total", "tokens streamed to consumers",
+            tag_keys=base)
+        self.kv_util = Gauge(
+            "serve_kv_pool_utilization",
+            "live fraction of the paged KV block pool", tag_keys=base)
+        self.occupancy = Gauge(
+            "serve_batch_occupancy",
+            "slots active in the last engine step", tag_keys=base)
+        self.spec_accept = Gauge(
+            "serve_spec_accept_rate",
+            "speculative drafts accepted / proposed (cumulative)",
+            tag_keys=base)
+        self._all = [
+            self.ttft, self.inter_token, self.queue_wait,
+            self.request_latency, self.engine_step, self.requests,
+            self.preemptions, self.tokens, self.kv_util, self.occupancy,
+            self.spec_accept,
+        ]
+        self._last_push = 0.0
+        self._last_push_total = -1  # recorder.total at the last push
+        self._rebuild_phase_keys()
+
+    def _rebuild_phase_keys(self) -> None:
+        # precomputed observe keys for the per-step phase histogram: the
+        # engine hot loop must not pay a dict merge + sort per dispatch
+        self._phase_keys = {
+            p: self.engine_step.tags_key({"phase": p})
+            for p in ("prefill", "decode", "verify")
+        }
+
+    def observe_phase(self, phase: str, dur: float) -> None:
+        self.engine_step.observe_key(dur, self._phase_keys[phase])
+
+    def set_context(self, deployment: str = "", replica: str = "") -> None:
+        tags = {}
+        if deployment:
+            tags["deployment"] = deployment
+        if replica:
+            tags["replica"] = replica
+        for m in self._all:
+            m.set_default_tags(tags)
+        self._rebuild_phase_keys()
+
+    # -------------------------------------------------- cross-process push
+
+    def flush_events(self, force: bool = False) -> None:
+        """Throttled DELTA push of the flight-recorder ring to the head
+        (the metrics-push channel's sibling: `push_serve_events`). Must
+        never break the workload. Only events past the last pushed seq go
+        on the wire — a busy replica must not re-serialize its whole
+        4096-event ring every interval, and an idle one (no new events)
+        pushes nothing; the head appends by seq (`_h_push_serve_events`),
+        so already-delivered events survive there past the local ring."""
+        if self.recorder is None or not len(self.recorder):
+            return
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        now = time.monotonic()
+        if not force:
+            if now - self._last_push < float(cfg.serve_telemetry_push_s):
+                return
+            if self.recorder.total == self._last_push_total:
+                return
+        self._last_push = now
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            if global_worker.connected:
+                snap = self.recorder.snapshot()
+                if self._last_push_total > 0:
+                    snap = [e for e in snap
+                            if e["seq"] > self._last_push_total]
+                if not snap:
+                    return
+                node = getattr(global_worker, "node_id", None) or "node"
+                global_worker.send({
+                    "t": "push_serve_events",
+                    "proc": f"{node}:pid-{os.getpid()}",
+                    "events": snap,
+                    "dropped": self.recorder.dropped,
+                })
+                self._last_push_total = snap[-1]["seq"]
+        except Exception:
+            pass
+
+
+_TEL: Optional[ServeTelemetry] = None
+_TEL_FLAG_OFF = False  # singleton was force-built while the flag was off
+_TEL_LOCK = threading.Lock()
+
+
+def get_telemetry(force: bool = False) -> Optional[ServeTelemetry]:
+    """The process singleton; None when `serve_telemetry` is off (pass
+    force=True to build one regardless — benches that compare on vs off).
+    A force-built singleton under a disabled flag stays invisible to
+    non-forced callers: one bench row must not re-enable telemetry for
+    every later telemetry=None engine in the same process."""
+    global _TEL, _TEL_FLAG_OFF
+    if _TEL is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        enabled = bool(cfg.serve_telemetry)
+        if not force and not enabled:
+            return None
+        with _TEL_LOCK:
+            if _TEL is None:
+                _TEL = ServeTelemetry()
+                _TEL_FLAG_OFF = not enabled
+    if _TEL_FLAG_OFF and not force:
+        return None
+    return _TEL
+
+
+def resolve(telemetry) -> Optional[ServeTelemetry]:
+    """The engine/batcher `telemetry=` contract: None -> process singleton
+    per the flag, False -> off for this instance, anything else passes
+    through (tests inject their own)."""
+    if telemetry is None:
+        return get_telemetry()
+    if telemetry is False:
+        return None
+    return telemetry
+
+
+def set_context(deployment: str = "", replica: str = "") -> None:
+    tel = get_telemetry()
+    if tel is not None:
+        tel.set_context(deployment, replica)
+
+
+def flush_events(force: bool = False) -> None:
+    tel = _TEL
+    if tel is not None:
+        tel.flush_events(force=force)
+
+
+def record_orphaned_request(mtype: str, rid: int, tag: str = "") -> None:
+    """Data-plane watchdog hook (protocol.Connection.request): a request
+    with no reply past the warn deadline lands in BOTH planes — the
+    `data_plane_orphaned_requests_total` counter (scrapable at /metrics)
+    and a flight-recorder instant next to whatever the engine was doing —
+    then force-flushes so the head holds the evidence at hang time."""
+    try:
+        from ray_tpu.util import metrics
+
+        metrics.data_plane_orphaned_counter().inc(
+            tags={"kind": tag or str(mtype)})
+        tel = get_telemetry()
+        if tel is not None and tel.recorder is not None:
+            tel.recorder.record(
+                "orphaned_request",
+                args={"mtype": str(mtype), "rid": int(rid), "tag": tag},
+            )
+            tel.flush_events(force=True)
+        metrics.flush()
+    except Exception:
+        pass  # telemetry must never break the data plane
+
+
+# --------------------------------------------------------------------------
+# Chrome trace export
+# --------------------------------------------------------------------------
+
+
+def to_chrome_trace(snapshots: Dict[str, List[Dict[str, Any]]]) -> List[dict]:
+    """Convert per-process flight-recorder snapshots into Chrome
+    trace-event JSON (the `ray timeline` format): pid = process, tid =
+    engine slot, `X` complete events for spans (dur > 0), `i` instants
+    otherwise. Batch-wide events carrying args["slots"] expand to one
+    event per slot so each slot's lane shows its own decode/verify work;
+    slot-LESS events (slot -1, e.g. orphaned_request) render on a
+    dedicated "process-wide" lane (tid -1) so a post-mortem reader never
+    misattributes them to slot 0's request."""
+    out: List[dict] = []
+    for pid, (proc, events) in enumerate(sorted(snapshots.items()), start=1):
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": proc},
+        })
+        proc_lane_named = False
+        for ev in events:
+            args = dict(ev.get("args") or {})
+            slots = args.pop("slots", None)
+            slot = int(ev.get("slot", -1))
+            if slots:
+                tids = [int(s) for s in slots]
+            elif slot >= 0:
+                tids = [slot]
+            else:
+                tids = [-1]
+                if not proc_lane_named:
+                    proc_lane_named = True
+                    out.append({
+                        "name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": -1, "args": {"name": "process-wide"},
+                    })
+            ts_us = float(ev["ts"]) * 1e6
+            dur_s = float(ev.get("dur", 0.0))
+            for tid in tids:
+                e = {
+                    "name": ev["name"], "cat": "serve", "pid": pid,
+                    "tid": tid, "ts": ts_us, "args": args,
+                }
+                if dur_s > 0:
+                    e["ph"] = "X"
+                    e["dur"] = dur_s * 1e6
+                else:
+                    e["ph"] = "i"
+                    e["s"] = "t"
+                out.append(e)
+    return out
+
+
+def dump_timeline(path: Optional[str] = None) -> List[dict]:
+    """Dump the cluster-wide engine flight recorder as Chrome trace
+    events (`ray timeline` parity for the serving plane). Asks every live
+    serve replica to push its recorder to the head first (controller
+    fan-out), then merges the head's store with this process's own
+    recorder. Writes chrome://tracing-loadable JSON when `path` is given;
+    returns the event list either way."""
+    try:
+        import ray_tpu
+        from .handle import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.flush_telemetry.remote(), timeout=15)
+    except Exception:
+        pass  # no controller (engine driven in-process): local-only dump
+    flush_events(force=True)
+    snapshots: Dict[str, List[Dict[str, Any]]] = {}
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker.connected:
+            store = global_worker.request({"t": "get_serve_events"})
+            snapshots = {
+                proc: entry.get("events", [])
+                for proc, entry in (store or {}).items()
+            }
+    except Exception:
+        pass
+    if not snapshots:
+        tel = _TEL
+        if tel is not None and tel.recorder is not None:
+            snapshots = {f"local:pid-{os.getpid()}": tel.recorder.snapshot()}
+    trace = to_chrome_trace(snapshots)
+    if path:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace

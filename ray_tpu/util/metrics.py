@@ -90,12 +90,21 @@ class Metric:
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
+        # hot-path cache: an observe/inc/set with NO call-site tags uses
+        # the precomputed default key directly — no dict merge, no set
+        # difference, no sort per data point (serving observes per token)
+        self._default_key: Tuple[Tuple[str, str], ...] = ()
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
         _REGISTRY.register(self)
 
     def set_default_tags(self, tags: Dict[str, str]):
+        extra = set(tags) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"unknown tag keys {sorted(extra)} for metric {self.name!r}")
         self._default_tags = dict(tags)
+        self._default_key = _tags_key(self._default_tags)
         return self
 
     def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
@@ -125,7 +134,8 @@ class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         if value < 0:
             raise ValueError("Counter.inc value must be >= 0")
-        key = _tags_key(self._merged(tags))
+        key = (self._default_key if tags is None
+               else _tags_key(self._merged(tags)))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
         _REGISTRY.maybe_flush()
@@ -135,7 +145,8 @@ class Gauge(Metric):
     """Last-value gauge (reference: util/metrics.py:290)."""
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tags_key(self._merged(tags))
+        key = (self._default_key if tags is None
+               else _tags_key(self._merged(tags)))
         with self._lock:
             self._values[key] = float(value)
         _REGISTRY.maybe_flush()
@@ -157,7 +168,13 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tags_key(self._merged(tags))
+        key = (self._default_key if tags is None
+               else _tags_key(self._merged(tags)))
+        self.observe_key(value, key)
+
+    def observe_key(self, value: float, key: Tuple[Tuple[str, str], ...]):
+        """Fast path for hot loops: observe under a PRECOMPUTED tags key
+        (see tags_key) — no per-point dict merge/validation/sort."""
         with self._lock:
             ent = self._values.get(key)
             if not isinstance(ent, dict):
@@ -176,10 +193,28 @@ class Histogram(Metric):
             ent["count"] += 1
         _REGISTRY.maybe_flush()
 
+    def tags_key(self, tags: Optional[Dict[str, str]] = None):
+        """Precompute an observe_key key for default tags + `tags`."""
+        return (self._default_key if tags is None
+                else _tags_key(self._merged(tags)))
+
     def _snapshot(self) -> dict:
         snap = super()._snapshot()
         snap["boundaries"] = list(self.boundaries)
         return snap
+
+
+def data_plane_orphaned_counter() -> Counter:
+    """THE definition of data_plane_orphaned_requests_total — shared by
+    the protocol watchdog's serve-free fallback and the serve telemetry
+    plane, so the two registration sites cannot drift (the registry
+    aliases by name and keeps the first description it sees)."""
+    return Counter(
+        "data_plane_orphaned_requests_total",
+        "data-plane requests past the no-reply warn deadline "
+        "(request/reply correlation loss suspects)",
+        tag_keys=("kind",),
+    )
 
 
 def flush():
@@ -187,25 +222,37 @@ def flush():
     _REGISTRY.maybe_flush(force=True)
 
 
+def pump():
+    """Throttled push (the normal observe-time path, callable from
+    periodic pollers): an idle process's LAST observations otherwise sit
+    unpushed until its next metric op — which may never come."""
+    _REGISTRY.maybe_flush()
+
+
+def _escape_tag_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double quote
+    and newline must be escaped or a hostile/unlucky tag value (a model id
+    with a quote, a route with a newline) corrupts the whole scrape."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _fmt_tags(tags: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags]
+    parts = [f'{k}="{_escape_tag_value(v)}"' for k, v in tags]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
-def export_prometheus() -> str:
-    """Render the cluster-wide metric aggregate (all processes) as
-    Prometheus text (reference: metrics_agent.py opencensus->prometheus)."""
-    from .._private.worker import global_worker
-
-    flush()
-    store = global_worker.request({"t": "get_metrics"})
-    # merge: counters/histograms sum across processes; gauges take the most
-    # recent process write (push timestamp order)
+def merge_snapshots(store: Dict[str, dict]) -> Dict[str, dict]:
+    """Merge per-process metric snapshots ({proc: {"ts":, "metrics":}})
+    into one cluster-wide view: counters/histograms SUM across processes;
+    gauges take the most recent process write (push-timestamp order, ties
+    broken by process-name sort so the merge is deterministic)."""
     merged: Dict[str, dict] = {}
     gauge_ts: Dict[Tuple[str, Tuple], float] = {}
-    for proc in sorted(store, key=lambda p: store[p].get("ts", 0.0)):
+    for proc in sorted(store, key=lambda p: (store[p].get("ts", 0.0), p)):
         ts = store[proc].get("ts", 0.0)
         for name, snap in store[proc].get("metrics", {}).items():
             m = merged.setdefault(
@@ -234,6 +281,11 @@ def export_prometheus() -> str:
                     if ts >= gauge_ts.get((name, tags), -1.0):
                         gauge_ts[(name, tags)] = ts
                         m["values"][tags] = v
+    return merged
+
+
+def render_prometheus(merged: Dict[str, dict]) -> str:
+    """Render a merged metric view as Prometheus exposition text."""
     lines = []
     for name, m in sorted(merged.items()):
         if m["description"]:
@@ -254,3 +306,63 @@ def export_prometheus() -> str:
             else:
                 lines.append(f"{name}{_fmt_tags(tags)} {v}")
     return "\n".join(lines) + "\n"
+
+
+def export_prometheus(timeout: Optional[float] = None) -> str:
+    """Render the cluster-wide metric aggregate (all processes) as
+    Prometheus text (reference: metrics_agent.py opencensus->prometheus).
+    `timeout` bounds the head round-trip — callers holding a shared
+    resource (the proxy's call pool) must not park on a wedged head."""
+    from .._private.worker import global_worker
+
+    flush()
+    store = global_worker.request({"t": "get_metrics"}, timeout=timeout)
+    return render_prometheus(merge_snapshots(store))
+
+
+def quantile_from_buckets(
+    boundaries: Sequence[float], buckets: Sequence[int], q: float
+) -> Optional[float]:
+    """Estimate the q-quantile (0..1) from cumulative-free bucket counts by
+    linear interpolation within the containing bucket. Values in the +Inf
+    overflow bucket clamp to the last finite boundary (the histogram holds
+    no better information). Returns None for an empty histogram."""
+    count = sum(buckets)
+    if count <= 0:
+        return None
+    rank = q * count
+    cum = 0.0
+    for i, n in enumerate(buckets):
+        prev = cum
+        cum += n
+        if cum >= rank and n > 0:
+            if i >= len(boundaries):  # overflow bucket
+                return float(boundaries[-1])
+            lo = float(boundaries[i - 1]) if i else 0.0
+            hi = float(boundaries[i])
+            return lo + (hi - lo) * max(0.0, rank - prev) / n
+    return float(boundaries[-1])
+
+
+def local_histogram_quantiles(
+    name: str, qs: Sequence[float], tags: Optional[Dict[str, str]] = None
+) -> Optional[List[Optional[float]]]:
+    """Quantile estimates from THIS process's registry (bench/test helper —
+    no cluster round trip). Aggregates across all tag sets unless `tags`
+    pins one exactly. Returns None when the metric doesn't exist here."""
+    with _REGISTRY.lock:
+        m = _REGISTRY.metrics.get(name)
+    if m is None or not isinstance(m, Histogram):
+        return None
+    # pinning resolves through the metric's own default-tag merge (the
+    # same key construction observe uses) — stored keys include the
+    # defaults set_default_tags stamped, so a raw caller key never would
+    want = m.tags_key(tags) if tags is not None else None
+    agg = [0] * (len(m.boundaries) + 1)
+    with m._lock:
+        for key, ent in m._values.items():
+            if want is not None and key != want:
+                continue
+            if isinstance(ent, dict):
+                agg = [a + b for a, b in zip(agg, ent["buckets"])]
+    return [quantile_from_buckets(m.boundaries, agg, q) for q in qs]

@@ -113,6 +113,7 @@ def ray_start_cluster():
 # failure and the fixture teardown still reaps the cluster. Tune/disable
 # via RAY_TPU_TEST_HANG_TIMEOUT_S (0 = off).
 import signal  # noqa: E402
+import sys  # noqa: E402
 
 _HANG_TIMEOUT_S = int(os.environ.get("RAY_TPU_TEST_HANG_TIMEOUT_S", "300"))
 
@@ -124,6 +125,27 @@ def pytest_runtest_call(item):
         return
 
     def _on_alarm(signum, frame):
+        # serving flight recorder, if this process holds one: the engine's
+        # last step-level events print next to the hang-guard traceback
+        # (ISSUE 14 — the wedge's timeline, not just its stack). NEVER a
+        # fresh import from a signal handler: the hang may be holding an
+        # import lock, and the guard must still fire
+        try:
+            telemetry = sys.modules.get("ray_tpu.serve.telemetry")
+            if telemetry is None:
+                raise LookupError("serve telemetry never imported here")
+            tel = telemetry._TEL
+            if tel is not None and tel.recorder is not None and len(tel.recorder):
+                tail = tel.recorder.snapshot()[-20:]
+                print(
+                    f"[hang-guard] last {len(tail)} flight-recorder events:",
+                    file=sys.stderr,
+                )
+                for ev in tail:
+                    print(f"[hang-guard]   {ev}", file=sys.stderr)
+                tel.flush_events(force=True)
+        except Exception:
+            pass
         raise TimeoutError(
             f"{item.nodeid} exceeded the {_HANG_TIMEOUT_S}s hang guard "
             "(RAY_TPU_TEST_HANG_TIMEOUT_S); the traceback below is where "

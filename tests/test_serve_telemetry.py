@@ -1,0 +1,515 @@
+"""Serving telemetry plane (ISSUE 14): request-lifecycle metrics scraped
+at GET /metrics DURING a live SSE stream, the engine flight recorder
+dumped mid-generation as well-formed Chrome trace JSON, cross-process
+metric aggregation edge cases, and the data-plane orphaned-request
+watchdog landing in both telemetry planes.
+"""
+
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import metrics as umetrics
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class SlowGen:
+    """Paged engine with an artificial per-step delay so a generation is
+    reliably IN FLIGHT while the test scrapes/dumps from outside."""
+
+    def __init__(self, step_sleep_s: float = 0.02):
+        import dataclasses
+
+        from ray_tpu.models import CONFIGS
+        from ray_tpu.models.kv_paging import PagedDecodeEngine
+        from ray_tpu.serve.batching import ContinuousBatcher
+
+        cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=256)
+        eng = PagedDecodeEngine(
+            cfg, max_batch_size=4, seed=0, prefill_buckets=(16,)
+        )
+        orig_step = eng.step
+
+        def slow_step(slots):
+            time.sleep(step_sleep_s)
+            return orig_step(slots)
+
+        eng.step = slow_step
+        self.batcher = ContinuousBatcher(
+            eng, max_batch_size=4, batch_wait_timeout_s=0.05
+        )
+
+    def __call__(self, body):
+        stream = self.batcher.submit(
+            tokens=body["tokens"],
+            max_new_tokens=body.get("max_new_tokens"),
+        )
+        return serve.sse_stream(stream)
+
+
+def _sse_client(host, port, route, body_obj, out, key):
+    s = socket.create_connection((host, int(port)), timeout=120)
+    body = json.dumps(body_obj).encode()
+    s.sendall(
+        f"POST {route} HTTP/1.1\r\nHost: x\r\n".encode()
+        + b"Content-Type: application/json\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+    )
+    buf = b""
+    while True:
+        data = s.recv(65536)
+        if not data:
+            break
+        buf += data
+        if b"0\r\n\r\n" in buf:
+            break
+    s.close()
+    out[key] = buf
+
+
+def _scrape(host, port):
+    c = http.client.HTTPConnection(host, int(port), timeout=30)
+    c.request("GET", "/metrics")
+    r = c.getresponse()
+    body = r.read().decode()
+    c.close()
+    return r.status, body
+
+
+def _metric_value(text, name, **tags):
+    """Sum of the samples of `name` whose label set contains `tags`;
+    None when the metric is absent from the exposition."""
+    total, found = 0.0, False
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        if not (head == name or head.startswith(name + "{")):
+            continue
+        if all(f'{k}="{v}"' in head for k, v in tags.items()):
+            total += float(val)
+            found = True
+    return total if found else None
+
+
+def test_metrics_scrape_during_live_sse(serve_cluster):
+    """Acceptance: GET /metrics answers DURING an in-flight SSE stream
+    with the lifecycle histograms/gauges present, and after the stream
+    the counts reconcile exactly with the stream's own token count."""
+    serve.run(SlowGen.bind(), name="tel", route_prefix="/gen")
+    host, port = serve.proxy_address().split(":")
+
+    n_new = 60
+    outs = {}
+    t = threading.Thread(
+        target=_sse_client,
+        args=(host, port, "/gen", {"tokens": [3] * 8,
+                                   "max_new_tokens": n_new}, outs, 0),
+    )
+    t.start()
+
+    # scrape WHILE the stream is live: poll until the replica's first
+    # pushed snapshot lands, and require the witnessing scrape to have
+    # happened before the client finished
+    live_text = None
+    deadline = time.time() + 60
+    while t.is_alive() and time.time() < deadline:
+        status, text = _scrape(host, port)
+        assert status == 200
+        # the throttled registry flush may push TTFT (observed at the
+        # first token, during admit) one interval before the first
+        # step's gauges: wait for the full family set while still live
+        if (_metric_value(text, "serve_ttft_s_count")
+                and _metric_value(text, "serve_kv_pool_utilization")
+                and _metric_value(text, "serve_queue_wait_s_count")
+                and t.is_alive()):
+            live_text = text
+            break
+        time.sleep(0.1)
+    assert live_text is not None, "no mid-stream scrape saw serve_ttft_s"
+    # the scrape is parseable prometheus text with the plane's families
+    assert "# TYPE serve_ttft_s histogram" in live_text
+    assert _metric_value(live_text, "serve_ttft_s_count") >= 1
+    assert _metric_value(live_text, "serve_queue_wait_s_count") >= 1
+    kv = _metric_value(live_text, "serve_kv_pool_utilization")
+    assert kv is not None and 0.0 < kv <= 1.0
+    assert "serve_inter_token_latency_s_bucket" in live_text
+    # tags thread through: the deployment name rides every family
+    assert 'deployment="SlowGen"' in live_text
+
+    t.join(timeout=120)
+    assert 0 in outs
+    events = [ln for ln in outs[0].split(b"\n") if ln.startswith(b"data: ")]
+    assert events[-1] == b"data: [DONE]"
+    n_tokens = len(events) - 1
+    assert n_tokens == n_new
+
+    # post-stream reconciliation (throttled push: poll to convergence)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        _, text = _scrape(host, port)
+        if _metric_value(text, "serve_requests_total", outcome="ok") == 1.0:
+            break
+        time.sleep(0.2)
+    assert _metric_value(text, "serve_requests_total", outcome="ok") == 1.0
+    assert _metric_value(text, "serve_ttft_s_count") == 1.0
+    # every post-first token observed one inter-token gap
+    assert _metric_value(
+        text, "serve_inter_token_latency_s_count") == n_tokens - 1
+    assert _metric_value(text, "serve_tokens_total") == n_tokens
+    assert _metric_value(text, "serve_queue_wait_s_count") == 1.0
+    assert _metric_value(text, "serve_engine_step_s_count",
+                         phase="decode") >= 1
+    assert _metric_value(text, "serve_batch_occupancy") >= 1.0
+
+
+def test_flight_recorder_dump_mid_generation(serve_cluster, tmp_path):
+    """Acceptance: dump the flight recorder MID-generation; the Chrome
+    trace JSON is well-formed (valid ph/ts/pid/tid) and, once the stream
+    retires, contains the admit -> prefill -> decode -> retire sequence
+    for the known request's slot."""
+    # in-suite, THIS pytest process's singleton recorder holds events from
+    # earlier in-process engine tests; dump_timeline force-pushes the local
+    # ring too, so clear it — the assertions below are about the replica's
+    # generation only
+    tel = serve.telemetry.get_telemetry(force=True)
+    if tel.recorder is not None:
+        tel.recorder.clear()
+
+    serve.run(SlowGen.bind(), name="tel2", route_prefix="/gen2")
+    host, port = serve.proxy_address().split(":")
+
+    outs = {}
+    t = threading.Thread(
+        target=_sse_client,
+        args=(host, port, "/gen2", {"tokens": [5] * 8,
+                                    "max_new_tokens": 80}, outs, 0),
+    )
+    t.start()
+    # wait for the stream to provably start producing, then dump LIVE
+    deadline = time.time() + 60
+    mid = []
+    while t.is_alive() and time.time() < deadline:
+        mid = serve.telemetry.dump_timeline(str(tmp_path / "mid.json"))
+        if any(e.get("name") == "decode" for e in mid) and t.is_alive():
+            break
+        time.sleep(0.1)
+    assert t.is_alive(), "generation finished before the mid-flight dump"
+    with open(tmp_path / "mid.json") as f:
+        on_disk = json.load(f)
+    assert on_disk == mid and len(mid) > 0
+    for e in mid:
+        assert e["ph"] in ("M", "X", "i"), e
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], float) and e["ts"] > 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    names_mid = {e["name"] for e in mid}
+    assert {"admit", "prefill_chunk", "decode"} <= names_mid
+    assert "retire" not in names_mid  # still generating
+
+    t.join(timeout=120)
+    assert b"data: [DONE]" in outs[0]
+    full = serve.telemetry.dump_timeline(str(tmp_path / "full.json"))
+    admits = [e for e in full if e["name"] == "admit"]
+    assert len(admits) == 1
+    slot = admits[0]["tid"]
+    seq = [
+        next(e["ts"] for e in full
+             if e["name"] == name and e["tid"] == slot)
+        for name in ("admit", "prefill_chunk", "decode", "retire")
+    ]
+    assert seq == sorted(seq), seq  # admit -> prefill -> decode -> retire
+
+
+# ------------------------------------------------------------- unit layer
+
+
+def test_engine_flight_recorder_sequence():
+    """Engine-level recorder without a cluster: a generation's slot lane
+    reads admit -> prefill_chunk -> decode* -> retire, preemptions and
+    speculative rollbacks included by name."""
+    import dataclasses
+
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.serve import telemetry
+
+    tel = telemetry.ServeTelemetry(recorder_capacity=512)
+    cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=128)
+    eng = PagedDecodeEngine(cfg, max_batch_size=2, seed=0, telemetry=tel)
+    prompt = np.random.default_rng(0).integers(0, cfg.vocab_size, size=12)
+    tok, done = eng.admit(0, {"tokens": prompt, "max_new_tokens": 6})
+    while not done:
+        (tok, done), = eng.step([0]).values()
+    eng.release(0)
+    names = [e["name"] for e in tel.recorder.snapshot()]
+    assert names[0] == "admit" and names[-1] == "retire"
+    assert "prefill_chunk" in names and names.count("decode") == 5
+    # timestamps are monotonic non-decreasing within the ring
+    ts = [e["ts"] for e in tel.recorder.snapshot()]
+    assert ts == sorted(ts)
+    # ring is bounded: total counts lifetime, len counts held
+    assert tel.recorder.total == len(tel.recorder)
+
+
+def test_flight_recorder_ring_bounds_and_drops():
+    from ray_tpu.serve.telemetry import FlightRecorder
+
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("e", slot=i)
+    assert len(rec) == 8 and rec.total == 20 and rec.dropped == 12
+    slots = [e["slot"] for e in rec.snapshot()]
+    assert slots == list(range(12, 20))  # oldest dropped first
+
+
+def test_chrome_trace_expands_batch_events_per_slot():
+    from ray_tpu.serve.telemetry import to_chrome_trace
+
+    events = [
+        {"ts": 10.0, "name": "decode", "slot": -1, "dur": 0.002,
+         "args": {"slots": (0, 3)}},
+        {"ts": 10.1, "name": "retire", "slot": 3, "dur": 0.0},
+    ]
+    trace = to_chrome_trace({"proc-a": events})
+    decode = [e for e in trace if e["name"] == "decode"]
+    assert sorted(e["tid"] for e in decode) == [0, 3]
+    assert all(e["ph"] == "X" and e["dur"] == pytest.approx(2000.0)
+               for e in decode)
+    retire, = [e for e in trace if e["name"] == "retire"]
+    assert retire["ph"] == "i" and retire["tid"] == 3
+    meta = [e for e in trace if e["ph"] == "M"]
+    assert meta[0]["args"]["name"] == "proc-a"
+
+
+def test_chrome_trace_slotless_events_get_own_lane():
+    """Process-scope events (slot -1, e.g. orphaned_request) must not
+    render inside slot 0's lane — they get a named tid -1 lane."""
+    from ray_tpu.serve.telemetry import to_chrome_trace
+
+    events = [
+        {"ts": 1.0, "name": "decode", "slot": -1, "dur": 0.001,
+         "args": {"slots": (0,)}},
+        {"ts": 2.0, "name": "orphaned_request", "slot": -1, "dur": 0.0,
+         "args": {"rid": 7}},
+    ]
+    trace = to_chrome_trace({"p": events})
+    orphan, = [e for e in trace if e["name"] == "orphaned_request"]
+    assert orphan["tid"] == -1
+    lane, = [e for e in trace if e["ph"] == "M" and e["tid"] == -1]
+    assert lane["args"]["name"] == "process-wide"
+    decode, = [e for e in trace if e["name"] == "decode"]
+    assert decode["tid"] == 0  # batch expansion unaffected
+
+
+def test_telemetry_off_is_per_instance():
+    """telemetry=False disables instrumentation for that engine/batcher
+    without touching the process singleton (the on-vs-off bench contract)."""
+    import dataclasses
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.serve.batching import ContinuousBatcher
+
+    cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=128)
+    eng = PagedDecodeEngine(cfg, max_batch_size=1, seed=0, telemetry=False)
+    assert eng._tel is None and eng._rec is None
+    b = ContinuousBatcher(eng, max_batch_size=1, telemetry=False)
+    try:
+        assert b._tel is None
+        s = b.submit(tokens=[1, 2, 3], max_new_tokens=3)
+        assert len(list(s)) == 3
+        assert s._tel is None and s.n_tokens == 3  # timestamps still kept
+        assert s.t_first is not None
+    finally:
+        b.close()
+
+
+def test_stop_match_cancel_counts_as_ok():
+    """A stop-sequence match ends the generation via cancel(completed=True)
+    — serve_requests_total must count it as outcome=ok, not as a client
+    abort (a plain cancel stays 'cancelled')."""
+    from ray_tpu.serve.batching import GenerationStream
+
+    s = GenerationStream(1, {})
+    s.cancel(completed=True)
+    assert s._outcome() == "ok"
+    s2 = GenerationStream(2, {})
+    s2.cancel()
+    assert s2._outcome() == "cancelled"
+
+
+# --------------------------- util/metrics cross-process aggregation edges
+
+
+def _hist_snap(boundaries, buckets, total, count, tags=()):
+    return {
+        "type": "histogram", "description": "d", "boundaries": boundaries,
+        "values": {tuple(tags): {"buckets": buckets, "sum": total,
+                                 "count": count}},
+    }
+
+
+def test_histogram_bucket_merge_across_pushed_snapshots():
+    """Two processes' pushed snapshots of one histogram merge bucket-wise;
+    a same-name histogram with DIFFERENT boundaries is skipped, not
+    crashed into the export."""
+    tags = (("deployment", "d"),)
+    store = {
+        "proc-a": {"ts": 1.0, "metrics": {
+            "h": _hist_snap([0.1, 1.0], [1, 2, 3], 4.0, 6, tags)}},
+        "proc-b": {"ts": 2.0, "metrics": {
+            "h": _hist_snap([0.1, 1.0], [10, 0, 5], 7.5, 15, tags)}},
+        "proc-clash": {"ts": 3.0, "metrics": {
+            "h": _hist_snap([0.5], [1, 1], 1.0, 2, tags)}},
+    }
+    merged = umetrics.merge_snapshots(store)
+    ent = merged["h"]["values"][tags]
+    assert ent["buckets"] == [11, 2, 8]
+    assert ent["sum"] == pytest.approx(11.5) and ent["count"] == 21
+    text = umetrics.render_prometheus(merged)
+    # cumulative buckets: 11, 13, +Inf = count
+    assert 'h_bucket{deployment="d",le="0.1"} 11' in text
+    assert 'h_bucket{deployment="d",le="1.0"} 13' in text
+    assert 'h_bucket{deployment="d",le="+Inf"} 21' in text
+    assert 'h_count{deployment="d"} 21' in text
+
+
+def test_gauge_last_writer_wins_ordering():
+    """Gauge merge takes the most recent PUSH regardless of dict insertion
+    order; equal timestamps resolve deterministically (proc-name sort)."""
+    def g(v):
+        return {"type": "gauge", "description": "", "values": {(): v}}
+
+    newest_first = {
+        "b-new": {"ts": 9.0, "metrics": {"g": g(42.0)}},
+        "a-old": {"ts": 1.0, "metrics": {"g": g(7.0)}},
+    }
+    oldest_first = {
+        "a-old": {"ts": 1.0, "metrics": {"g": g(7.0)}},
+        "b-new": {"ts": 9.0, "metrics": {"g": g(42.0)}},
+    }
+    for store in (newest_first, oldest_first):
+        assert umetrics.merge_snapshots(store)["g"]["values"][()] == 42.0
+    tie = {
+        "zz": {"ts": 5.0, "metrics": {"g": g(1.0)}},
+        "aa": {"ts": 5.0, "metrics": {"g": g(2.0)}},
+    }
+    # deterministic: the later proc in sort order wins the tie
+    assert umetrics.merge_snapshots(tie)["g"]["values"][()] == 1.0
+
+
+def test_prometheus_tag_value_escaping():
+    """Label values with quotes, backslashes and newlines must render
+    escaped or the scrape is unparseable (previously unescaped)."""
+    hostile = 'he said "hi"\nC:\\path'
+    store = {"p": {"ts": 1.0, "metrics": {
+        "c": {"type": "counter", "description": "",
+              "values": {(("k", hostile),): 3.0}},
+    }}}
+    text = umetrics.render_prometheus(umetrics.merge_snapshots(store))
+    line = next(ln for ln in text.splitlines() if ln.startswith("c{"))
+    assert '\\"hi\\"' in line
+    assert "\\n" in line and "\n" not in line[:-1].replace("\\n", "")
+    assert "C:\\\\path" in line
+    assert line.endswith(" 3.0")
+
+
+def test_histogram_quantile_estimation():
+    from ray_tpu.util.metrics import quantile_from_buckets
+
+    # 100 obs: 50 in (0, 0.1], 49 in (0.1, 1.0], 1 overflow
+    q50 = quantile_from_buckets([0.1, 1.0], [50, 49, 1], 0.5)
+    assert 0.0 < q50 <= 0.1
+    q99 = quantile_from_buckets([0.1, 1.0], [50, 49, 1], 0.99)
+    assert 0.1 < q99 <= 1.0
+    assert quantile_from_buckets([0.1, 1.0], [0, 0, 5], 0.5) == 1.0
+    assert quantile_from_buckets([0.1], [0, 0], 0.5) is None
+
+
+# ------------------------------------- data-plane orphan watchdog satellite
+
+
+def test_orphaned_request_lands_in_metrics_and_recorder(tmp_path):
+    """Satellite (carried data-plane wedge): the Connection.request
+    watchdog's first fire increments data_plane_orphaned_requests_total
+    and lands an 'orphaned_request' flight-recorder event — the next
+    standalone test_repartition_exchange_exact wedge is visible in
+    /metrics and the timeline dump, not just the log."""
+    import asyncio
+
+    from ray_tpu._private import protocol
+    from ray_tpu.serve import telemetry
+
+    tel = telemetry.get_telemetry(force=True)
+    rec_before = (
+        sum(1 for e in tel.recorder.snapshot()
+            if e["name"] == "orphaned_request")
+        if tel.recorder else 0
+    )
+
+    def counter_total():
+        m = umetrics._REGISTRY.metrics.get(
+            "data_plane_orphaned_requests_total")
+        if m is None:
+            return 0.0
+        with m._lock:
+            return sum(m._values.values())
+    before = counter_total()
+
+    async def main():
+        path = os.path.join(str(tmp_path), "sock")
+        hang = asyncio.Event()
+
+        async def server_handler(msg):
+            await hang.wait()  # never replies within the test window
+
+        conns = []
+
+        async def on_client(reader, writer):
+            conns.append(
+                protocol.Connection(reader, writer, server_handler).start()
+            )
+
+        server = await asyncio.start_unix_server(on_client, path=path)
+        reader, writer = await protocol.open_stream(path)
+        conn = protocol.Connection(reader, writer, lambda m: None).start()
+        with pytest.raises(asyncio.TimeoutError):
+            await conn.request(
+                {"t": "get_objects"}, timeout=0.4, warn_after_s=0.05,
+                warn_tag="get_objects for task 'T-wedge' (2 deps)",
+            )
+        hang.set()
+        await conn.close()
+        for c in conns:
+            await c.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
+    assert counter_total() == before + 1.0  # once per orphaned request
+    if tel.recorder is not None:
+        evs = [e for e in tel.recorder.snapshot()
+               if e["name"] == "orphaned_request"]
+        assert len(evs) == rec_before + 1
+        assert evs[-1]["args"]["mtype"] == "get_objects"
+        assert "T-wedge" in evs[-1]["args"]["tag"]
