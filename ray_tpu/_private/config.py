@@ -112,6 +112,18 @@ D("data_plane_request_warn_s", float, 60.0,
   "rids — turns a lost request/reply pair (the standalone "
   "test_repartition_exchange_exact wedge) into a diagnosable log line "
   "next to the test hang-guard's stack dump; 0 disables")
+D("data_plane_request_deadline_s", float, 30.0,
+  "per-attempt reply deadline for retransmit-armed data-plane requests "
+  "(dep-resolution get_objects on the direct task channels): a request "
+  "with no reply after this long is RE-SENT with the same rid and a "
+  "bumped attempt counter (idempotent handlers re-execute; mutating ones "
+  "dedup by rid head-side). Per-attempt waits back off exponentially, "
+  "capped at 8x. 0 disables retransmit (legacy wait-forever behaviour)")
+D("data_plane_request_retries", int, 4,
+  "retransmits allowed per deadline-armed plane request before it "
+  "surfaces PlaneRequestTimeout to the caller (total attempts = 1 + "
+  "retries); dep pulls that exhaust this fall back to head-side task "
+  "routing, which resolves deps on the head instead")
 D("scheduler_spread_threshold", float, 0.5, "hybrid policy: prefer local until this utilization")
 D("log_to_driver", bool, True)
 D("session_dir_root", str, "/tmp/ray_tpu")

@@ -1,0 +1,256 @@
+"""Deterministic fault injection for the control/data plane.
+
+Reference parity: Ray's RAY_testing_* fault-injection hooks
+(src/ray/common/test_utils + the chaos-test NodeKillerActor): faults are
+armed by configuration, deterministic under a seed, and exercised by the
+chaos suite instead of waiting for a flaky standalone repro.
+
+Arming
+------
+Set ``RAY_TPU_FAULTS`` to a comma-separated directive list before the
+cluster starts (spawned workers inherit the environment), or call
+``faults.arm(spec, seed=..., state_dir=...)`` programmatically (covers the
+head + driver, which share the test process). ``RAY_TPU_TEST_FAULT_SEED``
+seeds the controller's RNG for the probabilistic ``rand:<p>`` selector.
+
+Directives
+----------
+  drop_reply:<type>:<sel>    swallow the selected replies to requests of
+                             <type> (the request EXECUTED; only the reply
+                             frame is lost — the lost-get_objects wedge)
+  dup_reply:<type>:<sel>     deliver the selected replies twice
+  delay_send:<type|any>:<s>  delay every matching outbound frame by <s> sec
+  delay_handler:<type>:<s>   delay the head-side handler for <type> by <s>
+  blackhole:<conn|any>       silently drop ALL frames on connections whose
+                             name matches (socket stays open: the peer sees
+                             a hang, not a reset)
+  kill_task:<fn|any>:<sel|once>  SIGKILL this worker process right before
+                             the selected matching task executes; ``once``
+                             fires exactly once across ALL processes via an
+                             O_EXCL marker file (a per-process counter
+                             would also kill the task's retry)
+
+``<sel>`` is a 1-based occurrence number (``1`` = first match) or
+``rand:<p>`` (fire with probability p, seeded). Counters are per-directive
+and process-local.
+
+Zero cost when off: plane hot paths guard every hook behind
+``if faults.ACTIVE:`` — one module-attribute load on the fast path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import threading
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Fast-path flag: hot code does `if faults.ACTIVE:` and never touches the
+# controller when no faults are armed.
+ACTIVE = False
+_CTL: Optional["FaultController"] = None
+
+
+class _Directive:
+    __slots__ = ("kind", "match", "arg", "count")
+
+    def __init__(self, kind: str, match: str, arg: str = ""):
+        self.kind = kind
+        self.match = match
+        self.arg = arg
+        self.count = 0  # matches seen so far (process-local)
+
+    def __repr__(self):
+        return f"<{self.kind}:{self.match}:{self.arg} count={self.count}>"
+
+
+class FaultController:
+    """Parsed fault directives + per-directive match counters."""
+
+    def __init__(self, spec: str, seed: int = 0, state_dir: str = ""):
+        self.spec = spec
+        self.rng = random.Random(seed)
+        # cluster-wide exactly-once markers (kill_task ...:once) live here;
+        # every process of one test run must see the same directory
+        self.state_dir = state_dir or os.environ.get(
+            "RAY_TPU_FAULTS_STATE", "/tmp/ray_tpu_faults"
+        )
+        self._lock = threading.Lock()
+        self.fired: Dict[str, int] = {}
+        self.directives: List[_Directive] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            kind = fields[0]
+            if kind in ("drop_reply", "dup_reply", "delay_send",
+                        "delay_handler", "kill_task"):
+                if len(fields) < 3:
+                    raise ValueError(f"fault directive needs 3 fields: {part!r}")
+                # selector may itself contain ':' (rand:<p>)
+                self.directives.append(
+                    _Directive(kind, fields[1], ":".join(fields[2:]))
+                )
+            elif kind == "blackhole":
+                if len(fields) != 2:
+                    raise ValueError(f"fault directive needs 2 fields: {part!r}")
+                self.directives.append(_Directive(kind, fields[1]))
+            else:
+                raise ValueError(f"unknown fault directive kind: {part!r}")
+
+    # -- selection -------------------------------------------------------
+
+    def _selected(self, d: _Directive) -> bool:
+        """Advance the directive's match counter; True if this occurrence
+        is the one the selector names. Caller holds the lock."""
+        d.count += 1
+        sel = d.arg
+        if sel.startswith("rand:"):
+            return self.rng.random() < float(sel[5:])
+        return d.count == int(sel)
+
+    def _record(self, d: _Directive):
+        key = f"{d.kind}:{d.match}"
+        self.fired[key] = self.fired.get(key, 0) + 1
+        logger.warning("fault injected: %s (occurrence %d)", key, d.count)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.fired)
+
+    # -- hooks (called from protocol.py / head.py / worker_main.py) ------
+
+    def reply_action(self, msg_type) -> Optional[str]:
+        """'drop' / 'dup' / None for a reply to a request of msg_type.
+        EVERY matching directive's occurrence counter advances on every
+        reply (no early return), so `drop_reply:t:1,drop_reply:t:2` means
+        occurrences 1 AND 2 as a human would read it."""
+        action = None
+        with self._lock:
+            for d in self.directives:
+                if d.kind in ("drop_reply", "dup_reply") and d.match == msg_type:
+                    if self._selected(d):
+                        self._record(d)
+                        if action is None:
+                            action = (
+                                "drop" if d.kind == "drop_reply" else "dup"
+                            )
+        return action
+
+    def send_action(self, conn_name: str, msg_type):
+        """'drop' (black-holed), a float delay in seconds, or None."""
+        with self._lock:
+            for d in self.directives:
+                if d.kind == "blackhole" and d.match in ("any", conn_name):
+                    self._record(d)
+                    return "drop"
+            delay = 0.0
+            for d in self.directives:
+                if d.kind == "delay_send" and d.match in ("any", msg_type):
+                    self._record(d)
+                    delay += float(d.arg)
+        return delay or None
+
+    def handler_delay(self, msg_type) -> float:
+        delay = 0.0
+        with self._lock:
+            for d in self.directives:
+                if d.kind == "delay_handler" and d.match == msg_type:
+                    self._record(d)
+                    delay += float(d.arg)
+        return delay
+
+    def before_task(self, fn_name: str) -> None:
+        """SIGKILL this process if a kill_task directive selects this
+        execution. Never returns if it fires."""
+        for d in self.directives:
+            if d.kind != "kill_task" or d.match not in ("any", fn_name):
+                continue
+            if d.arg == "once":
+                # cluster-wide exactly-once: first process to create the
+                # marker wins; the task's RETRY (fresh worker, fresh
+                # counters) must survive
+                try:
+                    os.makedirs(self.state_dir, exist_ok=True)
+                    marker = os.path.join(
+                        self.state_dir, f"killed_{d.kind}_{d.match}"
+                    )
+                    fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                except FileExistsError:
+                    continue
+                except OSError:
+                    continue
+            else:
+                with self._lock:
+                    if not self._selected(d):
+                        continue
+            with self._lock:
+                self._record(d)
+            logger.error(
+                "fault: SIGKILL pid %d before task %r", os.getpid(), fn_name
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def arm(spec: Optional[str] = None, seed: Optional[int] = None,
+        state_dir: str = "") -> Optional[FaultController]:
+    """Arm fault injection. With no args, reads RAY_TPU_FAULTS (no-op when
+    unset). Returns the controller (None if nothing armed)."""
+    global ACTIVE, _CTL
+    if spec is None:
+        spec = os.environ.get("RAY_TPU_FAULTS", "")
+    if not spec.strip():
+        return None
+    if seed is None:
+        seed = int(os.environ.get("RAY_TPU_TEST_FAULT_SEED", "0"))
+    _CTL = FaultController(spec, seed=seed, state_dir=state_dir)
+    ACTIVE = True
+    logger.warning(
+        "fault injection ARMED (pid %d): %s", os.getpid(), _CTL.directives
+    )
+    return _CTL
+
+
+def disarm() -> None:
+    global ACTIVE, _CTL
+    ACTIVE = False
+    _CTL = None
+
+
+def controller() -> Optional[FaultController]:
+    return _CTL
+
+
+# -- thin hook wrappers: safe to call only when ACTIVE is true ------------
+
+
+def reply_action(msg_type) -> Optional[str]:
+    c = _CTL
+    return c.reply_action(msg_type) if c is not None else None
+
+
+def send_action(conn_name: str, msg_type):
+    c = _CTL
+    return c.send_action(conn_name, msg_type) if c is not None else None
+
+
+def handler_delay(msg_type) -> float:
+    c = _CTL
+    return c.handler_delay(msg_type) if c is not None else 0.0
+
+
+def before_task(fn_name: str) -> None:
+    c = _CTL
+    if c is not None:
+        c.before_task(fn_name)
+
+
+# Env arming at import: worker processes import this via protocol.py at
+# startup, so RAY_TPU_FAULTS set before cluster start arms every process.
+arm()

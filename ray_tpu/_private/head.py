@@ -37,7 +37,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from . import protocol
+from . import faults, protocol
 from .config import GLOBAL_CONFIG as cfg
 
 logger = logging.getLogger(__name__)
@@ -208,6 +208,27 @@ class ObjectDirectory:
         self.errors: Dict[str, Any] = {}
         self.on_free = on_free  # called with the envelope when freed
         self.on_free_oid = None  # called with the object id when freed
+        # oids with a wait_available coroutine between entry and wakeup.
+        # Incremented SYNCHRONOUSLY before the first await — unlike
+        # ev._waiters, which only gains the waiter one loop iteration
+        # later (asyncio.wait_for wraps ev.wait() in ensure_future), so
+        # _maybe_free can trust this counter where ev._waiters lies.
+        # The PR-5..PR-10 lost-get_objects wedge lived in exactly that
+        # gap: a transient refcount 0 popped the "waiterless" event, the
+        # producer's put minted+set a NEW event, and the parked handler
+        # then registered on the orphaned old one forever.
+        self._waiting: collections.Counter = collections.Counter()
+        # free generation per oid (bounded breadcrumb): bumped every time a
+        # STORED envelope is actually freed. Lets wait_available distinguish
+        # "not arrived yet" (park) from "freed out from under me" (raise, so
+        # the get_objects handler can reconstruct from lineage or fail
+        # loudly) — without this, the arrived-then-freed refcount interleave
+        # (a consumer's add_refs borrow still in flight when the last
+        # existing ref dropped) parks the getter forever and retransmits
+        # just re-execute into the same void.
+        self.freed_gen: Dict[str, int] = {}
+        self._freed_order: collections.deque = collections.deque()
+        self._freed_cap = 4096
 
     def _event(self, oid: str) -> asyncio.Event:
         ev = self.events.get(oid)
@@ -233,7 +254,40 @@ class ObjectDirectory:
     async def wait_available(self, oid: str, timeout: Optional[float] = None):
         if oid in self.objects:
             return
-        await asyncio.wait_for(self._event(oid).wait(), timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # snapshot the free generation: a bump DURING this wait means the
+        # object existed and was freed under us — parking again would never
+        # end (nothing re-puts a freed object except reconstruction, which
+        # is the caller's job once we raise). Entry-time staleness (freed
+        # long before this wait began) is the caller's to check via
+        # freed_gen — snapshot semantics keep _reconstruct's own
+        # wait_available from insta-raising on the very oid it is reviving.
+        start_gen = self.freed_gen.get(oid, 0)
+        self._waiting[oid] += 1  # BEFORE any await: guards the event entry
+        try:
+            while oid not in self.objects:
+                if self.freed_gen.get(oid, 0) != start_gen:
+                    from ..exceptions import ObjectLostError
+
+                    raise ObjectLostError(oid)
+                ev = self._event(oid)  # re-fetch: identity may have changed
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise asyncio.TimeoutError()
+                await asyncio.wait_for(ev.wait(), remaining)
+                if oid not in self.objects:
+                    # stale wakeup: the envelope was freed/invalidated
+                    # between set and wake — clear so the loop parks again
+                    # instead of spinning (other loop-waiters re-check the
+                    # same way, so clearing a shared event is safe)
+                    ev.clear()
+        finally:
+            self._waiting[oid] -= 1
+            if self._waiting[oid] <= 0:
+                del self._waiting[oid]
 
     def get(self, oid: str):
         return self.objects[oid]
@@ -265,13 +319,31 @@ class ObjectDirectory:
             # mint a NEW event and set that one, stranding the old waiters
             # forever (the direct-path free/put interleave hits this —
             # get_objects parks, a transient count reaches 0, the producer's
-            # put lands after). Keeping the same object means the late put
-            # wakes them.
+            # put lands after). ev._waiters ALONE is not enough: between
+            # wait_available's entry and asyncio.wait_for scheduling the
+            # ev.wait() waiter there is a full loop iteration where the
+            # waiter is invisible — the root cause of the carried
+            # lost-get_objects wedge — so the _waiting counter (bumped
+            # synchronously before the first await) must hold the event
+            # alive through that gap.
             ev = self.events.get(oid)
-            if ev is not None and not ev._waiters:
+            if ev is not None and not ev._waiters and not self._waiting.get(oid):
                 self.events.pop(oid, None)
             self.refcounts.pop(oid, None)
             self.task_pins.pop(oid, None)
+            if env is not None:
+                # a STORED envelope died: leave a bounded breadcrumb so a
+                # parked (or future) getter can tell freed from not-yet-put,
+                # and wake anyone currently parked so they observe the free
+                # (their wait_available raises ObjectLostError and the
+                # get_objects handler takes the reconstruction path)
+                if oid not in self.freed_gen:
+                    self._freed_order.append(oid)
+                    while len(self._freed_order) > self._freed_cap:
+                        self.freed_gen.pop(self._freed_order.popleft(), None)
+                self.freed_gen[oid] = self.freed_gen.get(oid, 0) + 1
+                if ev is not None and (ev._waiters or self._waiting.get(oid)):
+                    ev.set()
             if env is not None and self.on_free is not None:
                 self.on_free(env)
             if self.on_free_oid is not None:
@@ -338,6 +410,13 @@ class Head:
         # only; reference: task_manager.h:164 lineage pinning). Entries die
         # with their object's last reference.
         self.object_lineage: Dict[str, str] = {}
+        # lineage of FREED objects (bounded): when a free retires a lineage
+        # entry, the oid->task mapping moves here so a getter that lost the
+        # refcount race (its add_refs borrow still in flight when the last
+        # ref dropped) can re-run the creating task instead of wedging
+        self._freed_lineage: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
         self._reconstructing: Dict[str, asyncio.Future] = {}
         self.objects.on_free_oid = self._on_object_freed
         # per-process metric snapshots: proc key -> {metric key -> snapshot}
@@ -1017,6 +1096,10 @@ class Head:
         fn = getattr(self, f"_h_{t}", None)
         if fn is None:
             raise ValueError(f"unknown message type {t!r}")
+        if faults.ACTIVE:
+            delay = faults.handler_delay(t)
+            if delay:
+                await asyncio.sleep(delay)
         # per-handler latency/count accounting (reference: event_stats.h
         # instruments the asio loops); total-time includes awaits, so slow
         # entries here mean "long-running", busy_ms means "loop-hogging"
@@ -1216,7 +1299,14 @@ class Head:
     # --- objects ---
 
     def _on_object_freed(self, oid: str, _default=None):
-        self.object_lineage.pop(oid, None)
+        tid = self.object_lineage.pop(oid, None)
+        if tid is not None and tid in self.tasks:
+            # keep a bounded breadcrumb: a late getter revives the object
+            # by re-running this task (stateless lineage only)
+            self._freed_lineage[oid] = tid
+            self._freed_lineage.move_to_end(oid)
+            while len(self._freed_lineage) > 4096:
+                self._freed_lineage.popitem(last=False)
         tid = self._stream_completion.pop(oid, None)
         if tid is not None:
             # the stream's terminal object died: release every yield's
@@ -1348,22 +1438,77 @@ class Head:
         return True
 
     async def _h_get_objects(self, conn, msg):
+        from ..exceptions import ObjectLostError
+
         ids: List[str] = msg["object_ids"]
         timeout = msg.get("timeout")
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for oid in ids:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            try:
-                await self.objects.wait_available(oid, remaining)
-            except asyncio.TimeoutError:
-                from ..exceptions import GetTimeoutError
+            # freed before this get even arrived (e.g. a retransmitted
+            # attempt landing after the refcount race resolved the wrong
+            # way): recover up front — wait_available would park forever
+            if not self.objects.contains(oid) and self.objects.freed_gen.get(oid):
+                await self._recover_freed(oid)
+            for attempt in range(2):
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                try:
+                    await self.objects.wait_available(oid, remaining)
+                    break
+                except asyncio.TimeoutError:
+                    from ..exceptions import GetTimeoutError
 
-                raise GetTimeoutError(
-                    f"Get timed out after {timeout}s waiting for object {oid}"
-                ) from None
+                    raise GetTimeoutError(
+                        f"Get timed out after {timeout}s waiting for object {oid}"
+                    ) from None
+                except ObjectLostError:
+                    # freed while we waited: the last existing ref dropped
+                    # with this getter's borrow still in flight. Re-run the
+                    # creator from lineage (or fail loudly) — never re-park.
+                    if attempt > 0:
+                        raise
+                    await self._recover_freed(oid)
             out.append(self.objects.get(oid))
         return out
+
+    async def _recover_freed(self, oid: str):
+        """A getter raced the free of `oid`: revive it by re-running its
+        creating task (lineage breadcrumb survives the free), or raise
+        ObjectLostError so the caller gets a fast, loud, typed failure
+        instead of an unbounded park. Recoveries count in
+        protocol.PLANE_STATS['freed_object_recoveries']."""
+        from ..exceptions import ObjectLostError
+
+        if oid not in self.object_lineage:
+            tid = self._freed_lineage.get(oid)
+            if tid is None or tid not in self.tasks:
+                logger.warning(
+                    "get_objects hit freed object %s with no lineage to "
+                    "re-run; surfacing ObjectLostError", oid,
+                )
+                raise ObjectLostError(oid)
+            self.object_lineage[oid] = tid
+        logger.warning(
+            "get_objects hit freed object %s (refcount race: a borrow was "
+            "in flight when the last ref dropped); re-running task %s from "
+            "lineage", oid, self.object_lineage[oid],
+        )
+        await self._reconstruct(oid)
+        protocol._stat("freed_object_recoveries")
+
+    async def _wait_dep_available(self, oid: str):
+        """wait_available with the freed-object recovery path: entry-time
+        staleness (freed before this wait began) and mid-wait frees both
+        route through lineage re-execution instead of parking forever."""
+        from ..exceptions import ObjectLostError
+
+        if not self.objects.contains(oid) and self.objects.freed_gen.get(oid):
+            await self._recover_freed(oid)
+        try:
+            await self.objects.wait_available(oid)
+        except ObjectLostError:
+            await self._recover_freed(oid)
+            await self.objects.wait_available(oid)
 
     async def _h_wait_objects(self, conn, msg):
         ids: List[str] = msg["object_ids"]
@@ -1390,7 +1535,12 @@ class Head:
                     if not done:
                         break
                     for fut in done:
-                        ready.append(pending.pop(fut))
+                        oid = pending.pop(fut)
+                        # a waiter can now finish exceptionally (object
+                        # freed mid-wait raises ObjectLostError): a lost
+                        # object is NOT ready — report it as pending
+                        if fut.exception() is None:
+                            ready.append(oid)
             finally:
                 for fut in pending:
                     fut.cancel()
@@ -1593,9 +1743,17 @@ class Head:
         rec.mark("waiting_deps")
         try:
             for oid in rec.spec.get("deps", []):
-                await self.objects.wait_available(oid)
+                await self._wait_dep_available(oid)
         except asyncio.CancelledError:
             return  # _finish_cancel cancelled us; returns already settled
+        except Exception as e:
+            # unrecoverable dep (freed with no lineage): settle the returns
+            # with the typed error — parking here would strand every getter.
+            # Dep pins stay held (a cancel racing this path may unpin via
+            # _finish_cancel; double-unpinning could free live objects)
+            rec.mark("failed")
+            self._fail_task_returns(rec.spec, e)
+            return
         if rec.cancel_requested:
             self._finish_cancel(rec)
             return
@@ -1632,6 +1790,12 @@ class Head:
         self._reconstructing[oid] = fut
         try:
             tid = self.object_lineage.get(oid)
+            if tid is None:
+                # the free retired the live lineage entry; the bounded
+                # breadcrumb (_on_object_freed) may still know the creator
+                tid = self._freed_lineage.get(oid)
+                if tid is not None:
+                    self.object_lineage[oid] = tid
             rec = self.tasks.get(tid or "")
             if rec is None:
                 from ..exceptions import ObjectLostError
@@ -1714,7 +1878,7 @@ class Head:
                 self._release_node(node_id, resources, strategy)
 
         for oid in spec.get("deps", []):
-            await self.objects.wait_available(oid)
+            await self._wait_dep_available(oid)
         node_id = await self._acquire_node(resources, strategy)
         if rec.state == "dead":
             # kill_actor landed during the waits above (worker not yet
@@ -1808,7 +1972,7 @@ class Head:
             rec.send_lock = asyncio.Lock()
         async with rec.send_lock:
             for oid in spec.get("deps", []):
-                await self.objects.wait_available(oid)
+                await self._wait_dep_available(oid)
             w = self.workers.get(rec.worker_id or "")
             if w is None or w.conn is None or w.conn.closed:
                 self._fail_task_returns(spec, ActorDiedError(rec.actor_id, "actor worker gone"))

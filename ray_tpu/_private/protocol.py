@@ -14,11 +14,16 @@ Message = dict with "t" (type). Requests carry "rid"; replies are
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import logging
 import pickle
 import struct
+import threading
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from . import faults
 
 logger = logging.getLogger(__name__)
 
@@ -41,6 +46,74 @@ PROTOCOL_VERSION = 2
 PARKABLE_TYPES = frozenset(
     {"poll_channel", "get_objects", "wait_objects", "pg_ready", "xget_objects"}
 )
+
+# Idempotency contract for retransmit (reference: Ray's task-retry rule —
+# only side-effect-free work re-executes freely). Handlers here only READ
+# state (or park waiting for it), so a retransmitted request simply
+# re-executes; this is also the recovery mechanism for the lost-wakeup
+# wedge, where the ORIGINAL handler may be parked forever on an orphaned
+# event and only a fresh execution can answer. Retransmit-armed requests of
+# any OTHER type are deduplicated by rid on the receiving side instead
+# (see Connection._read_loop): the duplicate is dropped while the original
+# executes, or answered from a bounded reply cache once it finished.
+IDEMPOTENT_TYPES = PARKABLE_TYPES | frozenset(
+    {
+        "ping",
+        "kv_get",
+        "get_actor_route",
+        "list_nodes",
+        "list_actors",
+        "list_tasks",
+        "list_objects",
+        "cluster_resources",
+        "available_resources",
+    }
+)
+
+# Replies kept per connection for rid dedup of retransmit-armed mutating
+# requests; small — only such requests (rare today) land here.
+_REPLY_CACHE_CAP = 512
+
+# Per-attempt waits back off exponentially up to this multiple of the base
+# deadline, so a slow-but-alive peer isn't hammered.
+_BACKOFF_CAP = 8.0
+
+# Process-wide recovery accounting, importable by tests without the metrics
+# stack (the head runs in the driver process, so a test sees head-side
+# increments here too). Mirrored into util/metrics counters when available.
+_STATS_LOCK = threading.Lock()
+PLANE_STATS = {
+    "retries": 0,  # retransmits sent
+    "recovered": 0,  # requests answered only after >= 1 retransmit
+    "duplicate_replies": 0,  # replies whose rid was already answered/abandoned
+    "deadline_timeouts": 0,  # requests that exhausted deadline + retries
+    "dedup_hits": 0,  # receiver-side duplicate requests suppressed
+    # head-side: get_objects hit an already-freed object and the head
+    # re-ran its creating task from lineage instead of parking forever
+    "freed_object_recoveries": 0,
+}
+
+
+def _stat(name: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        PLANE_STATS[name] += n
+
+
+def reset_plane_stats() -> None:
+    """Test hook: zero the counters (they are process-lifetime otherwise)."""
+    with _STATS_LOCK:
+        for k in PLANE_STATS:
+            PLANE_STATS[k] = 0
+
+
+def _metric(counter_fn_name: str, tags: Optional[dict] = None) -> None:
+    """Best-effort mirror into util/metrics; never breaks the plane."""
+    try:
+        from ray_tpu.util import metrics as _m
+
+        getattr(_m, counter_fn_name)().inc(tags=tags)
+    except Exception:
+        pass
 
 
 def check_protocol_version(msg: dict, peer: str) -> None:
@@ -141,13 +214,36 @@ class Connection:
         writer: asyncio.StreamWriter,
         handler: Callable[[dict], Awaitable[Any]],
         on_close: Optional[Callable[[], Awaitable[None]]] = None,
+        name: str = "",
     ):
         self.reader = reader
         self.writer = writer
         self.handler = handler
         self.on_close = on_close
+        # role tag ("head", "worker:<id>", ...): names this connection in
+        # hang dumps and lets fault injection black-hole one link by name
+        self.name = name
         self._rid_counter = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        # retry/attempt state per outstanding rid, for pending_summary()
+        # hang dumps and the warn watchdog
+        self._pending_meta: Dict[int, dict] = {}
+        # correlation lock: registration in request() and the pop in
+        # _read_loop/_close mutate _pending from (potentially) different
+        # threads during shutdown teardowns; a plain dict race here is the
+        # classic way a reply crosses its registration and is dropped as
+        # "unknown rid". All loop-side paths take it too — it is never
+        # contended in steady state, so the cost is one uncontended acquire.
+        self._corr_lock = threading.Lock()
+        # receiver-side rid dedup for retransmit-armed MUTATING requests:
+        # rids whose original dispatch is still executing (duplicates are
+        # dropped — the original will reply), and a bounded cache of
+        # finished replies (duplicates get the cached reply re-sent, the
+        # handler never re-executes)
+        self._dedup_inflight: set = set()
+        self._reply_cache: "collections.OrderedDict[int, tuple]" = (
+            collections.OrderedDict()
+        )
         self._send_lock = asyncio.Lock()
         self._closed = False
         self._reader_task: Optional[asyncio.Task] = None
@@ -174,13 +270,48 @@ class Connection:
                 if codec == CODEC_JSON:
                     self.codec = CODEC_JSON
                 if msg.get("t") == "reply":
-                    fut = self._pending.pop(msg["rid"], None)
-                    if fut is not None and not fut.done():
-                        if msg["ok"]:
-                            fut.set_result(msg.get("value"))
-                        else:
-                            fut.set_exception(msg["error"])
+                    with self._corr_lock:
+                        fut = self._pending.pop(msg["rid"], None)
+                        meta = self._pending_meta.pop(msg["rid"], None)
+                    if fut is None or fut.done():
+                        # duplicate or late reply: the rid was already
+                        # answered (a retransmit raced its original) or
+                        # abandoned (caller timed out). Drop it — the
+                        # request future was completed exactly once — and
+                        # count, so recovery noise stays observable.
+                        _stat("duplicate_replies")
+                        _metric("data_plane_duplicate_replies_counter")
+                        logger.debug(
+                            "dropped duplicate/late reply rid=%s on %s",
+                            msg.get("rid"), self.name or "conn",
+                        )
+                    elif msg["ok"]:
+                        if meta is not None and meta.get("attempt", 0) > 0:
+                            meta["recovered"] = True
+                        fut.set_result(msg.get("value"))
+                    else:
+                        fut.set_exception(msg["error"])
                 else:
+                    rid = msg.get("rid")
+                    if (
+                        rid is not None
+                        and "attempt" in msg
+                        and msg.get("t") not in IDEMPOTENT_TYPES
+                    ):
+                        # retransmit-armed mutating request: execute at
+                        # most once per rid on this connection
+                        if rid in self._dedup_inflight:
+                            _stat("dedup_hits")
+                            continue  # original still executing; it replies
+                        cached = self._reply_cache.get(rid)
+                        if cached is not None:
+                            _stat("dedup_hits")
+                            reply, rcodec = cached
+                            asyncio.get_running_loop().create_task(
+                                self._send_quiet(reply, rcodec)
+                            )
+                            continue
+                        self._dedup_inflight.add(rid)
                     task = asyncio.get_running_loop().create_task(
                         self._dispatch(msg, codec)
                     )
@@ -194,23 +325,53 @@ class Connection:
 
     async def _dispatch(self, msg: dict, codec: str = CODEC_PICKLE):
         rid = msg.get("rid")
+        dedup = (
+            rid is not None
+            and "attempt" in msg
+            and msg.get("t") not in IDEMPOTENT_TYPES
+        )
         try:
-            result = await self.handler(msg)
-            if rid is not None:
-                await self.send(
-                    {"t": "reply", "rid": rid, "ok": True, "value": result}, codec
-                )
-        except Exception as e:  # noqa: BLE001 - errors propagate to the peer
-            if rid is not None:
-                try:
-                    err = repr(e) if codec == CODEC_JSON else e
-                    await self.send(
-                        {"t": "reply", "rid": rid, "ok": False, "error": err}, codec
-                    )
-                except Exception:
-                    pass
+            try:
+                result = await self.handler(msg)
+                reply = {"t": "reply", "rid": rid, "ok": True, "value": result}
+            except Exception as e:  # noqa: BLE001 - errors propagate to the peer
+                if rid is None:
+                    return
+                err = repr(e) if codec == CODEC_JSON else e
+                reply = {"t": "reply", "rid": rid, "ok": False, "error": err}
+            if rid is None:
+                return
+            if dedup:
+                # cache BEFORE any fault/send so a retransmit arriving
+                # after a dropped reply is answered from here — the
+                # mutating handler ran exactly once
+                self._reply_cache[rid] = (reply, codec)
+                while len(self._reply_cache) > _REPLY_CACHE_CAP:
+                    self._reply_cache.popitem(last=False)
+            action = faults.reply_action(msg.get("t")) if faults.ACTIVE else None
+            if action == "drop":
+                return  # simulated lost reply frame; request side must recover
+            await self._send_quiet(reply, codec)
+            if action == "dup":
+                await self._send_quiet(reply, codec)
+        finally:
+            if dedup:
+                self._dedup_inflight.discard(rid)
+
+    async def _send_quiet(self, msg: dict, codec: Optional[str] = None):
+        """send() for replies: the peer vanishing mid-reply is routine."""
+        try:
+            await self.send(msg, codec)
+        except Exception:
+            pass
 
     async def send(self, msg: dict, codec: Optional[str] = None):
+        if faults.ACTIVE:
+            action = faults.send_action(self.name, msg.get("t"))
+            if action == "drop":
+                return  # black-holed link: frame vanishes, socket stays up
+            if action:
+                await asyncio.sleep(float(action))
         async with self._send_lock:
             if self.writer.is_closing():
                 # peer went away between request and reply (e.g. a job
@@ -228,83 +389,204 @@ class Connection:
         timeout: Optional[float] = None,
         warn_after_s: Optional[float] = None,
         warn_tag: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        retries: int = 0,
     ) -> Any:
         """Send `msg` with a fresh monotonic rid and await the correlated
-        reply. `warn_after_s` arms a watchdog that logs LOUDLY (repeating
-        each interval, naming the rid, message type, `warn_tag` and this
-        connection's other outstanding rids) while the reply is missing —
-        semantics are unchanged, but a lost request/reply pair becomes a
-        diagnosable log line next to a hang-guard stack dump instead of a
-        silent wedge."""
+        reply.
+
+        `warn_after_s` arms a watchdog that logs LOUDLY (repeating each
+        interval, naming the rid, message type, `warn_tag` and the
+        retry/attempt state of this connection's other outstanding rids)
+        while the reply is missing.
+
+        `deadline_s` arms retransmit: if no reply lands within the
+        (per-attempt, capped-exponential) deadline, the SAME rid is re-sent
+        with a bumped `attempt` counter, up to `retries` times, then
+        PlaneRequestTimeout surfaces. The rid stays stable across attempts
+        so whichever execution answers first completes the one future;
+        later replies are dropped as duplicates. Handlers in
+        IDEMPOTENT_TYPES re-execute freely (that re-execution IS the
+        recovery when the original parked on a lost wakeup); others are
+        rid-deduplicated on the receiving side. The watchdog and the
+        deadline share this one coroutine's timer — a retransmit never
+        spawns a second warn loop."""
         rid = next(self._rid_counter)
-        msg = dict(msg, rid=rid)
+        base = dict(msg)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._pending[rid] = fut
+        mtype = base.get("t")
+        meta = {
+            "t": mtype,
+            "tag": warn_tag or "",
+            "attempt": 0,
+            "retries": int(retries or 0),
+            "deadline_s": deadline_s,
+            "t0": time.monotonic(),
+            "recovered": False,
+        }
+        with self._corr_lock:
+            self._pending[rid] = fut
+            self._pending_meta[rid] = meta
         watchdog = None
-        # the send itself sits inside the cleanup scope: a failed/cancelled
-        # send must not leak the pending entry or an immortal watchdog
+        # sends sit inside the cleanup scope: a failed/cancelled send must
+        # not leak the pending entry or an immortal watchdog
         try:
             if warn_after_s and warn_after_s > 0:
-                t0 = loop.time()
-                mtype = msg.get("t")
+                watchdog = loop.create_task(
+                    self._warn_watch(rid, fut, meta, warn_after_s)
+                )
+            if not deadline_s or deadline_s <= 0:
+                # legacy wait-forever path (plus optional caller timeout)
+                await self.send(dict(base, rid=rid))
+                return await asyncio.wait_for(fut, timeout)
+            max_attempts = 1 + max(0, int(retries or 0))
+            start = time.monotonic()
+            while True:
+                attempt = meta["attempt"]
+                await self.send(dict(base, rid=rid, attempt=attempt))
+                wait_s = min(deadline_s * (2 ** attempt),
+                             deadline_s * _BACKOFF_CAP)
+                if timeout is not None:
+                    wait_s = min(
+                        wait_s, max(0.0, start + timeout - time.monotonic())
+                    )
+                try:
+                    # shield: a per-attempt timeout must not cancel the
+                    # shared future — a later attempt still awaits it
+                    value = await asyncio.wait_for(
+                        asyncio.shield(fut), wait_s
+                    )
+                except asyncio.TimeoutError:
+                    if fut.done():
+                        value = fut.result()  # reply raced the timer
+                    elif (
+                        timeout is not None
+                        and time.monotonic() - start >= timeout
+                    ):
+                        raise  # caller's overall timeout: legacy contract
+                    elif attempt + 1 >= max_attempts:
+                        _stat("deadline_timeouts")
+                        from ray_tpu.exceptions import PlaneRequestTimeout
 
-                async def _watch():
-                    recorded = False
-                    while not fut.done():
-                        await asyncio.sleep(warn_after_s)
-                        if fut.done():
-                            return
-                        if not recorded:
-                            # once per orphaned request: the wedge lands in
-                            # the telemetry plane too — a
-                            # data_plane_orphaned_requests_total increment
-                            # (visible at /metrics) and a flight-recorder
-                            # instant, force-flushed so the head holds the
-                            # evidence even if this process hangs next.
-                            # The serve stack is only used when ALREADY
-                            # imported (serving processes): a training/data
-                            # worker's watchdog must not pull the whole
-                            # serve package onto its event loop mid-wedge —
-                            # it still gets the counter via util/metrics.
-                            recorded = True
-                            try:
-                                import sys as _sys
-
-                                tmod = _sys.modules.get(
-                                    "ray_tpu.serve.telemetry")
-                                if tmod is not None:
-                                    tmod.record_orphaned_request(
-                                        mtype, rid, warn_tag or "")
-                                else:
-                                    from ray_tpu.util import metrics as _m
-
-                                    _m.data_plane_orphaned_counter().inc(
-                                        tags={
-                                            "kind": warn_tag or str(mtype)})
-                                    _m.flush()
-                            except Exception:
-                                pass
-                        outstanding = sorted(
-                            r for r in self._pending if r != rid
+                        raise PlaneRequestTimeout(
+                            str(mtype), rid, max_attempts,
+                            time.monotonic() - start, warn_tag or "",
                         )
-                        logger.error(
-                            "request t=%r rid=%d%s has no reply after %.0fs "
-                            "(connection %s; %d other outstanding rids: %s)",
+                    else:
+                        meta["attempt"] = attempt + 1
+                        _stat("retries")
+                        _metric(
+                            "data_plane_retries_counter",
+                            tags={"kind": str(mtype)},
+                        )
+                        logger.warning(
+                            "request t=%r rid=%d%s: no reply in %.1fs, "
+                            "retransmitting (attempt %d/%d) on %s",
                             mtype, rid,
                             f" [{warn_tag}]" if warn_tag else "",
-                            loop.time() - t0,
-                            "closed" if self._closed else "open",
-                            len(outstanding), outstanding[:8],
+                            wait_s, attempt + 1, max_attempts - 1,
+                            self.name or "conn",
                         )
-
-                watchdog = loop.create_task(_watch())
-            await self.send(msg)
-            return await asyncio.wait_for(fut, timeout)
+                        continue
+                if meta["attempt"] > 0:
+                    self._record_recovered(mtype, rid, meta)
+                return value
         finally:
             if watchdog is not None:
                 watchdog.cancel()
-            self._pending.pop(rid, None)
+            with self._corr_lock:
+                self._pending.pop(rid, None)
+                self._pending_meta.pop(rid, None)
+
+    def _record_recovered(self, mtype, rid: int, meta: dict) -> None:
+        """A retransmitted request got its answer: recovery is as visible
+        as loss was (counter + flight-recorder event, mirroring the
+        orphaned-request telemetry)."""
+        _stat("recovered")
+        logger.warning(
+            "request t=%r rid=%d recovered after %d retransmit(s) "
+            "(%.1fs total) on %s",
+            mtype, rid, meta["attempt"],
+            time.monotonic() - meta["t0"], self.name or "conn",
+        )
+        try:
+            import sys as _sys
+
+            tmod = _sys.modules.get("ray_tpu.serve.telemetry")
+            if tmod is not None and hasattr(tmod, "record_request_recovered"):
+                tmod.record_request_recovered(mtype, rid, meta["attempt"])
+            else:
+                _metric(
+                    "data_plane_recovered_counter", tags={"kind": str(mtype)}
+                )
+        except Exception:
+            pass
+
+    async def _warn_watch(self, rid, fut, meta, warn_after_s):
+        """One watchdog per request, shared by every retransmit attempt:
+        logs loudly while the reply is missing, lands the first fire in the
+        telemetry plane (data_plane_orphaned_requests_total + a
+        flight-recorder instant). The serve stack is only used when ALREADY
+        imported (serving processes): a training/data worker's watchdog
+        must not pull the whole serve package onto its event loop
+        mid-wedge — it still gets the counter via util/metrics."""
+        recorded = False
+        while not fut.done():
+            await asyncio.sleep(warn_after_s)
+            if fut.done():
+                return
+            if not recorded:
+                recorded = True
+                try:
+                    import sys as _sys
+
+                    tmod = _sys.modules.get("ray_tpu.serve.telemetry")
+                    if tmod is not None:
+                        tmod.record_orphaned_request(
+                            meta["t"], rid, meta["tag"])
+                    else:
+                        from ray_tpu.util import metrics as _m
+
+                        _m.data_plane_orphaned_counter().inc(
+                            tags={"kind": meta["tag"] or str(meta["t"])})
+                        _m.flush()
+                except Exception:
+                    pass
+            others = [
+                s for s in self.pending_summary() if s["rid"] != rid
+            ]
+            logger.error(
+                "request t=%r rid=%d%s has no reply after %.0fs "
+                "(attempt %d/%d, connection %s; %d other outstanding: %s)",
+                meta["t"], rid,
+                f" [{meta['tag']}]" if meta["tag"] else "",
+                time.monotonic() - meta["t0"],
+                meta["attempt"], meta["retries"],
+                "closed" if self._closed else (self.name or "open"),
+                len(others), others[:8],
+            )
+
+    def pending_summary(self):
+        """Retry/attempt state of every outstanding rid — thread-safe, so
+        the test hang guard can dump it from a signal handler."""
+        now = time.monotonic()
+        with self._corr_lock:
+            items = [
+                (r, dict(self._pending_meta.get(r) or {}))
+                for r in self._pending
+            ]
+        return [
+            {
+                "rid": r,
+                "t": m.get("t"),
+                "attempt": m.get("attempt", 0),
+                "retries": m.get("retries", 0),
+                "age_s": round(now - m.get("t0", now), 1),
+                "tag": m.get("tag", ""),
+            }
+            for r, m in sorted(items)
+        ]
 
     async def _close(self):
         if self._closed:
@@ -314,10 +596,15 @@ class Connection:
         for t in list(self._dispatch_tasks):
             if t is not current:  # _close may run inside a dispatch task
                 t.cancel()
-        for fut in self._pending.values():
+        with self._corr_lock:
+            futs = list(self._pending.values())
+            self._pending.clear()
+            self._pending_meta.clear()
+        for fut in futs:
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
-        self._pending.clear()
+        self._dedup_inflight.clear()
+        self._reply_cache.clear()
         try:
             self.writer.close()
         except Exception:

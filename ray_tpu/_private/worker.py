@@ -226,7 +226,9 @@ class _ActorChannel:
         async def handler(msg):
             raise ValueError("unexpected push on direct actor channel")
 
-        self.conn = protocol.Connection(reader, writer, handler)
+        self.conn = protocol.Connection(
+            reader, writer, handler, name=f"actor:{self.actor_id[:8]}"
+        )
         self.conn.start()
         self.direct_addr = addr  # the sync bypass dials the same endpoint
         return True
@@ -378,9 +380,13 @@ class _ActorChannel:
         try:
             loop = asyncio.get_running_loop()
             # the head takes the caller's +1 at submit (the direct path
-            # skipped it; head-path results don't carry it in put_object)
+            # skipped it; head-path results don't carry it in put_object).
+            # Acked: a silently lost submit orphans the call forever
             loop.create_task(_swallow_conn_errors(
-                self.worker.conn.send({"t": "submit_actor_task", "spec": spec})
+                self.worker._acked_push(
+                    {"t": "submit_actor_task", "spec": spec},
+                    what=f"submit_actor_task {spec['task_id'][:8]}",
+                )
             ))
             # release the direct-path dep pins AFTER the submit lands (the
             # handler pins deps synchronously on arrival)
@@ -539,6 +545,19 @@ class _TaskChannel:
         that same capacity."""
         try:
             spec["_resolved"] = await _resolve_spec_deps(self.worker, spec)
+        except exceptions.PlaneRequestTimeout:
+            # the dep pull exhausted its deadline + retransmit budget: the
+            # head connection is unresponsive for this request, but the
+            # head's OWN dep resolution may still work (its handler waits
+            # on local events, no round-trip) — route there instead of
+            # parking the task forever
+            logger.error(
+                "dep pull for task %r exhausted its retransmit budget; "
+                "routing via head", spec.get("task_id"),
+            )
+            self._resolving.discard(spec["task_id"])
+            self._to_head(spec)
+            return
         except Exception:
             logger.exception("dep resolution failed; routing via head")
             self._resolving.discard(spec["task_id"])
@@ -643,7 +662,9 @@ class _TaskChannel:
                 raise ValueError("unexpected push on task lease connection")
 
             reader, writer = await protocol.open_stream(addr)
-            conn = protocol.Connection(reader, writer, handler)
+            conn = protocol.Connection(
+                reader, writer, handler, name=f"lease:{grant['worker_id'][:8]}"
+            )
             conn.start()
             lease = _TaskLease(grant["worker_id"], grant["node_id"], conn)
             lease.last_used = loop.time()
@@ -841,8 +862,14 @@ class _TaskChannel:
         self.worker._release_pending(spec["return_ids"])
         try:
             loop = asyncio.get_running_loop()
+            # acked + retransmitted: a silently lost submit_task frame means
+            # the head never hears of the task — no record, outputs never
+            # materialize, every dependent parks
             loop.create_task(_swallow_conn_errors(
-                self.worker.conn.send({"t": "submit_task", "spec": spec})
+                self.worker._acked_push(
+                    {"t": "submit_task", "spec": spec},
+                    what=f"submit_task {spec['task_id'][:8]}",
+                )
             ))
             loop.create_task(_release_spec_deps(self.worker, spec))
         except Exception:
@@ -933,12 +960,16 @@ async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
     """Resolve dep envelopes for a direct push (local cache first, head
     for the rest) — shared by the actor and task direct channels.
 
-    The head request is instrumented: every request/reply pair on the
-    head connection already carries a monotonic rid, and a reply missing
+    The head request is instrumented AND recoverable: every request/reply
+    pair on the head connection carries a monotonic rid; a reply missing
     past data_plane_request_warn_s logs a loud repeating error naming the
-    orphaned get_objects request (rid, owning task, dep ids) — the known
-    lost-task wedge parks HERE with the head holding every dep, so the
-    hang-guard dump plus this line pinpoints the lost pair."""
+    orphaned get_objects request (rid, owning task, dep ids), and past
+    data_plane_request_deadline_s the request is RETRANSMITTED with the
+    same rid (get_objects is idempotent — the fresh execution answers even
+    if the original handler parked on a lost wakeup, the historical wedge
+    here). Exhausting the retransmit budget surfaces PlaneRequestTimeout,
+    which _resolve_then_requeue converts into head-side routing — the task
+    falls back to the head's own dep resolution instead of vanishing."""
     resolved = {}
     missing = []
     for oid in spec.get("deps", []):
@@ -949,6 +980,7 @@ async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
             missing.append(oid)
     if missing:
         warn_s = float(cfg.data_plane_request_warn_s)
+        deadline_s = float(cfg.data_plane_request_deadline_s)
         envs = await worker.conn.request(
             {"t": "get_objects", "object_ids": missing},
             warn_after_s=warn_s if warn_s > 0 else None,
@@ -957,6 +989,8 @@ async def _resolve_spec_deps(worker: "Worker", spec: dict) -> dict:
                 f"({len(missing)} deps: "
                 f"{[str(o)[:16] for o in missing[:4]]}{'...' if len(missing) > 4 else ''})"
             ),
+            deadline_s=deadline_s if deadline_s > 0 else None,
+            retries=int(cfg.data_plane_request_retries),
         )
         resolved.update(dict(zip(missing, envs)))
     return resolved
@@ -1160,7 +1194,7 @@ class Worker:
         async def handler(msg):
             return await self._handle_push(msg)
 
-        conn = protocol.Connection(reader, writer, handler)
+        conn = protocol.Connection(reader, writer, handler, name="head")
         conn.start()
         return conn
 
@@ -1246,7 +1280,8 @@ class Worker:
             return None
         return reply["seq"], reply["data"]
 
-    def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+    def request(self, msg: dict, timeout: Optional[float] = None,
+                **req_kwargs) -> Any:
         if self._dead_refs:
             self._drain_dead_refs()
         if not self.conn or self.conn.closed:
@@ -1257,7 +1292,66 @@ class Worker:
                 raise exceptions.RayTpuError(
                     "ray_tpu is not connected (call ray_tpu.init())"
                 )
-        return self.io.run(self.conn.request(msg, timeout))
+        return self.io.run(self.conn.request(msg, timeout, **req_kwargs))
+
+    def _fetch_kwargs(self) -> dict:
+        """Retransmit arming for idempotent head fetches (get_objects and
+        friends): a lost reply re-executes the read instead of wedging the
+        sync caller forever."""
+        deadline_s = float(cfg.data_plane_request_deadline_s)
+        if deadline_s <= 0:
+            return {}
+        return {
+            "deadline_s": deadline_s,
+            "retries": int(cfg.data_plane_request_retries),
+        }
+
+    async def _acked_push(self, msg: dict, what: str = "") -> None:
+        """State-bearing push (result envelopes, refcount deltas, task
+        submits) as an ACKED request riding the deadline/retransmit plane.
+        These used to be fire-and-forget sends, and ONE silently lost
+        put_objects frame stranded cluster state: the producer's results
+        never reached the head, so every dependent's get_objects parked
+        forever — the repartition-exchange wedge. The head dedups
+        retransmits by rid (mutating types), so redelivery is safe. Falls
+        back to fire-and-forget when deadlines are disabled."""
+        if self.conn is None or self.conn.closed:
+            return
+        kw = self._fetch_kwargs()
+        what = what or str(msg.get("t"))
+        if not kw:
+            await self.conn.send(msg)
+            return
+        try:
+            await self.conn.request(msg, warn_tag=what, **kw)
+        except exceptions.PlaneRequestTimeout:
+            logger.error(
+                "state push %r exhausted its retransmit budget; head "
+                "state may lag until reconnect", what,
+            )
+            raise
+
+    def plane_pending_summary(self):
+        """Outstanding plane rids across EVERY connection this worker
+        holds — the head conn plus direct task-lease and actor channels
+        (a wedge can park on any of them). Rows carry the connection
+        name; consumed by the tests' hang-guard dump."""
+        out = []
+
+        def _collect(conn):
+            if conn is None or conn.closed:
+                return
+            for row in conn.pending_summary():
+                row["conn"] = conn.name or "?"
+                out.append(row)
+
+        _collect(self.conn)
+        for ch in list(self._task_channels.values()):
+            for lease in list(ch.leases):
+                _collect(lease.conn)
+        for ach in list(self._actor_channels.values()):
+            _collect(ach.conn)
+        return out
 
     def _try_reconnect(self) -> bool:
         if self.io is None:
@@ -1422,15 +1516,24 @@ class Worker:
         try:
             # puts BEFORE records/refs: lineage entries must never point at
             # task records whose results the head hasn't seen, and a remove
-            # must not precede the put carrying the caller's +1
+            # must not precede the put carrying the caller's +1. Acked +
+            # retransmitted: a lost put_objects frame strands every
+            # dependent of these results (the repartition-exchange wedge)
             if puts:
-                await self.conn.send({"t": "put_objects", "objects": puts})
+                await self._acked_push(
+                    {"t": "put_objects", "objects": puts}, what="put_objects"
+                )
             if recs:
-                await self.conn.send({"t": "record_tasks", "records": recs})
+                await self._acked_push(
+                    {"t": "record_tasks", "records": recs}, what="record_tasks"
+                )
             if refs:
-                await self.conn.send({"t": "remove_refs", "counts": refs})
+                await self._acked_push(
+                    {"t": "remove_refs", "counts": refs}, what="remove_refs"
+                )
         except Exception:
-            pass  # conn died; disconnect() settles local waiters
+            pass  # conn died (or budget exhausted, already logged);
+            # disconnect() settles local waiters
 
     # ------------------------------------------------------------------
     # bulk plane: direct node-to-node buffer pulls
@@ -1905,7 +2008,8 @@ class Worker:
                     "t": "get_objects",
                     "object_ids": [ref_list[i].id for i in missing],
                     "timeout": remaining(),
-                }
+                },
+                **self._fetch_kwargs(),
             )
             for i, env in zip(missing, fetched):
                 envs[i] = env
@@ -1928,7 +2032,8 @@ class Worker:
                         raise exceptions.ObjectLostError(ref.id) from None
                     env = self.request(
                         {"t": "get_objects", "object_ids": [ref.id],
-                         "timeout": remaining()}
+                         "timeout": remaining()},
+                        **self._fetch_kwargs(),
                     )[0]
             value = serialization.deserialize(env)
             if getattr(env, "is_error", False):

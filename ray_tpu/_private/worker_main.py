@@ -18,7 +18,7 @@ import threading
 
 import cloudpickle
 
-from . import protocol
+from . import faults, protocol
 from .worker import (
     EventLoopThread,
     Worker,
@@ -89,7 +89,7 @@ class WorkerServer:
     async def run(self):
         self._loop = asyncio.get_running_loop()
         reader, writer = await protocol.open_stream(self.socket_path)
-        self.conn = protocol.Connection(reader, writer, self.handle)
+        self.conn = protocol.Connection(reader, writer, self.handle, name="head")
         self.conn.start()
 
         # Wire the in-process global worker so user task code can call
@@ -136,7 +136,9 @@ class WorkerServer:
             await asyncio.sleep(0.5)
             try:
                 reader, writer = await protocol.open_stream(self.socket_path)
-                conn = protocol.Connection(reader, writer, self.handle)
+                conn = protocol.Connection(
+                    reader, writer, self.handle, name="head"
+                )
                 conn.start()
                 await conn.request(
                     {
@@ -326,6 +328,11 @@ class WorkerServer:
 
         if "actor_id" in msg and msg.get("actor_id"):
             method_name = msg["method"]
+            if faults.ACTIVE:
+                # chaos hook: SIGKILL at the task boundary — after dispatch
+                # (the head believes the task is running) but before user
+                # code, the exact window task retry must cover
+                faults.before_task(method_name)
 
             def _call():
                 global_worker.current_task_id = msg["task_id"]
@@ -349,6 +356,8 @@ class WorkerServer:
                 lambda: self._execute(msg["task_id"], msg["return_ids"], _call),
             )
         fn = await self._fetch_blob("fn", msg["fn_key"], self._fn_cache)
+        if faults.ACTIVE:
+            faults.before_task(getattr(fn, "__name__", "task"))
 
         def _run():
             global_worker.current_task_id = msg["task_id"]

@@ -90,6 +90,33 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class PlaneRequestTimeout(RayTpuError, TimeoutError):
+    """A control/data-plane request exhausted its deadline AND its
+    retransmit budget (data_plane_request_deadline_s x
+    data_plane_request_retries) without a correlated reply. Distinct from
+    GetTimeoutError (the USER's timeout on a value): this one means the
+    plane itself is unresponsive — the connection may be black-holed or the
+    peer wedged — so callers should re-route (serve handles retry the same
+    replica once, then pick another) rather than simply wait longer."""
+
+    def __init__(self, msg_type: str = "", rid: int = 0, attempts: int = 0,
+                 elapsed_s: float = 0.0, tag: str = ""):
+        self.msg_type = msg_type
+        self.rid = rid
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.tag = tag
+        super().__init__(
+            f"plane request t={msg_type!r} rid={rid} got no reply after "
+            f"{attempts} attempt(s) over {elapsed_s:.1f}s"
+            + (f" [{tag}]" if tag else "")
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.msg_type, self.rid, self.attempts,
+                             self.elapsed_s, self.tag))
+
+
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id_hex: str):
         self.object_id_hex = object_id_hex

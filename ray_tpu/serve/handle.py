@@ -175,8 +175,7 @@ class DeploymentResponse:
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
 
-        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
-        from ray_tpu.exceptions import GetTimeoutError
+        from ray_tpu.exceptions import GetTimeoutError, PlaneRequestTimeout
 
         breaker = (
             get_breaker(self._handle.deployment_name)
@@ -205,45 +204,41 @@ class DeploymentResponse:
             if breaker is not None:
                 breaker.release_probe()
             raise
-        except _retryable_errors() as first_exc:
-            # the chosen replica died mid-call or was draining (e.g. torn
-            # down by a redeploy that raced this request): re-route against
-            # a refreshed replica set with spaced, bounded attempts
-            # (reference: the router retries system failures transparently,
-            # serve/_private/router.py — plus backoff so a crash-looping
-            # deployment isn't hammered). The breaker samples the LOGICAL
-            # call once at the end — a transient drain race retried to
-            # success must not march the breaker toward open.
-            if self._handle is None or self._call is None:
+        except PlaneRequestTimeout:
+            # a plane blip, NOT a replica verdict: the data plane lost the
+            # request/reply pair (black-holed link, wedged head handler) —
+            # the replica may well have computed the answer. Retry the SAME
+            # replica once (idempotent re-execution / head-side rid dedup
+            # make the duplicate safe), then fall into the re-route path.
+            # Never feeds the breaker: an unresponsive plane says nothing
+            # about the deployment's health.
+            if breaker is not None:
+                breaker.release_probe()
+            if (self._handle is None or self._call is None
+                    or self.replica is None):
                 raise
             args, kwargs = self._call
-            attempts = max(0, int(cfg.serve_handle_retry_attempts))
-            last_exc = first_exc
-            for attempt in range(attempts):
-                left = _remaining()
-                if left is not None and left <= 0:
-                    break
-                pause = _backoff_s(attempt)
-                time.sleep(pause if left is None else min(pause, left))
+            try:
                 self.retries += 1
-                try:
-                    self._handle._refresh(force=True)
-                    retry = self._handle.remote(*args, **kwargs)
-                    out = ray_tpu.get(retry.ref, timeout=_remaining())
-                    self.replica = retry.replica
+                retry = self.replica.handle_request.remote(
+                    self._handle.method_name, args, kwargs,
+                    model_id=self._handle.multiplexed_model_id,
+                )
+                out = ray_tpu.get(retry, timeout=_remaining())
+                if breaker is not None:
                     breaker.record_success()
-                    return out
-                except GetTimeoutError:
-                    breaker.release_probe()
-                    raise
-                except _retryable_errors() as e:
-                    last_exc = e
-                except DeploymentUnavailableError:
-                    # breaker opened (or replicas gone) while we retried:
-                    # fail fast — the proxy turns this into 503
-                    raise
-            breaker.record_failure()
-            raise last_exc
+                return out
+            except (PlaneRequestTimeout,) + _retryable_errors() as e:
+                # same replica unreachable twice (or genuinely dead): now
+                # re-route like a replica failure
+                return self._reroute(e, breaker, _remaining)
+        except _retryable_errors() as first_exc:
+            # the chosen replica died mid-call or was draining (e.g. torn
+            # down by a redeploy that raced this request): re-route
+            # immediately — death is a verdict, unlike a plane blip above
+            if self._handle is None or self._call is None:
+                raise
+            return self._reroute(first_exc, breaker, _remaining)
         except Exception:
             # the replica answered with a user-code error: the deployment
             # is SERVING — close/feed the breaker as a success so an open
@@ -251,6 +246,52 @@ class DeploymentResponse:
             if breaker is not None:
                 breaker.record_success()
             raise
+
+    def _reroute(self, first_exc, breaker, _remaining):
+        """Re-route the logical call against a refreshed replica set with
+        spaced, bounded attempts (reference: the router retries system
+        failures transparently, serve/_private/router.py — plus backoff so
+        a crash-looping deployment isn't hammered). The breaker samples the
+        LOGICAL call once at the end — a transient drain race retried to
+        success must not march the breaker toward open, and a final failure
+        that is merely a plane timeout releases the probe instead of
+        recording a failure (plane blips never trip the circuit)."""
+        import ray_tpu
+
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.exceptions import GetTimeoutError, PlaneRequestTimeout
+
+        args, kwargs = self._call
+        attempts = max(0, int(cfg.serve_handle_retry_attempts))
+        last_exc = first_exc
+        for attempt in range(attempts):
+            left = _remaining()
+            if left is not None and left <= 0:
+                break
+            pause = _backoff_s(attempt)
+            time.sleep(pause if left is None else min(pause, left))
+            self.retries += 1
+            try:
+                self._handle._refresh(force=True)
+                retry = self._handle.remote(*args, **kwargs)
+                out = ray_tpu.get(retry.ref, timeout=_remaining())
+                self.replica = retry.replica
+                breaker.record_success()
+                return out
+            except GetTimeoutError:
+                breaker.release_probe()
+                raise
+            except (PlaneRequestTimeout,) + _retryable_errors() as e:
+                last_exc = e
+            except DeploymentUnavailableError:
+                # breaker opened (or replicas gone) while we retried:
+                # fail fast — the proxy turns this into 503
+                raise
+        if isinstance(last_exc, PlaneRequestTimeout):
+            breaker.release_probe()
+        else:
+            breaker.record_failure()
+        raise last_exc
 
     @property
     def ref(self):

@@ -305,6 +305,29 @@ def record_orphaned_request(mtype: str, rid: int, tag: str = "") -> None:
         pass  # telemetry must never break the data plane
 
 
+def record_request_recovered(mtype: str, rid: int, attempts: int) -> None:
+    """The self-healing counterpart of record_orphaned_request: a
+    retransmitted plane request got its reply. Lands in
+    `data_plane_requests_recovered_total` and as a `request_recovered`
+    flight-recorder instant, so recovery is as visible in the timeline as
+    loss was."""
+    try:
+        from ray_tpu.util import metrics
+
+        metrics.data_plane_recovered_counter().inc(tags={"kind": str(mtype)})
+        tel = get_telemetry()
+        if tel is not None and tel.recorder is not None:
+            tel.recorder.record(
+                "request_recovered",
+                args={"mtype": str(mtype), "rid": int(rid),
+                      "attempts": int(attempts)},
+            )
+            tel.flush_events(force=True)
+        metrics.flush()
+    except Exception:
+        pass  # telemetry must never break the data plane
+
+
 # --------------------------------------------------------------------------
 # Chrome trace export
 # --------------------------------------------------------------------------

@@ -217,6 +217,37 @@ def data_plane_orphaned_counter() -> Counter:
     )
 
 
+def data_plane_retries_counter() -> Counter:
+    """Retransmits sent for deadline-armed plane requests (same shared
+    single-definition discipline as data_plane_orphaned_counter)."""
+    return Counter(
+        "data_plane_request_retries_total",
+        "deadline-expired data-plane requests retransmitted with the "
+        "same rid and a bumped attempt counter",
+        tag_keys=("kind",),
+    )
+
+
+def data_plane_recovered_counter() -> Counter:
+    """Requests answered only AFTER at least one retransmit — recovery
+    made as visible as loss (the orphaned counter) was."""
+    return Counter(
+        "data_plane_requests_recovered_total",
+        "data-plane requests whose reply arrived only after retransmit "
+        "(a lost request/reply pair that self-healed)",
+        tag_keys=("kind",),
+    )
+
+
+def data_plane_duplicate_replies_counter() -> Counter:
+    return Counter(
+        "data_plane_duplicate_replies_total",
+        "replies dropped because their rid was already answered or "
+        "abandoned (retransmit races and late replies)",
+        tag_keys=(),
+    )
+
+
 def flush():
     """Force-push this process's metrics to the head."""
     _REGISTRY.maybe_flush(force=True)

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Soak the data-plane exchange: run the historical wedge's repro test
+# standalone, N times, each in a fresh process. The carried
+# lost-get_objects wedge fired on 50-80% of STANDALONE runs on a 2-core
+# host (in-suite timing almost never hit the window), so standalone
+# repetition is the regression signal — ten green runs ≈ <1e-3 chance the
+# wedge is still there at the historical rate.
+#
+# Usage: scripts/soak_data_plane.sh [iterations]   (default 10)
+# Also wired as tests/test_chaos.py::test_soak_data_plane_script (slow).
+set -u
+
+ITERS="${1:-10}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+TEST="tests/test_data_ops.py::test_repartition_exchange_exact"
+# a wedge must fail fast, not eat the whole soak budget
+export RAY_TPU_TEST_HANG_TIMEOUT_S="${RAY_TPU_TEST_HANG_TIMEOUT_S:-120}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+cd "$REPO"
+fails=0
+for i in $(seq 1 "$ITERS"); do
+    echo "=== soak run $i/$ITERS: $TEST ==="
+    if ! python -m pytest "$TEST" -q -p no:cacheprovider; then
+        fails=$((fails + 1))
+        echo "=== soak run $i FAILED ==="
+    fi
+done
+
+if [ "$fails" -ne 0 ]; then
+    echo "soak: $fails/$ITERS runs failed"
+    exit 1
+fi
+echo "soak: $ITERS/$ITERS runs passed"

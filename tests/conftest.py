@@ -80,6 +80,13 @@ def pytest_configure(config):
         "on CPU; they must FAIL (never skip) on divergence from the dense "
         "reference, and test_paged_attention.py budgets their wall clock",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection tests (ray_tpu._private."
+        "faults) — they arm RAY_TPU_FAULTS / call faults.arm() and always "
+        "disarm in teardown; seed the rand:<p> selector via "
+        "RAY_TPU_TEST_FAULT_SEED (default 0) to reproduce a run exactly",
+    )
 
 
 @pytest.fixture
@@ -144,6 +151,21 @@ def pytest_runtest_call(item):
                 for ev in tail:
                     print(f"[hang-guard]   {ev}", file=sys.stderr)
                 tel.flush_events(force=True)
+        except Exception:
+            pass
+        # retry/attempt state of every outstanding plane rid on the
+        # driver's head connection: a wedge now names the request it is
+        # stuck on AND how many retransmits it has burned. Same
+        # no-fresh-imports rule as above.
+        try:
+            wmod = sys.modules.get("ray_tpu._private.worker")
+            gw = getattr(wmod, "global_worker", None)
+            if gw is not None:
+                # every conn: head + task leases + actor channels — a
+                # wedge can park on any of them
+                for row in gw.plane_pending_summary():
+                    print(f"[hang-guard] outstanding rid: {row}",
+                          file=sys.stderr)
         except Exception:
             pass
         raise TimeoutError(

@@ -1,0 +1,450 @@
+"""Unit tests for the self-healing plane: Connection deadline/retransmit,
+receiver-side rid dedup, duplicate-reply dropping, fault injection, and the
+ObjectDirectory lost-wakeup fix (the root cause of the carried
+lost-get_objects wedge).
+
+These run against in-process socketpair Connections — no cluster — so they
+are fast, deterministic, and tier-1."""
+
+import asyncio
+import socket
+
+import pytest
+
+from ray_tpu._private import faults, protocol
+from ray_tpu.exceptions import PlaneRequestTimeout
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    protocol.reset_plane_stats()
+    yield
+    faults.disarm()
+    protocol.reset_plane_stats()
+
+
+async def _make_pair(handler, client_name="", server_name="server"):
+    """Two Connections over a socketpair: client issues requests, server
+    runs `handler` for them."""
+    s1, s2 = socket.socketpair()
+
+    async def _noop(msg):
+        raise ValueError("client got unexpected push")
+
+    r1, w1 = await asyncio.open_connection(sock=s1)
+    r2, w2 = await asyncio.open_connection(sock=s2)
+    server = protocol.Connection(r1, w1, handler, name=server_name).start()
+    client = protocol.Connection(r2, w2, _noop, name=client_name).start()
+    return client, server
+
+
+async def _close_pair(client, server):
+    await client.close()
+    await server.close()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -------------------------------------------------------------------------
+# retransmit + recovery
+# -------------------------------------------------------------------------
+
+
+def test_dropped_idempotent_reply_recovers_by_retransmit():
+    """The wedge scenario in miniature: the first get_objects reply frame
+    is dropped; the retransmitted request re-executes (idempotent) and the
+    caller recovers instead of hanging."""
+
+    async def main():
+        calls = {"n": 0}
+
+        async def handler(msg):
+            calls["n"] += 1
+            return {"oids": msg["object_ids"]}
+
+        client, server = await _make_pair(handler)
+        faults.arm("drop_reply:get_objects:1")
+        try:
+            out = await client.request(
+                {"t": "get_objects", "object_ids": ["x"]},
+                deadline_s=0.2, retries=3,
+            )
+        finally:
+            await _close_pair(client, server)
+        assert out == {"oids": ["x"]}
+        assert calls["n"] == 2  # original executed (reply lost) + retransmit
+        assert protocol.PLANE_STATS["retries"] >= 1
+        assert protocol.PLANE_STATS["recovered"] == 1
+
+    _run(main())
+
+
+def test_retransmit_exhaustion_raises_plane_timeout():
+    """Every reply dropped: the request surfaces PlaneRequestTimeout after
+    1 + retries attempts, within the capped-exponential budget — never a
+    hang."""
+
+    async def main():
+        async def handler(msg):
+            return "pong"
+
+        client, server = await _make_pair(handler)
+        faults.arm("drop_reply:get_objects:1,drop_reply:get_objects:2,"
+                   "drop_reply:get_objects:3")
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            with pytest.raises(PlaneRequestTimeout) as ei:
+                await client.request(
+                    {"t": "get_objects", "object_ids": ["x"]},
+                    deadline_s=0.1, retries=2,
+                )
+        finally:
+            await _close_pair(client, server)
+        # budget: 0.1 + 0.2 + 0.4 = 0.7s (+ slack); must not be a hang
+        assert loop.time() - t0 < 5.0
+        assert ei.value.attempts == 3
+        assert protocol.PLANE_STATS["deadline_timeouts"] == 1
+
+    _run(main())
+
+
+def test_mutating_request_deduplicated_by_rid():
+    """A retransmit-armed MUTATING request executes at most once per rid:
+    the duplicate is answered from the reply cache, not re-executed."""
+
+    async def main():
+        calls = {"n": 0}
+
+        async def handler(msg):
+            calls["n"] += 1
+            return calls["n"]
+
+        client, server = await _make_pair(handler)
+        assert "mutate_thing" not in protocol.IDEMPOTENT_TYPES
+        faults.arm("drop_reply:mutate_thing:1")
+        try:
+            out = await client.request(
+                {"t": "mutate_thing"}, deadline_s=0.2, retries=3,
+            )
+        finally:
+            await _close_pair(client, server)
+        assert out == 1  # the cached FIRST execution's reply
+        assert calls["n"] == 1  # never re-executed
+        assert protocol.PLANE_STATS["dedup_hits"] >= 1
+
+    _run(main())
+
+
+def test_duplicate_reply_dropped_and_counted():
+    """A duplicated reply frame completes the request exactly once; the
+    second delivery is dropped and counted."""
+
+    async def main():
+        async def handler(msg):
+            return "pong"
+
+        client, server = await _make_pair(handler)
+        faults.arm("dup_reply:ping:1")
+        try:
+            out = await client.request({"t": "ping"})
+            # let the duplicate frame arrive and be processed
+            await asyncio.sleep(0.1)
+        finally:
+            await _close_pair(client, server)
+        assert out == "pong"
+        assert protocol.PLANE_STATS["duplicate_replies"] == 1
+
+    _run(main())
+
+
+def test_blackholed_connection_times_out_not_hangs():
+    """All frames on a black-holed connection vanish (socket stays open):
+    a deadline-armed request surfaces PlaneRequestTimeout within budget."""
+
+    async def main():
+        async def handler(msg):
+            return "pong"
+
+        client, server = await _make_pair(handler, client_name="head")
+        faults.arm("blackhole:head")
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            with pytest.raises(PlaneRequestTimeout):
+                await client.request(
+                    {"t": "ping"}, deadline_s=0.1, retries=1,
+                )
+        finally:
+            faults.disarm()  # or close frames would be dropped too
+            await _close_pair(client, server)
+        assert loop.time() - t0 < 5.0
+
+    _run(main())
+
+
+def test_delay_send_directive():
+    async def main():
+        async def handler(msg):
+            return "pong"
+
+        client, server = await _make_pair(handler)
+        faults.arm("delay_send:ping:0.3")
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            out = await client.request({"t": "ping"})
+        finally:
+            await _close_pair(client, server)
+        assert out == "pong"
+        assert loop.time() - t0 >= 0.3
+
+    _run(main())
+
+
+def test_pending_summary_reports_attempt_state():
+    """The hang-guard dump source: outstanding rids with retry/attempt."""
+
+    async def main():
+        release = asyncio.Event()
+
+        async def handler(msg):
+            await release.wait()
+            return "done"
+
+        client, server = await _make_pair(handler)
+        try:
+            req = asyncio.ensure_future(
+                client.request(
+                    {"t": "get_objects", "object_ids": []},
+                    deadline_s=0.2, retries=5, warn_tag="unit",
+                )
+            )
+            await asyncio.sleep(0.5)  # at least one retransmit has fired
+            summary = client.pending_summary()
+            assert len(summary) == 1
+            row = summary[0]
+            assert row["t"] == "get_objects"
+            assert row["retries"] == 5
+            assert row["attempt"] >= 1
+            assert row["age_s"] >= 0.4
+            assert row["tag"] == "unit"
+            release.set()
+            assert await req == "done"
+            assert client.pending_summary() == []
+        finally:
+            await _close_pair(client, server)
+
+    _run(main())
+
+
+def test_legacy_request_path_unchanged():
+    """No deadline: requests behave exactly as before (wait, timeout)."""
+
+    async def main():
+        async def handler(msg):
+            if msg.get("slow"):
+                await asyncio.sleep(5)
+            return "pong"
+
+        client, server = await _make_pair(handler)
+        try:
+            assert await client.request({"t": "ping"}) == "pong"
+            with pytest.raises(asyncio.TimeoutError):
+                await client.request({"t": "ping", "slow": True}, timeout=0.1)
+        finally:
+            await _close_pair(client, server)
+
+    _run(main())
+
+
+def test_caller_timeout_bounds_retransmit_budget():
+    """An explicit caller timeout caps the total retransmit budget and
+    keeps the legacy TimeoutError contract."""
+
+    async def main():
+        async def handler(msg):
+            return "pong"
+
+        client, server = await _make_pair(handler)
+        faults.arm("blackhole:sink")
+        client.name = "sink"
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await client.request(
+                    {"t": "ping"}, timeout=0.3, deadline_s=1.0, retries=8,
+                )
+        finally:
+            faults.disarm()
+            await _close_pair(client, server)
+        assert loop.time() - t0 < 2.0
+
+    _run(main())
+
+
+# -------------------------------------------------------------------------
+# fault controller mechanics
+# -------------------------------------------------------------------------
+
+
+def test_fault_controller_parsing_and_seed():
+    c = faults.FaultController(
+        "drop_reply:get_objects:2,blackhole:head,delay_send:any:0.25",
+        seed=7,
+    )
+    assert len(c.directives) == 3
+    # seeded rng is deterministic
+    a = faults.FaultController("drop_reply:x:rand:0.5", seed=3)
+    b = faults.FaultController("drop_reply:x:rand:0.5", seed=3)
+    seq_a = [a.reply_action("x") for _ in range(16)]
+    seq_b = [b.reply_action("x") for _ in range(16)]
+    assert seq_a == seq_b
+    assert "drop" in seq_a  # p=0.5 over 16 draws: fires
+    with pytest.raises(ValueError):
+        faults.FaultController("explode:everything")
+
+
+def test_faults_inactive_by_default():
+    assert faults.ACTIVE is False
+    assert faults.controller() is None
+
+
+# -------------------------------------------------------------------------
+# ObjectDirectory lost-wakeup regression (the root cause)
+# -------------------------------------------------------------------------
+
+
+def test_object_directory_lost_wakeup_race():
+    """Regression for the carried lost-get_objects wedge. Sequence:
+
+      1. a get_objects handler with a timeout enters wait_available (object
+         absent): it fetches the event, then asyncio.wait_for wraps
+         ev.wait() in ensure_future, DEFERRING waiter registration to the
+         next loop iteration — ev._waiters is still empty (on CPython
+         ≤3.11; timeout=None awaits inline and has no such gap, which is
+         why the wedge only struck timeout-carrying gets),
+      2. a transient refcount 0 (direct-path free/put interleave) runs
+         _maybe_free inside that gap, which used to pop the "waiterless"
+         event,
+      3. the producer's put mints and sets a NEW event,
+      4. the handler's deferred waiter registers on the ORPHANED old event:
+         never woken, reply never sent.
+
+    With the _waiting counter (bumped synchronously before the first
+    await) the event survives step 2 and the waiter completes. Verified:
+    the pre-fix wait_available/_maybe_free bodies wedge on this exact
+    sequence; the fixed ones complete immediately."""
+
+    async def main():
+        from ray_tpu._private.head import ObjectDirectory
+
+        od = ObjectDirectory()
+        od.add_ref("x", 1)
+        # timeout MUST be non-None: that is the wait_for path with the
+        # deferred-registration gap (and well above the 2s assertion below
+        # so a regression surfaces as the wedge, not this timeout)
+        waiter = asyncio.ensure_future(od.wait_available("x", timeout=30))
+        await asyncio.sleep(0)  # step 1: inside the registration gap
+        od.remove_ref("x", 1)  # step 2: transient zero
+        od.add_ref("x", 1)
+        od.put("x", "envelope")  # step 3
+        await asyncio.wait_for(waiter, timeout=2.0)  # pre-fix: hangs here
+        assert od.get("x") == "envelope"
+
+    _run(main())
+
+
+def test_object_directory_waiting_counter_balanced():
+    async def main():
+        from ray_tpu._private.head import ObjectDirectory
+
+        od = ObjectDirectory()
+        w1 = asyncio.ensure_future(od.wait_available("y"))
+        w2 = asyncio.ensure_future(od.wait_available("y"))
+        await asyncio.sleep(0)
+        assert od._waiting["y"] == 2
+        od.put("y", "env")
+        await asyncio.gather(w1, w2)
+        assert "y" not in od._waiting
+        # timeout path decrements too
+        with pytest.raises(asyncio.TimeoutError):
+            await od.wait_available("z", timeout=0.05)
+        assert "z" not in od._waiting
+
+    _run(main())
+
+
+def test_object_directory_normal_flow_still_frees():
+    """The fix must not leak events: with no waiters, free still prunes."""
+
+    async def main():
+        from ray_tpu._private.head import ObjectDirectory
+
+        freed = []
+        od = ObjectDirectory(on_free=freed.append)
+        od.add_ref("a", 1)
+        od.put("a", "env-a")
+        await od.wait_available("a", timeout=1)
+        od.remove_ref("a", 1)
+        assert freed == ["env-a"]
+        assert "a" not in od.events
+        assert "a" not in od.objects
+
+    _run(main())
+
+
+def test_object_directory_freed_mid_wait_raises_not_parks():
+    """Regression for the second wedge class the 10x soak surfaced:
+    arrived-then-freed. A getter parks (object absent), the producer's put
+    lands, and the last existing ref drops BEFORE the getter wakes —
+    because the getter's own add_refs borrow was still in flight when the
+    deletion was decided (classic ownerless-refcounting race). The old
+    wait_available saw the post-free absence as a stale wakeup and
+    re-parked forever; retransmitted get_objects re-executed into the same
+    void (the head genuinely no longer held the envelope). Now the free
+    bumps freed_gen and wakes parked waiters, whose wait raises
+    ObjectLostError so the get_objects handler can take the lineage
+    reconstruction path instead of wedging."""
+
+    async def main():
+        from ray_tpu._private.head import ObjectDirectory
+        from ray_tpu.exceptions import ObjectLostError
+
+        od = ObjectDirectory()
+        waiter = asyncio.ensure_future(od.wait_available("x", timeout=30))
+        await asyncio.sleep(0)  # parked, object absent
+        od.put("x", "envelope")
+        od.add_ref("x", 1)
+        od.remove_ref("x", 1)  # last ref drops before the waiter wakes
+        with pytest.raises(ObjectLostError):
+            await asyncio.wait_for(waiter, timeout=2.0)  # old code: hangs
+        assert od.freed_gen.get("x") == 1
+
+    _run(main())
+
+
+def test_object_directory_freed_gen_only_marks_stored_envelopes():
+    """freed_gen is a breadcrumb for objects that EXISTED and died — a
+    refcount reaching zero for a never-arrived object (remove outrunning
+    the put) must not mark it, or every late put would look like a free
+    to the next waiter's entry check."""
+
+    async def main():
+        from ray_tpu._private.head import ObjectDirectory
+
+        od = ObjectDirectory()
+        od.add_ref("y", 1)
+        od.remove_ref("y", 1)  # transient zero, nothing stored
+        assert "y" not in od.freed_gen
+        # the late put still works and a waiter completes normally
+        od.put("y", "env-y")
+        od.add_ref("y", 1)
+        await od.wait_available("y", timeout=1)
+        assert od.get("y") == "env-y"
+
+    _run(main())

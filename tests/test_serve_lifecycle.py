@@ -346,6 +346,116 @@ def test_circuit_breaker_state_machine():
     assert not b.is_open and b.allow()
 
 
+def test_plane_timeout_retries_same_replica_never_trips_breaker(monkeypatch):
+    """A PlaneRequestTimeout is a plane blip, not a replica verdict: the
+    handle retries the SAME replica once (the replica may hold the answer;
+    idempotent re-execution / rid dedup make the duplicate safe) and the
+    circuit breaker is never fed a failure."""
+    import ray_tpu
+    from ray_tpu.exceptions import PlaneRequestTimeout
+    from ray_tpu.serve import handle as handle_mod
+
+    handle_mod._reset_breakers()
+
+    retry_log = []
+
+    class FakeMethod:
+        def remote(self, method, args, kwargs, model_id=None):
+            retry_log.append((method, args, kwargs, model_id))
+            return "retry-ref"
+
+    class FakeReplica:
+        handle_request = FakeMethod()
+
+    class FakeHandle:
+        deployment_name = "Dep"
+        method_name = "__call__"
+        multiplexed_model_id = ""
+
+    resp = handle_mod.DeploymentResponse(
+        "orig-ref", handle=FakeHandle(), call=((7,), {})
+    )
+    resp.replica = FakeReplica()
+
+    def fake_get(ref, timeout=None):
+        if ref == "orig-ref":
+            raise PlaneRequestTimeout("handle_request", 9, 3, 1.5)
+        return "answer"
+
+    monkeypatch.setattr(ray_tpu, "get", fake_get)
+    assert resp.result(timeout_s=5) == "answer"
+    assert resp.retries == 1
+    assert retry_log == [("__call__", (7,), {}, "")]  # same replica, once
+    b = handle_mod.get_breaker("Dep")
+    assert not b.is_open and b._consecutive == 0
+
+
+def test_plane_timeout_exhaustion_releases_probe_not_failure(monkeypatch):
+    """Every attempt times out at the plane: the final exception is
+    PlaneRequestTimeout and the breaker's failure count stays untouched
+    (an unresponsive plane says nothing about deployment health) —
+    whereas replica DEATH (retryable error) does feed the breaker."""
+    import ray_tpu
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.exceptions import ActorDiedError, PlaneRequestTimeout
+    from ray_tpu.serve import handle as handle_mod
+
+    handle_mod._reset_breakers()
+    monkeypatch.setitem(cfg._overrides, "serve_handle_retry_attempts", 1)
+    monkeypatch.setitem(cfg._overrides, "serve_handle_backoff_base_s", 0.01)
+    monkeypatch.setitem(cfg._overrides, "serve_handle_backoff_max_s", 0.02)
+
+    class FakeMethod:
+        def remote(self, method, args, kwargs, model_id=None):
+            return "retry-ref"
+
+    class FakeReplica:
+        handle_request = FakeMethod()
+
+    def make_handle(exc):
+        class FakeHandle:
+            deployment_name = "Dep2"
+            method_name = "__call__"
+            multiplexed_model_id = ""
+
+            def _refresh(self, force=False):
+                pass
+
+            def remote(self, *a, **k):
+                r = handle_mod.DeploymentResponse("reroute-ref")
+                return r
+
+        return FakeHandle()
+
+    def fake_get_always_timeout(ref, timeout=None):
+        raise PlaneRequestTimeout("handle_request", 1, 3, 0.5)
+
+    resp = handle_mod.DeploymentResponse(
+        "orig-ref", handle=make_handle(None), call=((), {})
+    )
+    resp.replica = FakeReplica()
+    monkeypatch.setattr(ray_tpu, "get", fake_get_always_timeout)
+    import pytest as _pytest
+    with _pytest.raises(PlaneRequestTimeout):
+        resp.result(timeout_s=2)
+    b = handle_mod.get_breaker("Dep2")
+    assert b._consecutive == 0 and not b.is_open  # plane blips never trip
+
+    # contrast: replica death IS a verdict — the breaker counts it
+    def fake_get_died(ref, timeout=None):
+        raise ActorDiedError("replica died")
+
+    resp2 = handle_mod.DeploymentResponse(
+        "orig-ref", handle=make_handle(None), call=((), {})
+    )
+    resp2.replica = FakeReplica()
+    monkeypatch.setattr(ray_tpu, "get", fake_get_died)
+    with _pytest.raises(ActorDiedError):
+        resp2.result(timeout_s=2)
+    assert b._consecutive == 1
+    handle_mod._reset_breakers()
+
+
 def test_breaker_fails_fast_when_deployment_gone(serve_cluster):
     """After every replica of a deployment is gone, repeated calls trip the
     per-deployment breaker and fail fast with DeploymentUnavailableError —
