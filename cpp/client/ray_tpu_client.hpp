@@ -38,7 +38,10 @@
 
 namespace ray_tpu {
 
-static constexpr int kProtocolVersion = 2;
+// v3 added out-of-band buffer segments to the *pickle* codec's framing.
+// JSON-codec peers (this client) never receive OOB-flagged frames, so the
+// wire format here is unchanged from v2.
+static constexpr int kProtocolVersion = 3;
 
 // ---------------------------------------------------------------------------
 // Minimal JSON value + parser/writer (only what the control plane needs).

@@ -36,6 +36,12 @@ namespace {
 constexpr uint32_t kMagic = 0x52545057;  // "RTPW" (v3: slab + robust mutex)
 constexpr int kMaxObjects = 1 << 14;
 constexpr int kNameLen = 48;
+// Session-derived shm FILENAMES (ctrl/data segments) get their own, larger
+// bound: a session id like "<base>_<node-id>" is ~40 chars before the
+// "/rtpu_"/"_ctrl" decoration, and silent snprintf truncation at kNameLen
+// used to chop the "_ctrl" suffix off per-node sessions -- every node then
+// probed the WRONG peer segment name and same-host attach never engaged.
+constexpr int kSegNameLen = 192;
 constexpr int64_t kAlign = 4096;
 
 struct ObjectEntry {
@@ -69,7 +75,7 @@ struct ControlBlock {
 
 struct StoreHandle {
   ControlBlock* ctrl;
-  char prefix[kNameLen];
+  char prefix[kSegNameLen];
   void* data_rw;
   void* data_ro;
   int64_t data_len;
@@ -243,7 +249,7 @@ void* ensure_data_map(StoreHandle* h, bool writable) {
   std::lock_guard<std::mutex> guard(g_map_mutex);
   void*& slot = writable ? h->data_rw : h->data_ro;
   if (slot != nullptr) return slot;
-  char seg[kNameLen * 2];
+  char seg[kSegNameLen + 16];
   snprintf(seg, sizeof(seg), "%s_data", h->prefix);
   int64_t cap = h->ctrl->capacity.load();
   int fd = shm_open(seg, O_CREAT | O_RDWR, 0600);
@@ -270,7 +276,7 @@ extern "C" {
 
 // Opens (or creates) the store control segment for a session.
 void* shm_store_connect(const char* session, int64_t capacity_bytes) {
-  char ctrl_name[kNameLen];
+  char ctrl_name[kSegNameLen];
   snprintf(ctrl_name, sizeof(ctrl_name), "/rtpu_%s_ctrl", session);
   int fd = shm_open(ctrl_name, O_CREAT | O_RDWR, 0600);
   if (fd < 0) return nullptr;
@@ -622,7 +628,7 @@ void shm_store_disconnect(void* handle) {
 
 // Destroys the session's control + data segments (head calls at shutdown).
 void shm_store_destroy(const char* session) {
-  char name[kNameLen];
+  char name[kSegNameLen];
   snprintf(name, sizeof(name), "/rtpu_%s_ctrl", session);
   shm_unlink(name);
   snprintf(name, sizeof(name), "/rtpu_%s_data", session);

@@ -783,38 +783,116 @@ def bench_decode_spec_realtext(new_tokens: int = 48, k: int = 4) -> dict:
     return out
 
 
-def bench_cross_node_gbps(mb: int = 256) -> float:
+def bench_cross_node(mb: int = 256, repeats: int = 3) -> dict:
     """2-node broadcast over the direct bulk plane: produce mb on one agent
-    node, pull it on another (chunked node-to-node; the head serves only
-    locations). Reference row: BASELINE.md multi-node broadcast."""
+    node, pull it on another (zero-copy node-to-node; the head serves only
+    locations). Reference row: BASELINE.md multi-node broadcast.
+
+    The timer covers ONLY the consumer-side pull (submit + pull + reply):
+    producing the array and sealing it into the source slab happen before
+    t0 (a `settle` task on the producer node returns once the object is
+    resolvable there). Each repeat produces a FRESH object — pulled
+    buffers cache on the consumer node, so re-pulling would time a local
+    shm hit, not the plane."""
     import numpy as np
 
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
+    out = {}
+    n = mb * 1024 * 1024
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
     try:
         cluster.add_node(num_cpus=2, resources={"src": 1})
         cluster.add_node(num_cpus=2, resources={"dst": 1})
 
         @ray_tpu.remote(resources={"src": 0.1})
-        def produce():
-            return np.ones(mb * 1024 * 1024, dtype=np.uint8)
+        def produce(i):
+            return np.ones(n, dtype=np.uint8)
+
+        @ray_tpu.remote(resources={"src": 0.1})
+        def settle(x):
+            # materializes on the PRODUCING node (local shm, no wire):
+            # returns only once the object is sealed and resolvable there
+            return len(x)
 
         @ray_tpu.remote(resources={"dst": 0.1})
         def consume(x):
             return int(x[0]) + len(x)
 
-        ref = produce.remote()
-        # warm: placement + first pull populates the consumer node's cache
-        ray_tpu.get(consume.remote(ref), timeout=120)
-        t0 = time.perf_counter()
-        ref2 = produce.remote()
-        ray_tpu.get(consume.remote(ref2), timeout=120)
-        dt = time.perf_counter() - t0
-        return mb / 1024 / dt
+        # warm: placement + worker spawn on both nodes + peer resolution
+        ray_tpu.get(consume.remote(produce.remote(-1)), timeout=180)
+
+        best = 0.0
+        for i in range(repeats):
+            ref = produce.remote(i)
+            ray_tpu.get(settle.remote(ref), timeout=180)
+            t0 = time.perf_counter()
+            assert ray_tpu.get(consume.remote(ref), timeout=180) == 1 + n
+            dt = time.perf_counter() - t0
+            best = max(best, mb / 1024 / dt)
+        out["cross_node_256mb_gbps"] = round(best, 2)
+
+        # striping sub-metric, wire-only: the DRIVER pulls over real bulk
+        # sockets (same-host slab attach off) with 1 socket vs the stripe
+        # fan-out. Informational, ungated: on a single-core host both
+        # stripes contend for the same CPU so ~1.0x is expected; the
+        # fan-out pays off with a NIC per host.
+        try:
+            speedup, wire_gbps = _cross_node_striped_speedup(
+                mb, produce, settle
+            )
+            out["cross_node_striped_speedup_x"] = round(speedup, 2)
+            out["cross_node_wire_gbps"] = round(wire_gbps, 2)
+        except Exception as e:
+            print(f"[microbench] striped sub-metric unavailable: {e!r}",
+                  file=sys.stderr)
     finally:
         cluster.shutdown()
+    return out
+
+
+def _cross_node_striped_speedup(mb, produce, settle):
+    import ray_tpu
+    from ray_tpu._private import serialization
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+    from ray_tpu._private.worker import global_worker
+
+    def wire_pull_gbps(ref, stripe_sockets):
+        env = global_worker.request(
+            {"t": "get_objects", "object_ids": [ref.id]}
+        )[0]
+        refs = serialization.shm_buffer_refs(env)
+        cfg.apply({
+            "bulk_same_host": False,
+            "bulk_stripe_sockets": stripe_sockets,
+            "bulk_stripe_min_bytes": 32 * 1024 * 1024,
+        })
+        t0 = time.perf_counter()
+        got = global_worker.fetch_buffers_direct(refs[0].node, refs)
+        dt = time.perf_counter() - t0
+        if got is None or any(v is None for v in got.values()):
+            raise RuntimeError("direct wire pull failed")
+        return mb / 1024 / dt
+
+    try:
+        r1 = produce.remote(1001)
+        ray_tpu.get(settle.remote(r1), timeout=180)
+        rn = produce.remote(1002)
+        ray_tpu.get(settle.remote(rn), timeout=180)
+        single = wire_pull_gbps(r1, 1)
+        striped = wire_pull_gbps(rn, 4)
+        return striped / single, single
+    finally:
+        cfg.apply({
+            "bulk_same_host": True,
+            "bulk_stripe_sockets": 4,
+            "bulk_stripe_min_bytes": 64 * 1024 * 1024,
+        })
+
+
+def bench_cross_node_gbps(mb: int = 256) -> float:
+    return bench_cross_node(mb)["cross_node_256mb_gbps"]
 
 
 def bench_head_stress(n_tasks: int = 0, n_actors: int = 0) -> dict:
@@ -892,11 +970,13 @@ GATES = {
     # is below 12.5 GB/s the absolute 10 GB/s is unreachable by
     # construction — the honest target is ~75% of the floor, capped
     "put_100mb_gbps": (">=", lambda r: min(10.0, 0.75 * r["host_memcpy_gbps"])),
-    # cross-node bulk transfer is ~20x below the memcpy floor today
-    # (VERDICT weak #3) — ANTI-REGRESSION, not aspiration: trips if the
-    # direct pull path gets slower still, leaves the 0.5x-of-floor
-    # target to the zero-copy work (ROADMAP item 3)
-    "cross_node_256mb_gbps": (">=", lambda r: min(0.15, 0.02 * r["host_memcpy_gbps"])),
+    # cross-node pull pays at most ONE host copy on the zero-copy bulk
+    # plane (slab-attach or recv-into-slab), so half the single-thread
+    # memcpy floor is the honest bound — copy time plus an equal budget
+    # for dispatch/seal/teardown (ROADMAP item 3 landed: was an
+    # anti-regression floor of min(0.15, 0.02x) while pulls were
+    # chunk-copied through the head relay)
+    "cross_node_256mb_gbps": (">=", lambda r: 0.5 * r["host_memcpy_gbps"]),
     # batched KV-cache decode must beat serial per-request decode: the
     # continuous-batching serving fast path (both engines run PAGED)
     "decode_batched_speedup_x": (">=", 2.0),
@@ -1060,7 +1140,7 @@ def main():
     results["get_100mb_gbps"] = round(bench_get_gbps(), 2)
     results["broadcast_10mb_16actors_ms"] = round(bench_weight_broadcast_ms(), 1)
     ray_tpu.shutdown()
-    results["cross_node_256mb_gbps"] = round(bench_cross_node_gbps(), 2)
+    results.update(bench_cross_node())
     results.update(bench_head_stress())
 
     # targets resolve from the shared GATES table (floor-relative ones —
@@ -1119,8 +1199,7 @@ ROWS = {
     # calls init with a custom system config; cross_node builds a
     # Cluster) — run_only must release any shared cluster first, or the
     # row's init raises "called twice"
-    "cross_node": (lambda: {"cross_node_256mb_gbps": round(bench_cross_node_gbps(), 2)},
-                   None, ("cross_node_256mb_gbps",)),
+    "cross_node": (bench_cross_node, None, ("cross_node_256mb_gbps",)),
     "head_stress": (bench_head_stress, None, ()),
 }
 

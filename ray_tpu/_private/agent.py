@@ -24,6 +24,7 @@ import os
 import shutil
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -51,102 +52,47 @@ class Agent:
         self.shm_session: str = ""
         self._shm = None
         self._shm_tried = False
+        self._shm_lock = threading.Lock()
         self.workers: Dict[str, subprocess.Popen] = {}
         self._stop = asyncio.Event()
         self._quit = False  # explicit shutdown (no reconnect attempts)
         self.buffer_addr: str = ""
+        self._bulk_server = None
 
     # ------------------------------------------------------------------
 
     def _shm_client(self):
-        if not self._shm_tried:
-            self._shm_tried = True
-            from .shm import ShmClient
+        # called from the event loop AND the bulk server's serve threads —
+        # the lock keeps a half-initialized None from leaking to a
+        # concurrent first caller (stripe pulls arrive N-at-once)
+        with self._shm_lock:
+            if not self._shm_tried:
+                self._shm_tried = True
+                from .shm import ShmClient
 
-            try:
-                self._shm = ShmClient(self.shm_session, cfg.shm_store_bytes)
-                self._shm.pretouch_async()  # one pretouch per node slab
-            except Exception:
-                self._shm = None
-        return self._shm
+                try:
+                    self._shm = ShmClient(self.shm_session, cfg.shm_store_bytes)
+                    self._shm.pretouch_async()  # one pretouch per node slab
+                except Exception:
+                    self._shm = None
+            return self._shm
 
     async def _start_buffer_server(self) -> str:
-        """TCP listener serving this node's shm plane STRAIGHT to peer
-        workers/agents — the node-to-node bulk plane (reference:
-        object_manager.h:117 chunked push/pull between object managers).
-        The head only hands out locations; object bytes never relay
-        through it.
-
-        The wire format is RAW (no pickle, no per-chunk framing): request =
-        op byte + <Q name_len> + name; reply = <q size> (+ the buffer bytes
-        streamed in bounded writes for op READ). Consumers read with
-        blocking sockets + recv_into — on a busy host this is ~3-5x the
-        throughput of pickled frames through asyncio streams."""
-        import struct
-
-        async def on_peer(reader, writer):
-            import socket as _socket
-
-            sock = writer.get_extra_info("socket")
-            if sock is not None:
-                # big send buffer: on busy hosts throughput is bounded by
-                # sender/receiver scheduling ping-pong; deep kernel buffers
-                # amortize the context switches
-                try:
-                    sock.setsockopt(
-                        _socket.SOL_SOCKET, _socket.SO_SNDBUF, 8 * 1024 * 1024
-                    )
-                except OSError:
-                    pass
-            try:
-                while True:
-                    hdr = await reader.readexactly(9)
-                    op = hdr[0]
-                    (nlen,) = struct.unpack("<Q", hdr[1:9])
-                    if nlen > 4096:
-                        break
-                    name = (await reader.readexactly(nlen)).decode()
-                    shm = self._shm_client()
-                    mv = None if shm is None else shm.get_or_spilled(name)
-                    if op == 1:  # INFO
-                        writer.write(
-                            struct.pack("<q", -1 if mv is None else len(mv))
-                        )
-                        await writer.drain()
-                    elif op == 2:  # READ (whole buffer, streamed)
-                        if mv is None:
-                            writer.write(struct.pack("<q", -1))
-                            await writer.drain()
-                            continue
-                        size = len(mv)
-                        writer.write(struct.pack("<q", size))
-                        step = cfg.fetch_chunk_bytes
-                        # memoryview slices: zero-copy into the transport
-                        # (the shm mapping outlives the awaited drain);
-                        # drain per chunk keeps the agent loop + memory
-                        # responsive while the wire stays full
-                        for off in range(0, size, step):
-                            writer.write(mv[off : off + step])
-                            await writer.drain()
-                        if size == 0:
-                            await writer.drain()
-                    else:
-                        break
-            except (asyncio.IncompleteReadError, ConnectionError, OSError):
-                pass
-            finally:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+        """Start the node-to-node bulk plane (bulk.BulkServer): dedicated
+        blocking sender threads doing sock.sendall straight from the shm
+        mapping (os.sendfile for spilled buffers) — off this event loop, so
+        a 256MB pull never contends with control-plane handlers. The head
+        only hands out locations; object bytes never relay through it
+        (reference: object_manager.h:117 chunked push/pull)."""
+        from .bulk import BulkServer
 
         # honor the cluster's bind policy: the control plane's bind host
         # (head_tcp_host) decides whether this unauthenticated plane is
         # loopback-only or LAN-exposed — serving raw object bytes on all
         # interfaces of a loopback-configured cluster would leak data
         bind = cfg.head_tcp_host or "0.0.0.0"
-        server = await asyncio.start_server(on_peer, host=bind, port=0)
-        port = server.sockets[0].getsockname()[1]
+        self._bulk_server = BulkServer(self._shm_client, bind)
+        port = self._bulk_server.start()
         from .head import _advertise_host
 
         return f"{_advertise_host(bind)}:{port}"
@@ -295,6 +241,11 @@ class Agent:
                     proc.kill()
                 except Exception:
                     pass
+        if self._bulk_server is not None:
+            try:
+                self._bulk_server.stop()
+            except Exception:
+                pass
         shm = self._shm_client()
         if shm is not None:
             try:
@@ -452,13 +403,15 @@ class Agent:
         return True
 
     async def _h_read_buffers(self, msg):
-        """Serve node-local shm buffers to the head (cross-node object pull)."""
+        """Serve node-local shm buffers to the head (relay fallback for
+        cross-node pulls). WireBuffer: the slab views ride the control
+        socket as out-of-band segments — no pickle copy on this side."""
 
         shm = self._shm_client()
-        out: Dict[str, Optional[bytes]] = {}
+        out: Dict[str, Optional[protocol.WireBuffer]] = {}
         for name in msg["names"]:
             mv = None if shm is None else shm.get_or_spilled(name)
-            out[name] = None if mv is None else bytes(mv)
+            out[name] = None if mv is None else protocol.WireBuffer(mv)
         return out
 
     async def _h_delete_buffers(self, msg):

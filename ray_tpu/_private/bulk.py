@@ -1,0 +1,330 @@
+"""Node-to-node bulk object plane: blocking slab-to-socket senders.
+
+Reference parity: object_manager.h:117 chunked push/pull between object
+managers. The wire format is RAW (no pickle, no per-chunk framing):
+
+    request = <B op> <Q name_len> name [<Q offset> <Q length> for READ_RANGE]
+    reply   = <q n> (+ n raw bytes for READ / READ_RANGE)
+
+ops: INFO=1 (reply is the buffer size), READ=2 (whole buffer), READ_RANGE=3
+(a byte range — the striping primitive: one 256MB pull fans out across N
+sockets, each asking for a disjoint range). Negative replies: -1 = buffer
+unknown on this node, -2 = bad range.
+
+Serving runs on dedicated blocking threads doing sock.sendall straight from
+the shm mapping (os.sendfile for spilled buffers) — no asyncio transport
+copy, no contention with the agent's control-plane event loop. Consumers
+read with blocking sockets + recv_into preallocated slab views, so a direct
+pull costs at most one host copy (kernel-to-slab).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+from . import faults
+from .config import GLOBAL_CONFIG as cfg
+
+OP_INFO = 1
+OP_READ = 2
+OP_READ_RANGE = 3
+
+MISSING = -1
+BAD_RANGE = -2
+
+_MAX_NAME = 4096
+_HDR = struct.Struct("<BQ")
+_RANGE = struct.Struct("<QQ")
+_REPLY = struct.Struct("<q")
+
+# Process-local serving stats (tests + debugging), PLANE_STATS pattern.
+BULK_STATS: Dict[str, int] = {
+    "requests": 0,
+    "range_requests": 0,
+    "bytes_sent": 0,
+    "sendfile_bytes": 0,
+    "faults_close": 0,
+    "faults_blackhole": 0,
+}
+_STATS_LOCK = threading.Lock()
+
+
+def _stat(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        BULK_STATS[key] = BULK_STATS.get(key, 0) + n
+
+
+def reset_bulk_stats() -> None:
+    with _STATS_LOCK:
+        for k in list(BULK_STATS):
+            BULK_STATS[k] = 0
+
+
+def account(path: str, nbytes: int) -> None:
+    """Consumer-side transfer accounting: one pull of `nbytes` over
+    `path` (direct | striped | relay | spilled). Never breaks a pull."""
+    try:
+        from ray_tpu.util import metrics as _m
+
+        _m.bulk_plane_bytes_counter().inc(nbytes, tags={"path": path})
+        _m.bulk_plane_pulls_counter().inc(tags={"path": path})
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# client-side helpers (worker pull path + microbench share these)
+# ---------------------------------------------------------------------------
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill `view` (writable, contiguous bytes) from the socket — lands
+    bytes straight in the caller's buffer (a slab view on the pull path)."""
+    got = 0
+    size = view.nbytes
+    while got < size:
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("bulk peer closed mid-stream")
+        got += n
+
+
+def recv_exact(sock: socket.socket, size: int) -> bytearray:
+    buf = bytearray(size)
+    if size:
+        recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def pack_request(op: int, name: str, offset: int = 0, length: int = 0) -> bytes:
+    nb = name.encode()
+    req = _HDR.pack(op, len(nb)) + nb
+    if op == OP_READ_RANGE:
+        req += _RANGE.pack(offset, length)
+    return req
+
+
+def read_reply_size(sock: socket.socket) -> int:
+    return _REPLY.unpack(bytes(recv_exact(sock, 8)))[0]
+
+
+def read_info(sock: socket.socket, name: str) -> int:
+    sock.sendall(pack_request(OP_INFO, name))
+    return read_reply_size(sock)
+
+
+def read_range_into(
+    sock: socket.socket, name: str, offset: int, view: memoryview
+) -> int:
+    """Pull `view.nbytes` bytes of `name` starting at `offset` straight into
+    `view`. Returns the (negative) reply code without touching the view when
+    the server can't serve the range."""
+    sock.sendall(pack_request(OP_READ_RANGE, name, offset, view.nbytes))
+    n = read_reply_size(sock)
+    if n < 0:
+        return n
+    if n != view.nbytes:
+        raise ConnectionError(
+            f"bulk peer served {n} bytes for a {view.nbytes}-byte range"
+        )
+    recv_exact_into(sock, view)
+    return n
+
+
+def connect(addr: str, timeout_s: Optional[float] = None) -> socket.socket:
+    """Dial a peer's bulk server with the tuned socket options (deep receive
+    buffer before connect so the kernel honors it, NODELAY for the small
+    request frames, bounded timeout so a blackholed peer can't hang pulls)."""
+    host, port_s = addr.rsplit(":", 1)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8 * 1024 * 1024)
+    except OSError:
+        pass
+    sock.settimeout(
+        timeout_s if timeout_s is not None else cfg.bulk_read_timeout_s
+    )
+    sock.connect((host, int(port_s)))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class BulkServer:
+    """Threaded TCP listener serving one node's shm plane to peers.
+
+    `shm_client_fn` is called lazily per request (the agent's shm client is
+    created on first use, after the session handshake)."""
+
+    def __init__(self, shm_client_fn, bind_host: str):
+        self._shm_client_fn = shm_client_fn
+        self._bind_host = bind_host
+        self._lsock: Optional[socket.socket] = None
+        self._stopping = False
+        self.port = 0
+
+    def start(self) -> int:
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((self._bind_host, 0))
+        lsock.listen(128)
+        self._lsock = lsock
+        self.port = lsock.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop, name="bulk-accept", daemon=True
+        ).start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), name="bulk-send", daemon=True
+            ).start()
+
+    # -- per-connection handler (dedicated blocking sender thread) ----------
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            # deep send buffer: throughput on busy hosts is bounded by
+            # sender/receiver scheduling ping-pong; big kernel buffers
+            # amortize the context switches
+            try:
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDBUF, 8 * 1024 * 1024
+                )
+            except OSError:
+                pass
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                if not self._serve_one(conn):
+                    return
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn: socket.socket) -> bool:
+        try:
+            hdr = recv_exact(conn, _HDR.size)
+        except ConnectionError:
+            return False  # peer hung up between requests
+        op, nlen = _HDR.unpack(bytes(hdr))
+        if nlen > _MAX_NAME:
+            return False
+        name = bytes(recv_exact(conn, nlen)).decode()
+        offset = length = 0
+        if op == OP_READ_RANGE:
+            offset, length = _RANGE.unpack(bytes(recv_exact(conn, _RANGE.size)))
+        elif op not in (OP_INFO, OP_READ):
+            return False
+        _stat("requests")
+        if op == OP_READ_RANGE:
+            _stat("range_requests")
+
+        action = faults.bulk_action() if faults.ACTIVE else None
+        if action == "blackhole":
+            # swallow the request, keep the socket open: the consumer's
+            # read timeout is what surfaces the loss (partition semantics)
+            _stat("faults_blackhole")
+            return True
+
+        src = self._resolve(name)
+        if src is None:
+            conn.sendall(_REPLY.pack(MISSING))
+            return True
+        kind, obj, size = src
+        try:
+            if op == OP_INFO:
+                conn.sendall(_REPLY.pack(size))
+                return True
+            if op == OP_READ:
+                offset, length = 0, size
+            elif offset + length > size:
+                conn.sendall(_REPLY.pack(BAD_RANGE))
+                return True
+            conn.sendall(_REPLY.pack(length))
+            if length == 0:
+                return True
+            limit = offset + length
+            if action == "close":
+                # mid-stream death: serve about half then drop the socket
+                _stat("faults_close")
+                limit = offset + max(1, length // 2)
+            if kind == "shm":
+                self._send_slab(conn, obj, offset, limit)
+            else:
+                self._sendfile(conn, obj, offset, limit)
+            if action == "close":
+                conn.close()
+                return False
+            return True
+        finally:
+            if kind == "spill":
+                obj.close()
+
+    def _resolve(self, name: str):
+        """('shm', memoryview, size) | ('spill', open file, size) | None."""
+        from .shm import ShmBufferRef
+
+        shm = self._shm_client_fn()
+        if shm is None:
+            return None
+        mv = shm.get(ShmBufferRef(name=name, size=0))
+        if mv is not None:
+            return ("shm", mv, mv.nbytes)
+        try:
+            f = open(shm._spill_file(name), "rb")
+        except OSError:
+            return None
+        return ("spill", f, os.fstat(f.fileno()).st_size)
+
+    @staticmethod
+    def _send_slab(conn: socket.socket, mv: memoryview, off: int, limit: int):
+        """sock.sendall straight from the shm mapping — the kernel copies
+        out of the slab pages; no Python-side staging buffer."""
+        step = cfg.fetch_chunk_bytes
+        sent = 0
+        while off < limit:
+            n = min(step, limit - off)
+            conn.sendall(mv[off : off + n])
+            off += n
+            sent += n
+        _stat("bytes_sent", sent)
+
+    @staticmethod
+    def _sendfile(conn: socket.socket, f, off: int, limit: int):
+        """Spilled buffers ride os.sendfile: file pages go straight to the
+        socket without ever entering userspace."""
+        out_fd, in_fd = conn.fileno(), f.fileno()
+        sent = 0
+        while off < limit:
+            n = os.sendfile(out_fd, in_fd, off, min(1 << 26, limit - off))
+            if n == 0:
+                raise ConnectionError("sendfile hit EOF inside a valid range")
+            off += n
+            sent += n
+        _stat("sendfile_bytes", sent)
+        _stat("bytes_sent", sent)

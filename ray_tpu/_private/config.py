@@ -89,6 +89,19 @@ D("object_inline_limit_bytes", int, 128 * 1024, "objects <= this ride the contro
 D("fetch_chunk_bytes", int, 16 * 1024 * 1024,
   "chunk size for node-to-node buffer pulls (object_manager.h chunked "
   "transfer analogue); bounds per-message memory on the bulk plane")
+D("bulk_stripe_sockets", int, 4,
+  "parallel sockets a large bulk pull stripes across (READ_RANGE fan-out); "
+  "1 disables striping")
+D("bulk_stripe_min_bytes", int, 64 * 1024 * 1024,
+  "buffers at or above this size stripe across bulk_stripe_sockets; "
+  "smaller buffers ride one socket (pipelined for multi-buffer pulls)")
+D("bulk_same_host", bool, True,
+  "when a peer node's shm plane lives on THIS machine (colocated test "
+  "clusters, multi-agent hosts), attach it directly and copy slab-to-slab "
+  "instead of going through TCP")
+D("bulk_read_timeout_s", float, 120.0,
+  "blocking-socket timeout for bulk-plane pulls; a blackholed/dead peer "
+  "surfaces as a timeout and the pull falls back to the head relay")
 D("shm_store_bytes", int, 2 * 1024**3, "capacity of the C++ shared-memory object store")
 D("shm_store_enabled", bool, True)
 D("get_poll_timeout_s", float, 0.2)

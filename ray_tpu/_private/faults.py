@@ -29,6 +29,12 @@ Directives
                              fires exactly once across ALL processes via an
                              O_EXCL marker file (a per-process counter
                              would also kill the task's retry)
+  bulk_close:<sel>           close the bulk-plane socket mid-stream while
+                             serving the selected request (peer-death
+                             analogue: the consumer sees a short read)
+  bulk_blackhole:<sel>       swallow the selected bulk-plane request — no
+                             reply, socket stays open (the consumer's read
+                             timeout fires)
 
 ``<sel>`` is a 1-based occurrence number (``1`` = first match) or
 ``rand:<p>`` (fire with probability p, seeded). Counters are per-directive
@@ -100,6 +106,13 @@ class FaultController:
                 if len(fields) != 2:
                     raise ValueError(f"fault directive needs 2 fields: {part!r}")
                 self.directives.append(_Directive(kind, fields[1]))
+            elif kind in ("bulk_close", "bulk_blackhole"):
+                if len(fields) < 2:
+                    raise ValueError(f"fault directive needs 2 fields: {part!r}")
+                # the second field IS the selector (may contain ':' — rand:<p>)
+                self.directives.append(
+                    _Directive(kind, "bulk", ":".join(fields[1:]))
+                )
             else:
                 raise ValueError(f"unknown fault directive kind: {part!r}")
 
@@ -164,6 +177,21 @@ class FaultController:
                     self._record(d)
                     delay += float(d.arg)
         return delay
+
+    def bulk_action(self) -> Optional[str]:
+        """'close' (drop the socket mid-stream) / 'blackhole' (no reply) /
+        None, for one bulk-plane request being served."""
+        action = None
+        with self._lock:
+            for d in self.directives:
+                if d.kind in ("bulk_close", "bulk_blackhole"):
+                    if self._selected(d):
+                        self._record(d)
+                        if action is None:
+                            action = (
+                                "close" if d.kind == "bulk_close" else "blackhole"
+                            )
+        return action
 
     def before_task(self, fn_name: str) -> None:
         """SIGKILL this process if a kill_task directive selects this
@@ -249,6 +277,11 @@ def before_task(fn_name: str) -> None:
     c = _CTL
     if c is not None:
         c.before_task(fn_name)
+
+
+def bulk_action() -> Optional[str]:
+    c = _CTL
+    return c.bulk_action() if c is not None else None
 
 
 # Env arming at import: worker processes import this via protocol.py at

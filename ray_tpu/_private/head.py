@@ -510,14 +510,21 @@ class Head:
 
     async def _h_buffer_addrs(self, conn, msg):
         """Owner-directed location lookup (pull_manager.h:52): where is each
-        node's bulk-plane listener? Consumers dial it directly and cache the
-        answer; the head never sees the object bytes."""
+        node's bulk plane? Consumers dial the addr directly (and, when the
+        peer's shm session lives on THEIR machine, attach it instead of
+        using TCP at all) and cache the answer; the head never sees the
+        object bytes."""
+        session = os.path.basename(self.session_dir)
         out = {}
         for nid in msg["nodes"]:
             node = self.nodes.get(nid)
-            out[nid] = (
-                node.buffer_addr if node is not None and node.alive else None
-            )
+            if node is None or not node.alive or not node.buffer_addr:
+                out[nid] = None
+                continue
+            out[nid] = {
+                "addr": node.buffer_addr,
+                "shm_session": f"{session}_{nid}",
+            }
         return out
 
     async def _h_fetch_buffers(self, conn, msg):
@@ -538,13 +545,20 @@ class Head:
             except Exception:
                 return {name: None for name in names}
             self.relay_bytes += sum(len(v) for v in got.values() if v)
-            return got
-        # head node and logical nodes share the head machine's shm plane
+            # re-wrap for the consumer leg: the agent's WireBuffers arrived
+            # as out-of-band views; send them onward the same way instead
+            # of re-pickling the payload inline
+            return {
+                name: None if v is None else protocol.WireBuffer(v)
+                for name, v in got.items()
+            }
+        # head node and logical nodes share the head machine's shm plane:
+        # serve slab views out-of-band, zero head-side copies
         shm = self._shm_client()
         out = {}
         for name in names:
             mv = None if shm is None else shm.get_or_spilled(name)
-            out[name] = None if mv is None else bytes(mv)
+            out[name] = None if mv is None else protocol.WireBuffer(mv)
         return out
 
     async def start(self, tcp_host: Optional[str] = None, tcp_port: Optional[int] = None):
@@ -1132,8 +1146,29 @@ class Head:
 
     async def _h_object_stats(self, conn, msg):
         """Bulk-plane accounting: relayed bytes must stay ~0 when the
-        direct node-to-node plane is healthy."""
-        return {"relay_bytes": self.relay_bytes}
+        direct node-to-node plane is healthy. bulk_* roll up the pushed
+        per-process counters (bytes/pulls by path, relay fallbacks)."""
+        out = {"relay_bytes": self.relay_bytes}
+        try:
+            from ray_tpu.util.metrics import merge_snapshots
+
+            merged = merge_snapshots(self.metrics_store)
+            for name, key in (
+                ("bulk_plane_bytes_total", "bulk_bytes_by_path"),
+                ("bulk_plane_pulls_total", "bulk_pulls_by_path"),
+            ):
+                m = merged.get(name)
+                if m:
+                    out[key] = {
+                        (dict(tags).get("path", "") or "untagged"): v
+                        for tags, v in m["values"].items()
+                    }
+            m = merged.get("bulk_plane_fallbacks_total")
+            if m:
+                out["bulk_fallbacks"] = sum(m["values"].values())
+        except Exception:
+            pass
+        return out
 
     async def _h_debug_object(self, conn, msg):
         """Per-object directory introspection (ops/debugging)."""

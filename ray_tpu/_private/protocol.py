@@ -28,15 +28,32 @@ from . import faults
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
+_NSEG = struct.Struct("<I")
 MAX_MSG = 1 << 40
+
+# Out-of-band framing (frame format v2): a length word with this bit set
+# announces that the frame carries raw buffer segments AFTER the pickle
+# body. Layout:
+#   <Q (len(seg_hdr + body)) | _OOB_FLAG>  seg_hdr  body  seg0 seg1 ...
+#   seg_hdr = <I nseg> + nseg * <Q seg_size>
+# The body is pickled at protocol 5 with a buffer_callback that extracts
+# every PickleBuffer larger than _SEG_INLINE_MAX; the receiver reads the
+# segments into their own buffers and hands them to pickle.loads(buffers=)
+# — big payloads (fetch_buffers relays, task args/returns) never pass
+# through pickle's in-band copy on either side. JSON frames (cross-language
+# clients) are never OOB.
+_OOB_FLAG = 1 << 63
+_SEG_INLINE_MAX = 64 * 1024
 
 # Wire-format version, carried in every registration message and checked by
 # the head (reference: the protobuf schema + gRPC service versioning of
 # src/ray/protobuf). Bump whenever message shapes change incompatibly —
 # cross-version control planes must fail fast with a clear error, not
 # corrupt state mid-protocol (mixed versions happen when a multi-host
-# deployment upgrades hosts one at a time).
-PROTOCOL_VERSION = 2
+# deployment upgrades hosts one at a time). v3: out-of-band buffer
+# segments on the plane framing (older peers would misread the flagged
+# length word as an oversized frame).
+PROTOCOL_VERSION = 3
 
 # Handler types that may PARK indefinitely waiting for cluster events and
 # only read state — safe (and necessary) to cancel when their connection
@@ -151,17 +168,64 @@ CODEC_PICKLE = "pickle"
 CODEC_JSON = "json"
 
 
+class WireBuffer:
+    """Marks a buffer for OUT-OF-BAND transport on the plane framing: the
+    bytes ride as a raw segment after the pickle body (sender writes the
+    view straight to the socket; receiver's pickle.loads hands back a
+    readonly view of the received segment). Unwraps to the plain buffer on
+    load — handlers upstream see bytes/memoryview exactly as before.
+    memoryview itself is not picklable, which is why this wrapper exists."""
+
+    __slots__ = ("view",)
+
+    def __init__(self, data):
+        self.view = data if isinstance(data, memoryview) else memoryview(data)
+
+    def __len__(self):
+        return self.view.nbytes
+
+    def __reduce_ex__(self, protocol):
+        if protocol >= 5:
+            return (_wire_load, (pickle.PickleBuffer(self.view),))
+        return (_wire_load, (bytes(self.view),))
+
+
+def _wire_load(buf):
+    # bytes (in-band / legacy protocol) or a readonly memoryview of an
+    # out-of-band segment — both satisfy every buffer consumer downstream
+    return buf
+
+
+def _parse_oob(payload):
+    """Split an OOB frame's first block into (segment sizes, pickle body)."""
+    (nseg,) = _NSEG.unpack_from(payload, 0)
+    sizes = [
+        _LEN.unpack_from(payload, _NSEG.size + i * _LEN.size)[0]
+        for i in range(nseg)
+    ]
+    body = memoryview(payload)[_NSEG.size + nseg * _LEN.size :]
+    return sizes, body
+
+
 async def read_msg(reader: asyncio.StreamReader) -> Tuple[dict, str]:
     """Returns (msg, codec). Frames are pickle by default; a body whose
     first byte is '{' is a JSON frame from a cross-language client (the
     C++ API, cpp/client/) — unambiguous because pickle protocol >= 2
     always starts with 0x80. Replies go back in the codec of the request
-    (reference: the protobuf wire format serves every worker language)."""
+    (reference: the protobuf wire format serves every worker language).
+    A length word with _OOB_FLAG set carries raw buffer segments after the
+    pickle body (see the framing comment at the top)."""
     hdr = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(hdr)
+    oob = bool(n & _OOB_FLAG)
+    n &= ~_OOB_FLAG
     if n > MAX_MSG:
         raise ConnectionError(f"oversized frame: {n}")
     body = await reader.readexactly(n)
+    if oob:
+        sizes, pbody = _parse_oob(body)
+        segs = [await reader.readexactly(s) for s in sizes]
+        return pickle.loads(pbody, buffers=segs), CODEC_PICKLE
     if body[:1] == b"{":
         import json
 
@@ -185,19 +249,80 @@ def _json_safe(value):
     return repr(value)
 
 
-def _frame(msg: dict, codec: str = CODEC_PICKLE) -> bytes:
+def _frame_parts(msg: dict, codec: str = CODEC_PICKLE) -> list:
+    """Frame `msg` as a list of bytes-like parts for a vectored write.
+    Large WireBuffer payloads become out-of-band segments: the raw views go
+    straight from their source buffer (often an shm mapping) to the socket
+    — no pickle in-band copy of the bulk bytes."""
     if codec == CODEC_JSON:
         import json
 
         body = json.dumps(_json_safe(msg)).encode()
-    else:
-        body = pickle.dumps(msg, protocol=5)
-    return _LEN.pack(len(body)) + body
+        return [_LEN.pack(len(body)), body]
+    segs: list = []
+
+    def _extract(pb) -> bool:
+        mv = pb.raw()
+        if mv.nbytes <= _SEG_INLINE_MAX:
+            return True  # small: serialize in-band, not worth a segment
+        segs.append(mv)
+        return False
+
+    body = pickle.dumps(msg, protocol=5, buffer_callback=_extract)
+    if not segs:
+        return [_LEN.pack(len(body)), body]
+    seg_hdr = _NSEG.pack(len(segs)) + b"".join(
+        _LEN.pack(s.nbytes) for s in segs
+    )
+    return [
+        _LEN.pack((len(seg_hdr) + len(body)) | _OOB_FLAG),
+        seg_hdr,
+        body,
+        *segs,
+    ]
+
+
+def _frame(msg: dict, codec: str = CODEC_PICKLE) -> bytes:
+    return b"".join(_frame_parts(msg, codec))
 
 
 async def send_msg(writer: asyncio.StreamWriter, msg: dict) -> None:
-    writer.write(_frame(msg))
+    writer.writelines(_frame_parts(msg))
     await writer.drain()
+
+
+def _recv_exact_sync(sock, size: int) -> bytearray:
+    buf = bytearray(size)
+    view = memoryview(buf)
+    got = 0
+    while got < size:
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += n
+    return buf
+
+
+def write_frame_sync(sock, msg: dict) -> None:
+    """Blocking-socket twin of send_msg (the worker bypass channel)."""
+    for part in _frame_parts(msg):
+        sock.sendall(part)
+
+
+def read_frame_sync(sock) -> dict:
+    """Blocking-socket twin of read_msg, OOB-aware (pickle frames only —
+    the bypass channel is python-to-python)."""
+    (n,) = _LEN.unpack(bytes(_recv_exact_sync(sock, _LEN.size)))
+    oob = bool(n & _OOB_FLAG)
+    n &= ~_OOB_FLAG
+    if n > MAX_MSG:
+        raise ConnectionError(f"oversized frame: {n}")
+    body = _recv_exact_sync(sock, n)
+    if oob:
+        sizes, pbody = _parse_oob(body)
+        segs = [_recv_exact_sync(sock, s) for s in sizes]
+        return pickle.loads(pbody, buffers=segs)
+    return pickle.loads(bytes(body))
 
 
 class Connection:
@@ -380,7 +505,10 @@ class Connection:
                 # conn-lost warning counter never fires, but keep the
                 # raise so callers still detect the dead peer.
                 raise ConnectionResetError("peer connection closed")
-            self.writer.write(_frame(msg, codec or self.codec))
+            # vectored write of the frame parts: OOB segments (slab views)
+            # go straight to the transport without being joined into one
+            # contiguous bytes object first
+            self.writer.writelines(_frame_parts(msg, codec or self.codec))
             await self.writer.drain()
 
     async def request(

@@ -55,10 +55,22 @@ class SerializedObject:
             for b in self.buffers
         )
 
-    def __reduce__(self):
-        wire_buffers = [
-            bytes(b) if isinstance(b, memoryview) else b for b in self.buffers
-        ]
+    def __reduce_ex__(self, protocol):
+        if protocol >= 5:
+            # PickleBuffer wrap: on the plane framing these ride as raw
+            # out-of-band segments (protocol._frame_parts) — a big task
+            # arg/return crossing a socket is never copied through pickle.
+            # Without a buffer_callback (plain dumps) they serialize
+            # in-band and load back as bytes, so every caller still works.
+            wire_buffers = [
+                pickle.PickleBuffer(b) if isinstance(b, memoryview) else b
+                for b in self.buffers
+            ]
+        else:
+            wire_buffers = [
+                bytes(b) if isinstance(b, memoryview) else b
+                for b in self.buffers
+            ]
         return (
             _rebuild_envelope,
             (self.payload, wire_buffers, self.contained_refs, self.is_error),
@@ -167,29 +179,71 @@ def materialize(env: SerializedObject, shm_client) -> SerializedObject:
     if missing:
         by_node: dict = {}
         for buf in missing:
-            by_node.setdefault(buf.node or "", []).append(buf.name)
-        for node, names in by_node.items():
-            # bulk plane first: chunked pull straight from the owning
-            # node's agent (object_manager.h:117); the head relay is the
-            # fallback (and the only path for head-owned buffers, where
-            # the head IS the owner)
-            got = None
-            if node and node != my_node:
-                got = global_worker.fetch_buffers_direct(node, names)
-            if got is None:
-                got = global_worker.request(
-                    {"t": "fetch_buffers", "names": names, "node": node}
-                )
+            by_node.setdefault(buf.node or "", []).append(buf)
+        for node, bufs in by_node.items():
+            # bulk plane first: zero-copy pull straight from the owning
+            # node (object_manager.h:117) — the sizes in the refs let the
+            # consumer recv_into preallocated slab space; the head relay
+            # is the fallback (and the only path for head-owned buffers,
+            # where the head IS the owner)
+            direct_eligible = bool(node) and node != my_node
+            if direct_eligible:
+                got = global_worker.fetch_buffers_direct(node, bufs)
+                if got is not None:
+                    # already slab-resident (recv_into landed there) — no
+                    # re-cache; a None value means the OWNER lost it
+                    for name, data in got.items():
+                        if data is None:
+                            raise ObjectLostError(name)
+                        resolved[name] = memoryview(data)
+                    continue
+                _count_relay_fallback()
+            got = global_worker.request(
+                {
+                    "t": "fetch_buffers",
+                    "names": [b.name for b in bufs],
+                    "node": node,
+                }
+            )
+            _account_relay(got)
             for name, data in got.items():
                 if data is None:
                     raise ObjectLostError(name)
+                mv = None
                 if shm_client is not None:
-                    shm_client.create(name, data)  # best-effort local cache
-                resolved[name] = memoryview(data)
+                    # cache into the local slab, then RESOLVE AGAINST THE
+                    # SLAB COPY — the transient receive buffer becomes
+                    # droppable instead of living on under the envelope
+                    ref2 = shm_client.create(name, data)
+                    if ref2 is not None:
+                        mv = shm_client.get(ref2)
+                resolved[name] = mv if mv is not None else memoryview(data)
     env.buffers = [
         resolved[b.name] if isinstance(b, ShmBufferRef) else b for b in env.buffers
     ]
     return env
+
+
+def _count_relay_fallback() -> None:
+    """A direct node-to-node pull failed and the fetch is falling back to
+    the head relay — make that visible (chaos tests assert on it)."""
+    try:
+        from ray_tpu.util import metrics as _m
+
+        _m.bulk_plane_fallbacks_counter().inc()
+    except Exception:
+        pass
+
+
+def _account_relay(got: dict) -> None:
+    try:
+        from .bulk import account
+
+        for data in got.values():
+            if data is not None:
+                account("relay", len(data))
+    except Exception:
+        pass
 
 
 def shm_buffer_names(env: SerializedObject):

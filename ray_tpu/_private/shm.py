@@ -186,6 +186,86 @@ def connect_for_session(session_dir: str):
         return None
 
 
+def attach_peer_plane(session: str) -> Optional["ShmClient"]:
+    """Attach to ANOTHER node's shm plane when it lives on this machine
+    (colocated test clusters, multi-agent hosts). shm_store_connect creates
+    the store if missing, so probe the control segment first — blindly
+    attaching to a dead peer would materialize a fresh empty store and mask
+    the miss. Returns None when the peer plane is not on this host."""
+    from .config import GLOBAL_CONFIG as cfg
+
+    if not cfg.shm_store_enabled or not session:
+        return None
+    if not os.path.exists(f"/dev/shm/rtpu_{session}_ctrl"):
+        return None
+    try:
+        return ShmClient(session, cfg.shm_store_bytes)
+    except Exception:
+        return None
+
+
+class PendingBuffer:
+    """An unsealed shm allocation exposing a writable view, so consumers can
+    recv_into the destination slab directly (zero intermediate copy). Must
+    end in commit() or abort(): unsealed objects are never LRU-evictable, so
+    an abandoned mapping would leak capacity forever — a weakref finalizer
+    aborts as a safety net if the owner drops the object without deciding."""
+
+    __slots__ = (
+        "_client", "name", "size", "_ptr", "view", "_done", "_finalizer",
+        "__weakref__",
+    )
+
+    def __init__(self, client: "ShmClient", name: str, size: int, ptr: int):
+        import weakref
+
+        self._client = client
+        self.name = name
+        self.size = size
+        self._ptr = ptr
+        self.view = (
+            memoryview((ctypes.c_char * size).from_address(ptr)).cast("B")
+            if size
+            else memoryview(bytearray(0))
+        )
+        self._done = False
+        self._finalizer = weakref.finalize(
+            self, _abort_pending, client.lib, client.handle, name.encode(), ptr
+        )
+
+    def commit(self) -> ShmBufferRef:
+        if self._done:
+            raise RuntimeError(f"pending buffer {self.name} already finished")
+        self._done = True
+        self._finalizer.detach()  # the sealed object must survive our GC
+        self.view = memoryview(b"")  # drop the writable alias before sealing
+        self._client.lib.shm_store_seal(self._client.handle, self.name.encode())
+        self._client.lib.shm_store_release(
+            self._client.handle, self.name.encode(), self._ptr
+        )
+        return ShmBufferRef(name=self.name, size=self.size)
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._finalizer.detach()
+        self.view = memoryview(b"")
+        _abort_pending(
+            self._client.lib, self._client.handle, self.name.encode(), self._ptr
+        )
+
+
+def _abort_pending(lib, handle, name_bytes, ptr):
+    """Release + delete an unsealed allocation (idempotent: delete of a
+    missing/other-generation name is a no-op in the store)."""
+    try:
+        lib.shm_store_release(handle, name_bytes, ptr)
+        lib.shm_store_delete(handle, name_bytes)
+    except Exception:
+        pass
+
+
 class ShmClient:
     def __init__(self, session: str, capacity_bytes: int):
         self.session = session
@@ -235,24 +315,9 @@ class ShmClient:
             return None  # disconnected (shutdown): treat as store-full
         data = memoryview(data)
         size = data.nbytes
-        ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
+        ptr = self._alloc(name, size, pin)
         if not ptr:
-            # LRU-evict evictable objects and retry (plasma eviction
-            # contract: the head reconstructs evicted ids on demand); if
-            # everything left is pinned (no lineage), spill it to disk
-            want = max(size * 2, 1 << 20)
-            if self.lib.shm_store_evict(self.handle, want) > 0:
-                ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
-            if not ptr:
-                os.makedirs(self.spill_dir, exist_ok=True)
-                if self.lib.shm_store_spill_pinned(
-                    self.handle, want, self.spill_dir.encode()
-                ) > 0:
-                    ptr = self.lib.shm_store_create(
-                        self.handle, name.encode(), size, int(pin)
-                    )
-            if not ptr:
-                return None
+            return None
         try:
             _copy_into(ptr, data, size)
         except BaseException:
@@ -264,6 +329,42 @@ class ShmClient:
         self.lib.shm_store_seal(self.handle, name.encode())
         self.lib.shm_store_release(self.handle, name.encode(), ptr)
         return ShmBufferRef(name=name, size=size)
+
+    def _alloc(self, name: str, size: int, pin: bool) -> Optional[int]:
+        """Allocate an unsealed mapping, retrying through the LRU-evict /
+        spill-pinned chain (plasma eviction contract: the head reconstructs
+        evicted ids on demand; pinned lineage-free data spills to disk)."""
+        ptr = self.lib.shm_store_create(self.handle, name.encode(), size, int(pin))
+        if not ptr:
+            want = max(size * 2, 1 << 20)
+            if self.lib.shm_store_evict(self.handle, want) > 0:
+                ptr = self.lib.shm_store_create(
+                    self.handle, name.encode(), size, int(pin)
+                )
+            if not ptr:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                if self.lib.shm_store_spill_pinned(
+                    self.handle, want, self.spill_dir.encode()
+                ) > 0:
+                    ptr = self.lib.shm_store_create(
+                        self.handle, name.encode(), size, int(pin)
+                    )
+        return ptr or None
+
+    def create_uninitialized(
+        self, name: str, size: int, pin: bool = False
+    ) -> Optional[PendingBuffer]:
+        """Allocate an UNSEALED buffer and hand back a writable view, so the
+        bulk plane can recv_into the destination slab directly (the ≤1-copy
+        pull path). The caller must commit() (seal, making it readable) or
+        abort() (free the capacity). Returns None when the store is full
+        even after eviction/spill, like create()."""
+        if self.handle is None:
+            return None
+        ptr = self._alloc(name, size, pin)
+        if not ptr:
+            return None
+        return PendingBuffer(self, name, size, ptr)
 
     def get(self, ref: ShmBufferRef) -> Optional[memoryview]:
         """Map a sealed object read-only, zero-copy. The mapping is unmapped

@@ -350,6 +350,20 @@ class Replica:
         }
         out.update(self._batcher_stats())
         try:
+            # bulk-plane transfer health in THIS replica process (weight
+            # pulls, big args/returns): pulls/bytes by path — fleet work
+            # reads it off replica stats without a metrics scrape
+            from ray_tpu.util import metrics as _bm
+
+            pulls = _bm.local_counter_by_tag("bulk_plane_pulls_total", "path")
+            if pulls:
+                out["bulk_pulls_by_path"] = pulls
+                out["bulk_bytes_by_path"] = _bm.local_counter_by_tag(
+                    "bulk_plane_bytes_total", "path"
+                )
+        except Exception:
+            pass
+        try:
             from . import telemetry
 
             tel = telemetry.get_telemetry()

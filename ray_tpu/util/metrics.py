@@ -248,6 +248,52 @@ def data_plane_duplicate_replies_counter() -> Counter:
     )
 
 
+def bulk_plane_bytes_counter() -> Counter:
+    """Bytes moved by the bulk object plane, by transfer path (same shared
+    single-definition discipline as data_plane_orphaned_counter)."""
+    return Counter(
+        "bulk_plane_bytes_total",
+        "bytes pulled over the bulk object plane, tagged by path: "
+        "direct (single-socket / same-host slab), striped (parallel "
+        "READ_RANGE sockets), relay (through the head), spilled "
+        "(served from a peer's spill file)",
+        tag_keys=("path",),
+    )
+
+
+def bulk_plane_pulls_counter() -> Counter:
+    return Counter(
+        "bulk_plane_pulls_total",
+        "buffers pulled over the bulk object plane, tagged by path "
+        "(direct | striped | relay | spilled)",
+        tag_keys=("path",),
+    )
+
+
+def bulk_plane_fallbacks_counter() -> Counter:
+    return Counter(
+        "bulk_plane_fallbacks_total",
+        "direct node-to-node pulls that failed (peer death, socket loss, "
+        "timeout) and fell back to the head relay",
+        tag_keys=(),
+    )
+
+
+def local_counter_by_tag(name: str, tag_key: str) -> Dict[str, float]:
+    """THIS process's counter totals grouped by one tag's value (stats
+    surfaces, no cluster round trip). Empty dict when absent/never inc'd."""
+    with _REGISTRY.lock:
+        m = _REGISTRY.metrics.get(name)
+    if m is None or not isinstance(m, Counter):
+        return {}
+    out: Dict[str, float] = {}
+    with m._lock:
+        for tags, v in m._values.items():
+            key = dict(tags).get(tag_key, "") or "untagged"
+            out[key] = out.get(key, 0.0) + v
+    return out
+
+
 def flush():
     """Force-push this process's metrics to the head."""
     _REGISTRY.maybe_flush(force=True)

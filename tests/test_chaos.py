@@ -336,3 +336,132 @@ def test_soak_data_plane_script():
     assert p.returncode == 0, (
         f"soak failed\nstdout:\n{p.stdout[-4000:]}\nstderr:\n{p.stderr[-4000:]}"
     )
+
+
+# ---------------------------------------------------------------------------
+# Bulk-plane chaos: the direct pull path dying mid-transfer must degrade to
+# the head relay with checksum-identical bytes (never corruption, never a
+# wedge) and make the fallback visible in the counters.
+# ---------------------------------------------------------------------------
+
+
+def _bulk_consume_fn():
+    """Task body shared by the bulk chaos tests: hash the pulled array and
+    report this worker's bulk-plane counters (the dep materialized in THIS
+    process right before the body ran, so the counters are its verdict)."""
+    import hashlib
+
+    from ray_tpu.util import metrics as m
+
+    def consume(x):
+        return {
+            "digest": hashlib.sha256(x.tobytes()).hexdigest(),
+            "fallbacks": sum(
+                m.local_counter_by_tag(
+                    "bulk_plane_fallbacks_total", "path"
+                ).values()
+            ),
+            "pulls": m.local_counter_by_tag("bulk_plane_pulls_total", "path"),
+        }
+
+    return consume
+
+
+def _bulk_chaos_cluster(monkeypatch, fault, extra_env=()):
+    """Arm the fault + force the socket path BEFORE any agent spawns (they
+    inherit the env; the driver imported faults un-armed long ago)."""
+    monkeypatch.setenv("RAY_TPU_FAULTS", fault)
+    monkeypatch.setenv("RAY_TPU_BULK_SAME_HOST", "0")
+    for k, v in extra_env:
+        monkeypatch.setenv(k, v)
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"bsrc": 1})
+    c.add_node(num_cpus=2, resources={"bdst": 1})
+    return c
+
+
+def _run_bulk_chaos(c, nbytes):
+    import hashlib
+
+    import numpy as np
+
+    @ray_tpu.remote(resources={"bsrc": 0.1})
+    def produce():
+        rng = np.random.default_rng(21)
+        return rng.integers(0, 256, nbytes, dtype=np.uint8)
+
+    @ray_tpu.remote(resources={"bsrc": 0.1})
+    def src_digest(x):
+        return hashlib.sha256(x.tobytes()).hexdigest()
+
+    consume = ray_tpu.remote(resources={"bdst": 0.1})(_bulk_consume_fn())
+
+    ref = produce.remote()
+    expected = ray_tpu.get(src_digest.remote(ref), timeout=120)
+    out = ray_tpu.get(consume.remote(ref), timeout=120)
+    return expected, out
+
+
+@pytest.mark.faults
+def test_bulk_midstream_close_falls_back_to_relay(monkeypatch):
+    """The owning agent serves HALF the reply then closes the socket
+    (bulk_close:1 = first bulk request it receives): the consumer's direct
+    pull fails, the fetch falls back to the head relay, and the bytes land
+    checksum-identical with the fallback counter bumped."""
+    c = _bulk_chaos_cluster(monkeypatch, "bulk_close:1")
+    try:
+        expected, out = _run_bulk_chaos(c, 8 << 20)
+        assert out["digest"] == expected
+        assert out["fallbacks"] >= 1
+        assert out["pulls"].get("relay", 0) >= 1
+        assert out["pulls"].get("direct", 0) == 0
+        stats = worker_mod.global_worker.request({"t": "object_stats"})
+        assert stats["relay_bytes"] >= (8 << 20)  # the relay really carried it
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.faults
+def test_bulk_striped_pull_socket_loss_falls_back_to_relay(monkeypatch):
+    """A striped pull (3 sockets over a 12MB buffer) loses ONE socket
+    mid-stripe (bulk_close:2 = second of the three concurrent stripe
+    requests): the whole pull aborts — no partial stripes are ever
+    committed — and the relay fallback lands checksum-identical."""
+    c = _bulk_chaos_cluster(
+        monkeypatch,
+        "bulk_close:2",
+        extra_env=(
+            ("RAY_TPU_BULK_STRIPE_SOCKETS", "3"),
+            ("RAY_TPU_BULK_STRIPE_MIN_BYTES", str(1 << 20)),
+        ),
+    )
+    try:
+        expected, out = _run_bulk_chaos(c, 12 << 20)
+        assert out["digest"] == expected
+        assert out["fallbacks"] >= 1
+        assert out["pulls"].get("relay", 0) >= 1
+        # the faulted striped pull must NOT have been accounted as served
+        assert out["pulls"].get("striped", 0) == 0
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.faults
+def test_bulk_blackholed_peer_times_out_to_relay(monkeypatch):
+    """bulk_blackhole swallows the request (socket open, no reply): the
+    consumer's read deadline turns the silence into a failed pull and the
+    relay fallback still delivers intact bytes."""
+    c = _bulk_chaos_cluster(
+        monkeypatch,
+        "bulk_blackhole:1",
+        extra_env=(("RAY_TPU_BULK_READ_TIMEOUT_S", "3"),),
+    )
+    try:
+        t0 = time.time()
+        expected, out = _run_bulk_chaos(c, 4 << 20)
+        assert out["digest"] == expected
+        assert out["fallbacks"] >= 1
+        assert out["pulls"].get("relay", 0) >= 1
+        assert time.time() - t0 < 60  # bounded by the read deadline, no wedge
+    finally:
+        c.shutdown()
