@@ -674,6 +674,96 @@ def bench_prefix_hit(trials: int = 3) -> dict:
     }
 
 
+def bench_serve_cross_replica(trials: int = 3) -> dict:
+    """Cross-replica prefix transfer win, gated (--only row): serving a
+    prompt whose prefix blocks arrive from a PEER engine over the
+    transfer path (export -> pack -> wire-check -> unpack -> import ->
+    admit) must beat the cold full prefill of the same prompt by >= 1.5x
+    — the import pays numpy copies plus a pool scatter instead of
+    recomputing attention over the whole shared span. The speedup only
+    counts if the importing engine's greedy continuation is TOKEN-
+    IDENTICAL to the cold engine's: any divergence zeroes the metric
+    (and so fails the gate) — a fast wrong answer is worthless."""
+    import dataclasses
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS, init_params
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.serve.kv_transfer import pack_payload, unpack_payload
+
+    bt = 32
+    cfg = dataclasses.replace(CONFIGS["tiny"], max_seq_len=1152)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def mk():
+        return PagedDecodeEngine(
+            cfg, params, max_batch_size=2, seed=0, block_tokens=bt,
+            num_blocks=192, model_id="bench",
+        )
+
+    def gen(eng, prompt, payload=None):
+        """(time-to-first-token ms, greedy tokens) for one generation."""
+        req = {"tokens": prompt, "max_new_tokens": 8}
+        if payload is not None:
+            req["kv_import"] = payload
+        t0 = time.perf_counter()
+        tok, done = eng.admit(0, req)
+        ttft = (time.perf_counter() - t0) * 1000
+        out = [tok]
+        while not done:
+            tok, done = eng.step([0])[0]
+            out.append(tok)
+        eng.release(0)
+        return ttft, out
+
+    rng = np.random.default_rng(0)
+    plen = 31 * bt + 1  # a ~1k shared span dwarfs the per-request tail
+    # three long-lived engines, as in a real fleet: the peer that computed
+    # the prefix, the replica that imports it, the replica that recomputes
+    # it cold. Each is warmed on a throwaway prompt first (per-engine jit
+    # closures: a fresh engine's first admit pays ~40x in compile) — every
+    # trial's prompt is fresh, so the cold engine's admit stays a true miss
+    src, dst, cold = mk(), mk(), mk()
+    warm = rng.integers(0, cfg.vocab_size, size=plen)
+    gen(src, warm)
+    gen(cold, warm)
+    gen(dst, warm, unpack_payload(*pack_payload(
+        src.export_prefix(np.asarray(warm, np.int32))
+    )))
+    cold_ts, imp_ts, identical, payload_bytes = [], [], True, 0
+    for _ in range(trials):
+        prompt = rng.integers(0, cfg.vocab_size, size=plen)
+        _, out_src = gen(src, prompt)  # peer computes + caches the chain
+        cold_ms, out_cold = gen(cold, prompt)
+        # the import path pays: export gather + pack + wire check + unpack
+        # + pool scatter + tail-only admit (the decode tail is identical
+        # on both paths and counted in neither — gen times admit only)
+        t0 = time.perf_counter()
+        meta, buf = pack_payload(
+            src.export_prefix(np.asarray(prompt, np.int32))
+        )
+        payload = unpack_payload(meta, buf)
+        transfer_ms = (time.perf_counter() - t0) * 1000
+        imp_ttft, out_imp = gen(dst, prompt, payload)
+        cold_ts.append(cold_ms)
+        imp_ts.append(transfer_ms + imp_ttft)
+        payload_bytes = int(buf.size)
+        identical = identical and (out_src == out_cold == out_imp)
+    cold_ms = statistics.median(cold_ts)
+    imp_ms = statistics.median(imp_ts)
+    speedup = cold_ms / max(imp_ms, 1e-9) if identical else 0.0
+    return {
+        "cross_replica_cold_ttft_ms": round(cold_ms, 2),
+        "cross_replica_import_ms": round(imp_ms, 2),
+        "cross_replica_payload_mb": round(payload_bytes / 2**20, 3),
+        "cross_replica_greedy_identical": identical,
+        "cross_replica_prefix_hit_speedup_x": round(speedup, 2),
+    }
+
+
 def bench_decode_telemetry_overhead(
     new_tokens: int = 128, batch: int = 8,
 ) -> dict:
@@ -982,6 +1072,11 @@ GATES = {
     "decode_batched_speedup_x": (">=", 2.0),
     # a prefix-cache hit must beat the cold prefill of the same prompt
     "prefix_hit_speedup_x": (">=", 2.0),
+    # a CROSS-REPLICA prefix hit (export -> pack -> wire-check -> unpack
+    # -> import on a peer engine) must still beat recomputing the prefill
+    # locally; greedy identity is asserted in-row — divergence zeroes the
+    # metric. --only row, not part of the full-sweep trials (see `gated`)
+    "cross_replica_prefix_hit_speedup_x": (">=", 1.5),
     # block-in-place paged attention must beat the block-table gather at
     # the same dtype in the long-context (bandwidth-bound) decode regime
     "decode_long_context_fused_speedup_x": (">=", 1.1),
@@ -1049,11 +1144,15 @@ def main():
     import subprocess
 
     n_trials = int(os.environ.get("RAY_TPU_MICROBENCH_TRIALS", "5"))
-    # every GATES entry is trial-gated except cross-node, which needs its
-    # own 2-node cluster and is measured once in THIS process — derived,
-    # not hand-listed, so a new gate cannot be silently dropped from the
-    # sweep's judgment
-    gated = tuple(k for k in GATES if k != "cross_node_256mb_gbps")
+    # every GATES entry is trial-gated except cross-node (needs its own
+    # 2-node cluster, measured once in THIS process) and the cross-replica
+    # transfer row (a dedicated --only CI step) — derived, not hand-listed,
+    # so a new gate cannot be silently dropped from the sweep's judgment
+    gated = tuple(
+        k for k in GATES
+        if k not in ("cross_node_256mb_gbps",
+                     "cross_replica_prefix_hit_speedup_x")
+    )
     expected = set(gated) | {"host_memcpy_gbps"}
     trials = []
     # trial 0 is a WARMUP, discarded: it faults in the interpreter/page
@@ -1189,6 +1288,8 @@ ROWS = {
     "decode_telemetry_overhead": (bench_decode_telemetry_overhead, False,
                                   ("decode_telemetry_overhead_ratio_x",)),
     "prefix_hit": (bench_prefix_hit, False, ("prefix_hit_speedup_x",)),
+    "serve_cross_replica": (bench_serve_cross_replica, False,
+                            ("cross_replica_prefix_hit_speedup_x",)),
     "task_submit": (lambda: {"task_submit_per_s": round(bench_task_submit(), 1)},
                     True, ("task_submit_per_s",)),
     "actor_sync": (lambda: {"actor_calls_sync_per_s": round(bench_actor_sync(), 1)},

@@ -313,6 +313,41 @@ D("serve_kv_prefix_cache", bool, True,
   "prompt prefixes (system prompts, few-shot headers) share physical "
   "blocks and skip prefill for the shared span; cache-held blocks are "
   "evicted LRU under pool pressure")
+D("serve_kv_transfer", bool, True,
+  "cluster-wide KV plane (serve/kv_transfer.py): replicas export cached "
+  "prefix blocks on request and import peers' blocks before prefill, so "
+  "a prefix computed anywhere in the deployment is a hit everywhere; "
+  "any transfer failure falls back to local recompute — never wrong "
+  "tokens. Off = every replica's PrefixCache stays private")
+D("serve_kv_transfer_min_blocks", int, 1,
+  "minimum full prompt blocks below which a replica does not attempt a "
+  "remote prefix pull (the transfer round-trip must be worth more than "
+  "the prefill it saves)")
+D("serve_prefix_affinity", bool, False,
+  "prefix-affinity routing: the controller aggregates a bounded LRU "
+  "prefix->replica digest from replica stats and publishes it over "
+  "long-poll; handles break power-of-two-choices ties toward the "
+  "replica advertising the longest cached chain for the request's "
+  "prefix hint. Plain load wins when queue depth diverges (see "
+  "serve_prefix_affinity_max_skew) so affinity cannot create hotspots")
+D("serve_prefix_affinity_max_skew", int, 2,
+  "max in-flight-request excess the affinity replica may carry over the "
+  "two-choices winner and still take the request; beyond it the load "
+  "pick wins — the hotspot cap")
+D("serve_prefix_hint_tokens", int, 64,
+  "leading prompt tokens hashed into the prefix hint used by affinity "
+  "routing and the replica-side digest; proxy, handle and replicas must "
+  "agree, so this is config (not engine geometry)")
+D("serve_prefix_digest_size", int, 512,
+  "per-deployment cap on the controller's prefix->replica digest "
+  "(bounded LRU: oldest hint evicted first)")
+D("serve_disaggregate", bool, False,
+  "disaggregated prefill/decode default for kv_transfer.deploy_"
+  "disaggregated(): prefill-tagged replicas run chunked prefill to "
+  "completion and hand committed blocks to a decode replica over the "
+  "transfer path; decode resumes token-for-token identically (greedy). "
+  "The two pools scale on the existing autoscaling signals — block "
+  "saturation (prefill) and batch occupancy (decode)")
 D("train_dist_heartbeat_timeout_s", int, 30,
   "upper bound on detecting a dead jax.distributed gang peer: the "
   "coordination-service heartbeat interval/missing-count are derived "

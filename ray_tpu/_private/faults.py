@@ -35,6 +35,12 @@ Directives
   bulk_blackhole:<sel>       swallow the selected bulk-plane request — no
                              reply, socket stays open (the consumer's read
                              timeout fires)
+  kv_transfer_drop:<sel>     corrupt the selected cross-replica KV
+                             transfer mid-flight (serve/kv_transfer.py
+                             truncates the packed payload before it
+                             ships): the importer's verification fails
+                             and the request falls back to local
+                             recompute — never wrong tokens
 
 ``<sel>`` is a 1-based occurrence number (``1`` = first match) or
 ``rand:<p>`` (fire with probability p, seeded). Counters are per-directive
@@ -112,6 +118,12 @@ class FaultController:
                 # the second field IS the selector (may contain ':' — rand:<p>)
                 self.directives.append(
                     _Directive(kind, "bulk", ":".join(fields[1:]))
+                )
+            elif kind == "kv_transfer_drop":
+                if len(fields) < 2:
+                    raise ValueError(f"fault directive needs 2 fields: {part!r}")
+                self.directives.append(
+                    _Directive(kind, "kv", ":".join(fields[1:]))
                 )
             else:
                 raise ValueError(f"unknown fault directive kind: {part!r}")
@@ -191,6 +203,18 @@ class FaultController:
                             action = (
                                 "close" if d.kind == "bulk_close" else "blackhole"
                             )
+        return action
+
+    def kv_transfer_action(self) -> Optional[str]:
+        """'drop' (corrupt this cross-replica KV transfer mid-flight) or
+        None, for one export being packed for the wire."""
+        action = None
+        with self._lock:
+            for d in self.directives:
+                if d.kind == "kv_transfer_drop":
+                    if self._selected(d):
+                        self._record(d)
+                        action = "drop"
         return action
 
     def before_task(self, fn_name: str) -> None:
@@ -282,6 +306,11 @@ def before_task(fn_name: str) -> None:
 def bulk_action() -> Optional[str]:
     c = _CTL
     return c.bulk_action() if c is not None else None
+
+
+def kv_transfer_action() -> Optional[str]:
+    c = _CTL
+    return c.kv_transfer_action() if c is not None else None
 
 
 # Env arming at import: worker processes import this via protocol.py at

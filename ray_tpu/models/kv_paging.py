@@ -275,6 +275,7 @@ class PagedDecodeEngine:
         drafter=None,
         prefill_chunk_tokens: Optional[int] = None,
         telemetry=None,
+        model_id: Optional[str] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -322,6 +323,27 @@ class PagedDecodeEngine:
         self.kv_cache_dtype = kv_cache_dtype
         kv_dtype = jnp.int8 if kv_cache_dtype == "int8" else cfg.dtype
         self.kv_block_bytes = paged_kv_block_bytes(cfg, bt, kv_dtype)
+
+        # cross-replica transfer identity (serve/kv_transfer.py): two
+        # engines produce matching export keys iff they agree on every
+        # byte-layout-relevant knob — model identity, block geometry,
+        # pool storage dtype, layer/head shape. The signature SEEDS the
+        # content-addressed key chain, so keys minted under a different
+        # model / dtype / geometry can never collide with this pool's
+        # (the int8-into-fp poison case is unrepresentable by key
+        # construction, not merely checked at import).
+        self.model_id = str(
+            model_id if model_id is not None else gcfg.serve_model_id or ""
+        )
+        sig = hashlib.sha1()
+        sig.update(b"ray_tpu.kv_transfer.v1|")
+        sig.update(self.model_id.encode())
+        sig.update(
+            f"|bt={bt}|kv={self.kv_cache_dtype}"
+            f"|sd={np.dtype(kv_dtype).name}"
+            f"|L={cfg.n_layers}|H={cfg.n_kv_heads}|D={cfg.d_head}".encode()
+        )
+        self.transfer_sig = sig.digest()
 
         attention_impl = attention_impl or gcfg.serve_paged_attention
         fused_impl = "auto"
@@ -533,6 +555,13 @@ class PagedDecodeEngine:
         self.spec_accepted = 0
         self.spec_emitted = 0
         self.spec_shapes: set = set()  # K1 widths the verify step compiled
+        # cross-replica KV transfer counters (serve/kv_transfer.py)
+        self.kv_exports = 0
+        self.kv_blocks_exported = 0
+        self.kv_imports = 0
+        self.kv_blocks_imported = 0
+        self.kv_tokens_imported = 0
+        self.kv_import_rejects = 0
 
     # ------------------------------------------------------------- internals
 
@@ -694,6 +723,16 @@ class PagedDecodeEngine:
             )
         if self._live[slot]:
             self._release_blocks(slot)
+
+        # cross-replica import: a transfer payload riding the request is
+        # applied BEFORE the prefix lookup, so the imported chain is hit
+        # by the normal admission path below (refcounted exactly like
+        # locally-computed blocks). A payload that fails verification is
+        # dropped — the lookup just misses and the span prefills from
+        # scratch (the recompute fallback).
+        kv_payload = request.get("kv_import")
+        if kv_payload is not None:
+            self.import_prefix(kv_payload, slot=slot)
 
         # prefix reuse: longest chain of cached FULL blocks, capped at
         # length-1 so at least one real token remains to prefill (its
@@ -1237,6 +1276,167 @@ class PagedDecodeEngine:
             self._release_blocks(slot)
         self._new_counts[slot] = 0
 
+    # --------------------------------------------- cross-replica KV transfer
+
+    def transfer_keys(self, tokens, n_blocks: int) -> List[bytes]:
+        """Content-addressed keys for the prompt's first `n_blocks` FULL
+        blocks. The chain is seeded with `transfer_sig` (model_id + block
+        geometry + pool dtype + layer/head shape) and extended per block
+        with its int32 token bytes — so two replicas of the same
+        deployment compute identical keys for identical prefixes, in any
+        process, while engines differing in ANY layout knob compute
+        disjoint key spaces. This chain is deliberately separate from the
+        in-process PrefixCache key chain (which has no cross-engine
+        identity to carry)."""
+        prompt = np.asarray(tokens, np.int32)
+        bt = self.block_tokens
+        if prompt.size < n_blocks * bt:
+            raise ValueError(
+                f"need {n_blocks * bt} tokens for {n_blocks} blocks, "
+                f"got {prompt.size}"
+            )
+        keys: List[bytes] = []
+        key = self.transfer_sig
+        for bi in range(int(n_blocks)):
+            h = hashlib.sha1()
+            h.update(key)
+            h.update(np.ascontiguousarray(
+                prompt[bi * bt:(bi + 1) * bt], np.int32).tobytes())
+            key = h.digest()
+            keys.append(key)
+        return keys
+
+    def export_prefix(
+        self, tokens, max_blocks: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Export the longest cached chain of full blocks matching the
+        prompt prefix as a self-verifying payload: chain keys, the token
+        span they cover, and the block contents gathered from the pool
+        (k/v, plus k_scale/v_scale on int8 pools). Returns None on a
+        cache miss. Runs on the LOOP THREAD (same ownership contract as
+        admit/step — the match and the pool gather must see one
+        consistent pool state); serving code routes here via
+        ContinuousBatcher.run_on_loop."""
+        if self.prefix_cache is None:
+            return None
+        prompt = np.asarray(tokens, np.int32)
+        if prompt.ndim != 1:
+            return None
+        bt = self.block_tokens
+        cap = int(prompt.size) // bt
+        if max_blocks is not None:
+            cap = min(cap, int(max_blocks))
+        if cap <= 0:
+            return None
+        blocks = self.prefix_cache.match_blocks(prompt, cap)
+        if not blocks:
+            return None
+        n = len(blocks)
+        idx = np.asarray(blocks, np.int32)
+        payload = {
+            "sig": self.transfer_sig,
+            "keys": self.transfer_keys(prompt, n),
+            "tokens": np.ascontiguousarray(prompt[:n * bt], np.int32),
+            "block_tokens": bt,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "blocks": {
+                name: np.asarray(self.pool[name][:, idx])
+                for name in self.pool
+            },
+        }
+        self.kv_exports += 1
+        self.kv_blocks_exported += n
+        if self._rec is not None:
+            self._rec.record("kv_export",
+                             args={"blocks": n, "tokens": n * bt})
+        return payload
+
+    def import_prefix(self, payload: Dict[str, Any], slot: int = -1) -> int:
+        """Install an exported prefix into the local pool + PrefixCache.
+        Returns the number of tokens newly imported (0 = nothing new:
+        already cached locally, or the payload failed verification and
+        was dropped — callers treat 0-with-reject as the recompute
+        fallback). Verification is strict: the engine signature must
+        match, the chain keys must recompute from the shipped tokens, and
+        every block leaf must match the pool's slice shape and dtype — a
+        payload from a different model, kv dtype, or block geometry can
+        never be installed. Imported blocks end up held by the cache at
+        refcount 1, exactly like locally-computed chain blocks. Loop
+        thread only (admit() applies request-borne payloads itself)."""
+        import jax.numpy as jnp
+
+        bt = self.block_tokens
+        tokens = None
+        n = 0
+        ok = (
+            isinstance(payload, dict)
+            and payload.get("sig") == self.transfer_sig
+            and int(payload.get("block_tokens") or 0) == bt
+            and payload.get("kv_cache_dtype") == self.kv_cache_dtype
+        )
+        if ok:
+            tokens = np.asarray(payload.get("tokens"), np.int32)
+            keys = list(payload.get("keys") or ())
+            n = len(keys)
+            ok = (
+                n > 0 and tokens.ndim == 1 and tokens.size == n * bt
+                and self.transfer_keys(tokens, n) == keys
+            )
+        if ok:
+            blocks = payload.get("blocks")
+            ok = isinstance(blocks, dict) and set(blocks) == set(self.pool)
+            if ok:
+                for name, arr in blocks.items():
+                    ref = self.pool[name]
+                    want = (ref.shape[0], n) + tuple(ref.shape[2:])
+                    if (tuple(np.shape(arr)) != want
+                            or np.dtype(arr.dtype) != np.dtype(ref.dtype)):
+                        ok = False
+                        break
+        if not ok or self.prefix_cache is None:
+            self.kv_import_rejects += 1
+            if self._rec is not None:
+                self._rec.record("kv_import", slot=slot,
+                                 args={"rejected": True})
+            return 0
+        local = self.prefix_cache.match_blocks(tokens, n)
+        m = len(local)
+        if m >= n:
+            return 0  # whole span already cached locally — nothing to do
+        need = n - m
+        self._reclaim(need)
+        try:
+            new_blocks = self.allocator.alloc(need)
+        except InsufficientBlocksError:
+            # pool pressure, not payload fault — still a recompute
+            # fallback from the caller's point of view
+            self.kv_import_rejects += 1
+            if self._rec is not None:
+                self._rec.record("kv_import", slot=slot,
+                                 args={"rejected": True, "blocks": need})
+            return 0
+        idx = np.asarray(new_blocks, np.int32)
+        pool = dict(self.pool)
+        for name, arr in payload["blocks"].items():
+            src = jnp.asarray(np.asarray(arr)[:, m:n])
+            pool[name] = pool[name].at[:, idx].set(src)
+        self.pool = pool
+        # register increfs only the NEW nodes; dropping our allocation
+        # reference leaves them cache-held at refcount 1 — identical to a
+        # retired locally-computed chain
+        self.prefix_cache.register(tokens, local + new_blocks)
+        for b in new_blocks:
+            self.allocator.decref(b)
+        self.kv_imports += 1
+        self.kv_blocks_imported += need
+        self.kv_tokens_imported += need * bt
+        if self._rec is not None:
+            self._rec.record(
+                "kv_import", slot=slot,
+                args={"blocks": need, "reused": m, "tokens": need * bt},
+            )
+        return need * bt
+
     def stats(self) -> Dict[str, Any]:
         used = self.allocator.num_usable - self.allocator.num_free
         return {
@@ -1276,6 +1476,15 @@ class PagedDecodeEngine:
             ),
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_reused": self.prefix_tokens_reused,
+            # cross-replica KV transfer (serve/kv_transfer.py): rejects
+            # count payloads dropped at verification or under pool
+            # pressure — each one is a recompute fallback upstream
+            "kv_exports": self.kv_exports,
+            "kv_blocks_exported": self.kv_blocks_exported,
+            "kv_imports": self.kv_imports,
+            "kv_blocks_imported": self.kv_blocks_imported,
+            "kv_tokens_imported": self.kv_tokens_imported,
+            "kv_import_rejects": self.kv_import_rejects,
             "preemptions": self.preemptions,
             "cow_copies": self.cow_copies,
             # speculative decoding: k=0 means off; rates cover spec steps

@@ -36,6 +36,14 @@ from .ingress import HTTPException, Router, ingress  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .openai_api import OpenAICompletions, openai_app  # noqa: F401
 from .replica import ReplicaDrainingError, ReplicaStreamHandle  # noqa: F401
+from .kv_transfer import (  # noqa: F401
+    KVGenerationServer,
+    KVTransferError,
+    KVTransferManager,
+    deploy_disaggregated,
+    deploy_generation,
+    prefix_hint,
+)
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
 

@@ -412,6 +412,9 @@ class ContinuousBatcher:
         self._steps = 0
         # bounded: observability for tests/operators, not a flight recorder
         self._occupancy: "deque" = deque(maxlen=65536)
+        # cross-thread calls serviced by the loop thread (run_on_loop):
+        # (fn, result box, done event) triples, drained every iteration
+        self._loop_calls: "deque" = deque()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
         )
@@ -465,6 +468,32 @@ class ContinuousBatcher:
         """[(step, n_active, request_ids active that step), ...]"""
         return list(self._occupancy)
 
+    def run_on_loop(self, fn, timeout_s: float = 10.0):
+        """Run `fn()` on the batcher's loop thread and return its result.
+
+        The loop thread owns the engine (admit/step/release are not
+        thread-safe), so anything that must see one consistent engine
+        state — cross-replica prefix exports reading the pool, ad-hoc
+        engine surgery in tests — goes through here instead of touching
+        the engine from a request thread. Calls are drained at the top of
+        every loop iteration (the idle loop wakes at least every ~50ms).
+        Raises TimeoutError when the loop cannot service the call in
+        `timeout_s` and RuntimeError after close()."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        if self._shutdown:
+            raise RuntimeError("batcher is closed")
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        self._loop_calls.append((fn, box, done))
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"batcher loop did not service the call in {timeout_s}s"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             out = {
@@ -491,6 +520,10 @@ class ContinuousBatcher:
                           "kv_cache_dtype", "attention_impl",
                           "prefill_chunk_tokens", "prefill_chunks",
                           "chunked_prefills", "prefilling",
+                          "prefill_tokens", "prefix_tokens_reused",
+                          "kv_exports", "kv_blocks_exported",
+                          "kv_imports", "kv_blocks_imported",
+                          "kv_tokens_imported", "kv_import_rejects",
                           "spec_k", "spec_steps", "spec_slot_steps",
                           "spec_proposed_tokens", "spec_accepted_tokens",
                           "spec_emitted_tokens", "spec_accept_rate",
@@ -701,8 +734,21 @@ class ContinuousBatcher:
                 self._holdback.appendleft((stream, parked))
                 self._admission_dirty = True  # blocks freed by the eviction
 
+    def _run_loop_calls(self) -> None:
+        while self._loop_calls:
+            try:
+                fn, box, done = self._loop_calls.popleft()
+            except IndexError:
+                return
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 — caller re-raises
+                box["error"] = e
+            done.set()
+
     def _loop(self) -> None:
         while not self._shutdown:
+            self._run_loop_calls()
             if not self._active:
                 if self._draining:
                     self._bounce_pending()
@@ -817,3 +863,12 @@ class ContinuousBatcher:
                     stream._finish(cut=True)
                     self._retire(slot)
                 self._cut_parked()
+        # loop exit (close()): fail parked cross-thread calls, or their
+        # callers would block until their timeout
+        while self._loop_calls:
+            try:
+                _, box, done = self._loop_calls.popleft()
+            except IndexError:
+                break
+            box["error"] = RuntimeError("batcher loop exited")
+            done.set()

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from .autoscaling import calculate_desired_num_replicas
@@ -29,6 +30,10 @@ class _DeploymentState:
         self.config: DeploymentConfig = config
         self.replicas: List[Any] = []  # ActorHandles
         self.draining = False  # whole deployment slated for removal
+        # prefix-affinity digest: hint -> (replica actor_id, cached chain
+        # depth in blocks). Bounded LRU, harvested from replica stats on
+        # the heartbeat and published over serve:prefix:<name>.
+        self.prefix_digest: "OrderedDict[str, tuple]" = OrderedDict()
         self.target: int = (
             config.autoscaling_config.min_replicas
             if config.autoscaling_config
@@ -495,6 +500,57 @@ class ServeController:
             state.last_scale_ts = now
             self._reconcile(state)
 
+    def _harvest_prefix_digest(self, state: _DeploymentState):
+        """Fold every replica's advertised prefix digest (hint -> cached
+        chain depth, from KVTransferManager via Replica.stats) into one
+        bounded per-deployment LRU and publish it on serve:prefix:<name>.
+        Longest advertised chain wins a hint; entries from replicas that
+        left the set are dropped — the digest only ever names routable
+        replicas. Runs on the ~5s heartbeat, gated on
+        serve_prefix_affinity (one stats fan-out per beat)."""
+        import ray_tpu
+
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        from ..util import pubsub
+        from .long_poll import prefix_channel
+
+        replicas = list(state.replicas)
+        if not replicas:
+            return
+        try:
+            stats = ray_tpu.get(
+                [r.stats.remote() for r in replicas], timeout=5
+            )
+        except Exception:
+            return
+        merged = state.prefix_digest
+        live = {getattr(r, "_actor_id", None) for r in replicas}
+        for r, s in zip(replicas, stats):
+            aid = getattr(r, "_actor_id", None)
+            for hint, depth in (s.get("prefix_digest") or {}).items():
+                cur = merged.get(hint)
+                if cur is None or cur[0] not in live or int(depth) >= cur[1]:
+                    merged[hint] = (aid, int(depth))
+                    merged.move_to_end(hint)
+        for hint in [h for h, (aid, _) in merged.items() if aid not in live]:
+            del merged[hint]
+        cap = max(1, int(cfg.serve_prefix_digest_size))
+        while len(merged) > cap:
+            merged.popitem(last=False)
+        try:
+            pubsub.publish(
+                prefix_channel(state.name),
+                {"digest": {h: [a, d] for h, (a, d) in merged.items()}},
+            )
+        except Exception:
+            pass  # handles just keep their last snapshot
+
+    def get_prefix_digest(self, deployment_name: str) -> Dict[str, tuple]:
+        """Pull-path mirror of the serve:prefix push (tests/debugging)."""
+        state = self._deployments.get(deployment_name)
+        return dict(state.prefix_digest) if state is not None else {}
+
     def _health_check(self, state: _DeploymentState):
         import ray_tpu
 
@@ -527,6 +583,12 @@ class ServeController:
                     self._health_check(state)
                     if heartbeat:
                         self._publish_replicas(state)
+                        from ray_tpu._private.config import (
+                            GLOBAL_CONFIG as _cfg,
+                        )
+
+                        if _cfg.serve_prefix_affinity:
+                            self._harvest_prefix_digest(state)
                 except Exception:
                     pass
             if heartbeat:

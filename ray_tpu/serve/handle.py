@@ -476,7 +476,21 @@ class DeploymentHandle:
                 still.append((idx, ref))
         self._inflight = still
 
-    def _pick_replica(self) -> int:
+    def _prefix_idx(self, hint: str) -> Optional[int]:
+        """Index of the replica the prefix digest advertises for `hint`,
+        or None (no digest entry, or that replica left the set)."""
+        from .long_poll import get_prefix_watcher
+
+        entry = get_prefix_watcher(self.deployment_name).digest.get(hint)
+        if not entry:
+            return None
+        aid = entry[0]
+        for i, r in enumerate(self._replicas):
+            if getattr(r, "_actor_id", None) == aid:
+                return i
+        return None
+
+    def _pick_replica(self, hint: str = "") -> int:
         n = len(self._replicas)
         if n == 1:
             return 0
@@ -488,7 +502,23 @@ class DeploymentHandle:
                 if getattr(r, "_actor_id", None) == want:
                     return i
         a, b = random.sample(range(n), 2)
-        return a if self._counts.get(a, 0) <= self._counts.get(b, 0) else b
+        pick = a if self._counts.get(a, 0) <= self._counts.get(b, 0) else b
+        if hint:
+            # prefix affinity: ties break toward the replica advertising
+            # the longest cached chain for this prompt's hint — but only
+            # while its queue stays within max_skew of the two-choices
+            # floor. Load wins when depths diverge: a hot prefix cannot
+            # pin a replica (the hint is a bounded-weight tie-break, not
+            # a hard route).
+            idx = self._prefix_idx(hint)
+            if idx is not None:
+                from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+                floor = min(self._counts.get(a, 0), self._counts.get(b, 0))
+                skew = int(cfg.serve_prefix_affinity_max_skew)
+                if self._counts.get(idx, 0) <= floor + skew:
+                    return idx
+        return pick
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         from ray_tpu._private.config import GLOBAL_CONFIG as cfg
@@ -505,6 +535,14 @@ class DeploymentHandle:
             )
         self._refresh()
         self._prune()
+        hint = ""
+        if cfg.serve_prefix_affinity:
+            # one hint per call, shared by both attempts: proxy traffic
+            # arrives as a body dict in args[0], handle traffic as
+            # tokens= kwargs — request_hint covers both shapes
+            from .kv_transfer import request_hint
+
+            hint = request_hint(args, kwargs)
         for attempt in range(2):
             # re-checked every attempt: a force-refresh after a failed
             # submit may have adopted an empty/draining set. Failing here is
@@ -523,7 +561,7 @@ class DeploymentHandle:
                     self.deployment_name, "no live replicas",
                     retry_after_s=cfg.serve_http_retry_after_s,
                 )
-            idx = self._pick_replica()
+            idx = self._pick_replica(hint)
             try:
                 ref = self._replicas[idx].handle_request.remote(
                     self.method_name, args, kwargs,

@@ -28,6 +28,10 @@ def replica_channel(deployment_name: str) -> str:
     return f"serve:replicas:{deployment_name}"
 
 
+def prefix_channel(deployment_name: str) -> str:
+    return f"serve:prefix:{deployment_name}"
+
+
 class ReplicaWatcher:
     """Daemon thread long-polling one deployment's replica channel.
 
@@ -83,7 +87,58 @@ class ReplicaWatcher:
         self._stop.set()
 
 
+class PrefixWatcher:
+    """Daemon thread long-polling one deployment's prefix-digest channel
+    (`serve:prefix:<name>`): the controller's bounded aggregate of which
+    replica holds the longest cached chain for each prefix hint. Purely
+    advisory — handles consult the snapshot for an affinity tie-break and
+    fall through to power-of-two-choices when it's empty, stale, or names
+    a replica that left the set. Same one-per-(process, deployment)
+    sharing discipline as ReplicaWatcher, and the same wire rule: a
+    snapshot is adopted atomically, never patched in place."""
+
+    def __init__(self, deployment_name: str):
+        self.channel = prefix_channel(deployment_name)
+        self.digest: Dict[str, Any] = {}  # hint -> (actor_id, chain depth)
+        self.version = 0
+        self.last_data_ts = 0.0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"long-poll:{self.channel}"
+        )
+        self._thread.start()
+
+    def _run(self):
+        from ..util import pubsub
+
+        while not self._stop.is_set():
+            try:
+                result = pubsub.poll(self.channel, self._seq, timeout=10.0)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(1.0)
+                continue
+            if result is None:
+                continue
+            self.last_data_ts = time.time()
+            self._seq, data = result
+            if isinstance(data, dict):
+                raw = data.get("digest", {})
+                self.digest = {
+                    h: (e[0], int(e[1]))
+                    for h, e in raw.items()
+                    if isinstance(e, (list, tuple)) and len(e) == 2
+                }
+                self.version += 1
+
+    def stop(self):
+        self._stop.set()
+
+
 _watchers: Dict[str, ReplicaWatcher] = {}
+_prefix_watchers: Dict[str, PrefixWatcher] = {}
 _watchers_lock = threading.Lock()
 
 
@@ -95,9 +150,22 @@ def get_watcher(deployment_name: str) -> ReplicaWatcher:
         return w
 
 
+def get_prefix_watcher(deployment_name: str) -> PrefixWatcher:
+    with _watchers_lock:
+        w = _prefix_watchers.get(deployment_name)
+        if w is None or w._stop.is_set():
+            w = _prefix_watchers[deployment_name] = PrefixWatcher(
+                deployment_name
+            )
+        return w
+
+
 def stop_watchers() -> None:
     """Called from serve.shutdown(): stop the poll threads promptly."""
     with _watchers_lock:
         for w in _watchers.values():
             w.stop()
         _watchers.clear()
+        for w in _prefix_watchers.values():
+            w.stop()
+        _prefix_watchers.clear()

@@ -81,6 +81,11 @@ class Replica:
         self._lock = threading.Lock()
         self._streams: Dict[int, Any] = {}
         self._stream_ids = itertools.count(1)
+        # monotonic fold of per-batcher cumulative counters across batcher
+        # replacement (see _mono_sum): retired batchers' last-seen values
+        # accumulate in _mono_base instead of vanishing from stats()
+        self._mono_base: Dict[str, int] = {}
+        self._mono_seen: Dict[str, Dict[int, int]] = {}
         # sid -> why it was closed early (reaped/cancelled): a later pull
         # must surface the truncation, not fake a clean completion
         self._closed_early: Dict[int, str] = {}
@@ -268,6 +273,31 @@ class Replica:
         with self._lock:
             return self._ongoing + len(self._streams)
 
+    def _mono_sum(self, key: str, values: Dict[int, int]) -> int:
+        """Monotonic sum of a per-batcher CUMULATIVE counter across batcher
+        replacement. A user callable that rebuilds its batcher (engine
+        swap, recovery) would otherwise make the replica-level sum drop to
+        the new batcher's fresh count — losing attribution mid-diff for
+        anything comparing before/after (the multi-replica prefix-hit
+        test diffs prefill_tokens exactly that way). A batcher that
+        vanishes — or whose id is reused by a NEW batcher, detectable as
+        the counter going backwards — folds its last-seen value into a
+        retained base."""
+        base = self._mono_base.get(key, 0)
+        seen = self._mono_seen.setdefault(key, {})
+        for bid, last in list(seen.items()):
+            cur = values.get(bid)
+            if cur is None or cur < last:
+                base += last
+                del seen[bid]
+        seen.update(values)
+        self._mono_base[key] = base
+        return base + sum(values.values())
+
+    _MONO_KEYS = ("prefill_tokens", "prefix_tokens_reused",
+                  "kv_blocks_exported", "kv_blocks_imported",
+                  "kv_tokens_imported", "kv_import_rejects")
+
     def _batcher_stats(self) -> Dict[str, int]:
         """Aggregate generation-slot occupancy over any drainable batchers
         hanging off the user callable (serve.ContinuousBatcher instances) —
@@ -278,6 +308,7 @@ class Replica:
         spec_k = spec_slot_steps = spec_proposed = 0
         spec_accepted = spec_emitted = 0
         chunk_tokens = prefilling = chunked_prefills = prefill_chunks = 0
+        mono_cur: Dict[str, Dict[int, int]] = {k: {} for k in self._MONO_KEYS}
         for v in self._drainables():
             get_stats = getattr(v, "stats", None)
             if get_stats is None:
@@ -288,6 +319,9 @@ class Replica:
                 continue
             if not isinstance(s, dict) or "max_batch_size" not in s:
                 continue
+            for k in self._MONO_KEYS:
+                if k in s:
+                    mono_cur[k][id(v)] = int(s[k])
             slots += int(s.get("max_batch_size", 0))
             active += int(s.get("active", 0))
             queued += int(s.get("queued", 0))
@@ -325,19 +359,23 @@ class Replica:
             prefilling += int(s.get("prefilling", 0))
             chunked_prefills += int(s.get("chunked_prefills", 0))
             prefill_chunks += int(s.get("prefill_chunks", 0))
-        return {"batch_slots": slots, "batch_active": active,
-                "batch_queued": queued, "kv_blocks_total": kv_total,
-                "kv_blocks_free": kv_free, "kv_preemptions": preempt,
-                "kv_pool_bytes": kv_bytes,
-                "prefill_chunk_tokens": chunk_tokens,
-                "prefilling": prefilling,
-                "chunked_prefills": chunked_prefills,
-                "prefill_chunks": prefill_chunks,
-                "spec_k": spec_k,
-                "spec_accept_rate": round(
-                    spec_accepted / max(1, spec_proposed), 4),
-                "spec_tokens_per_step": round(
-                    spec_emitted / max(1, spec_slot_steps), 2)}
+        out = {"batch_slots": slots, "batch_active": active,
+               "batch_queued": queued, "kv_blocks_total": kv_total,
+               "kv_blocks_free": kv_free, "kv_preemptions": preempt,
+               "kv_pool_bytes": kv_bytes,
+               "prefill_chunk_tokens": chunk_tokens,
+               "prefilling": prefilling,
+               "chunked_prefills": chunked_prefills,
+               "prefill_chunks": prefill_chunks,
+               "spec_k": spec_k,
+               "spec_accept_rate": round(
+                   spec_accepted / max(1, spec_proposed), 4),
+               "spec_tokens_per_step": round(
+                   spec_emitted / max(1, spec_slot_steps), 2)}
+        # monotonic across batcher replacement — see _mono_sum
+        for k in self._MONO_KEYS:
+            out[k] = self._mono_sum(k, mono_cur[k])
+        return out
 
     def stats(self) -> Dict[str, Any]:
         self._reap_idle_streams()
@@ -361,8 +399,35 @@ class Replica:
                 out["bulk_bytes_by_path"] = _bm.local_counter_by_tag(
                     "bulk_plane_bytes_total", "path"
                 )
+            # cluster-wide KV plane: recompute fallbacks + wire bytes by
+            # direction in THIS replica process (serve/kv_transfer.py)
+            kvfb = _bm.local_counter_by_tag(
+                "kv_transfer_fallbacks_total", "path"
+            )
+            if kvfb:
+                out["kv_transfer_fallbacks_total"] = int(sum(kvfb.values()))
+            kvb = _bm.local_counter_by_tag(
+                "serve_kv_transfer_bytes_total", "direction"
+            )
+            if kvb:
+                out["kv_transfer_bytes_by_direction"] = kvb
         except Exception:
             pass
+        # transfer managers hanging off the user callable advertise their
+        # remote-pull figures and the prefix digest affinity routing feeds
+        # on (controller harvests "prefix_digest" from these stats)
+        attrs = getattr(self.callable, "__dict__", None) or {}
+        digest: Dict[str, int] = {}
+        for v in list(attrs.values()):
+            if not getattr(v, "_serve_kv_transfer", False):
+                continue
+            try:
+                out.update(v.stats())
+                digest.update(v.digest())
+            except Exception:
+                pass
+        if digest:
+            out["prefix_digest"] = digest
         try:
             from . import telemetry
 

@@ -158,11 +158,27 @@ class ServeTelemetry:
             "serve_spec_accept_rate",
             "speculative drafts accepted / proposed (cumulative)",
             tag_keys=base)
+        # cluster-wide KV plane (serve/kv_transfer.py): cross-replica
+        # prefix traffic, by direction ("export" = bytes packed for a
+        # peer, "import" = bytes pulled and installed locally)
+        self.kv_transfer_bytes = Counter(
+            "serve_kv_transfer_bytes_total",
+            "cross-replica KV block bytes by direction",
+            tag_keys=base + ("direction",))
+        self.kv_transfer_hits = Counter(
+            "serve_kv_transfer_hits_total",
+            "remote prefix pulls that installed blocks locally",
+            tag_keys=base)
+        self.prefix_remote_hit_rate = Gauge(
+            "serve_prefix_remote_hit_rate",
+            "remote pulls installed / remote pulls attempted (cumulative)",
+            tag_keys=base)
         self._all = [
             self.ttft, self.inter_token, self.queue_wait,
             self.request_latency, self.engine_step, self.requests,
             self.preemptions, self.tokens, self.kv_util, self.occupancy,
-            self.spec_accept,
+            self.spec_accept, self.kv_transfer_bytes, self.kv_transfer_hits,
+            self.prefix_remote_hit_rate,
         ]
         self._last_push = 0.0
         self._last_push_total = -1  # recorder.total at the last push

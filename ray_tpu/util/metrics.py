@@ -279,6 +279,20 @@ def bulk_plane_fallbacks_counter() -> Counter:
     )
 
 
+def kv_transfer_fallbacks_counter() -> Counter:
+    """Cross-replica KV transfers abandoned for local recompute — peer
+    unreachable, payload corrupt/truncated mid-flight, verification
+    reject, or local pool pressure (serve/kv_transfer.py). Shared
+    single-definition discipline: incremented from the transfer manager,
+    read from Replica.stats and the chaos suite."""
+    return Counter(
+        "kv_transfer_fallbacks_total",
+        "cross-replica KV prefix transfers that fell back to local "
+        "recompute (the output is recomputed, never wrong)",
+        tag_keys=(),
+    )
+
+
 def local_counter_by_tag(name: str, tag_key: str) -> Dict[str, float]:
     """THIS process's counter totals grouped by one tag's value (stats
     surfaces, no cluster round trip). Empty dict when absent/never inc'd."""
