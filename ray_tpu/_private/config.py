@@ -354,6 +354,17 @@ D("train_dist_heartbeat_timeout_s", int, 30,
   "from this, so a hard-killed rank parks the surviving ranks' shutdown "
   "barrier ~this long instead of jax's ~100s default — the gang-restart "
   "latency floor (train/trainer.py). 0 = keep jax's defaults")
+D("train_dcn_grad_compression", str, "off",
+  "gradient compression over the slow `dcn` axis of a multi-slice mesh "
+  "(train/step.py): 'off' = fp32 all-reduce spanning (dcn, dp) as today; "
+  "'int8' = full-precision reduce INSIDE the slice (ICI), then an int8 "
+  "block-quantized exchange with error feedback across slices — ~4x "
+  "fewer DCN bytes per step (util/collective/compress.py). Adds an "
+  "error-feedback residual buffer to the optimizer state (checkpointed; "
+  "restoring a pre-compression checkpoint zero-initializes it)")
+D("train_dcn_grad_compression_block", int, 256,
+  "quantization block size for train_dcn_grad_compression=int8: one "
+  "shared fp32 scale per block crosses DCN alongside the int8 payload")
 # --- TPU ---
 D("tpu_chips_per_host", int, 4, "default TPU chips advertised per host when detected")
 D("mesh_dryrun_platform", str, "cpu")

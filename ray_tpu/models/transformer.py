@@ -114,6 +114,12 @@ class TransformerConfig:
     # pipeline parallelism: >1 splits the layer stack into pp stages
     pp_stages: int = 1
     pp_microbatches: int = 4
+    # interleaved-1F1B depth v (parallel/pipeline.py): each pipeline device
+    # hosts v of the pp_stages chunks (round-robin: chunk q on device
+    # q % (pp_stages/v)), shrinking the bubble toward (pp-1)/(v*n_mb+pp-1).
+    # Requires pp_stages % pp_interleave == 0 and pp_microbatches divisible
+    # by the per-device stage count pp_stages // pp_interleave.
+    pp_interleave: int = 1
     # >0: the training loss never materializes full [tokens, vocab] logits;
     # the unembed matmul + log-softmax run per seq-chunk of this size under
     # jax.checkpoint (ops/losses.py blockwise_softmax_cross_entropy). Frees
@@ -125,6 +131,15 @@ class TransformerConfig:
             raise ValueError(
                 f"mlp_variant must be 'silu_gate' or 'gelu', "
                 f"got {self.mlp_variant!r}"
+            )
+        if self.pp_interleave < 1:
+            raise ValueError(
+                f"pp_interleave must be >= 1, got {self.pp_interleave}"
+            )
+        if self.pp_stages % self.pp_interleave:
+            raise ValueError(
+                f"pp_stages {self.pp_stages} not divisible by "
+                f"pp_interleave {self.pp_interleave}"
             )
 
     def flops_per_token(self) -> float:
@@ -548,6 +563,7 @@ def make_forward(
                 n_microbatches=cfg.pp_microbatches,
                 axis_name=stage_axes or "pp",
                 batch_axes=batch_axes if batch_axes is not None else ("dp", "fsdp"),
+                virtual_stages_per_device=cfg.pp_interleave,
             )
         if not cfg.scan_layers:
             for i in range(cfg.n_layers):

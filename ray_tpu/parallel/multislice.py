@@ -237,14 +237,33 @@ def pp_outer(
     slice_spec: MeshSpec = MeshSpec(),
     *,
     stages_per_slice: int = 1,
+    virtual_stages_per_device: int = 1,
     **make_rules_kwargs,
 ) -> Tuple[SliceTopology, ShardingRules]:
     """Pipeline stages across slices: stage i lives on slice
     i // stages_per_slice; only microbatch activations cross DCN, at stage
     boundaries.  The right preset when one slice cannot hold the model and
-    activations are small relative to gradients."""
+    activations are small relative to gradients.
+
+    virtual_stages_per_device (v) selects the interleaved-1F1B schedule
+    (parallel/pipeline.py): each of the pp = num_slices*stages_per_slice
+    stage devices hosts v non-adjacent stage CHUNKS (chunk q on device
+    q % pp), shrinking the pipeline bubble from (pp-1)/(n_mb+pp-1) toward
+    (pp-1)/(v*n_mb+pp-1) at the cost of v x the activation hop rate — all
+    extra hops ride ICI; DCN still sees exactly one boundary transfer per
+    tick.  The model must expose pp * v stage rows (e.g.
+    TransformerConfig.pp_stages = pp * v with pp_interleave = v) and
+    n_microbatches must divide by pp.  v is a schedule knob, not a mesh
+    axis, so the returned topology/rules are identical for every v — it is
+    threaded here so gang-level code (ScalingConfig.virtual_stages_per_device
+    -> session.get_virtual_stages_per_device) validates one number once."""
     if stages_per_slice < 1:
         raise ValueError(f"stages_per_slice must be >= 1, got {stages_per_slice}")
+    if virtual_stages_per_device < 1:
+        raise ValueError(
+            f"virtual_stages_per_device must be >= 1, got "
+            f"{virtual_stages_per_device}"
+        )
     spec = dataclasses.replace(slice_spec, pp=stages_per_slice)
     return (
         SliceTopology(num_slices, spec),

@@ -78,6 +78,19 @@ class ShardingRules:
         r.update(overrides)
         return ShardingRules(r)
 
+    def without_axis(self, axis: str) -> "ShardingRules":
+        """Drop one mesh axis from every mapping — e.g. the per-slice view
+        of a dcn="dp" table, used inside a vmap(spmd_axis_name="dcn")
+        region where the dcn dimension is already spoken for."""
+        r: Dict[str, MeshAxes] = {}
+        for k, v in self.rules.items():
+            if isinstance(v, tuple):
+                t = tuple(a for a in v if a != axis)
+                r[k] = t if t else None
+            else:
+                r[k] = None if v == axis else v
+        return ShardingRules(r)
+
 
 # --- presets ---------------------------------------------------------------
 

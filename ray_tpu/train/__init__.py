@@ -10,7 +10,12 @@ from .step import TrainState, make_train_step, make_sharded_init  # noqa: F401
 from .trainer import JaxTrainer  # noqa: F401
 from .config import ScalingConfig, RunConfig, FailureConfig, CheckpointConfig  # noqa: F401
 from .session import report, get_context  # noqa: F401
-from .checkpoint import Checkpoint, save_checkpoint, restore_checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    Checkpoint,
+    save_checkpoint,
+    restore_checkpoint,
+    restore_train_state,
+)
 from .batch_predictor import BatchPredictor, JaxPredictor, Predictor  # noqa: F401,E402
 
 from .._private.usage import record_library_usage as _rlu  # noqa: E402

@@ -111,6 +111,45 @@ def restore_checkpoint(path: str, abstract_state: Any) -> Any:
     return out
 
 
+def restore_train_state(path: str, abstract_state: Any) -> Any:
+    """restore_checkpoint for TrainStates that may carry error-feedback
+    residuals (train_dcn_grad_compression='int8' wraps the optimizer state
+    as (inner_state, EFState) — train/step.py make_sharded_init).
+
+    A checkpoint written BEFORE compression was enabled has no EFState
+    entry; restoring it into a compression-enabled abstract state would be
+    a tree-structure mismatch. This helper retries with the EF half
+    stripped from the abstract tree and zero-fills the residuals with the
+    requested sharding — mathematically exact: EF residuals are carried
+    rounding error, and zero is the state of a run that has not rounded
+    anything yet."""
+    try:
+        return restore_checkpoint(path, abstract_state)
+    except Exception:
+        from ..util.collective.compress import EFState
+
+        opt = getattr(abstract_state, "opt_state", None)
+        if not (
+            isinstance(opt, tuple)
+            and len(opt) == 2
+            and isinstance(opt[1], EFState)
+        ):
+            raise
+        import jax
+        import jax.numpy as jnp
+
+        legacy = abstract_state._replace(opt_state=opt[0])
+        restored = restore_checkpoint(path, legacy)
+
+        def _zeros(a):
+            z = jnp.zeros(a.shape, a.dtype)
+            sh = getattr(a, "sharding", None)
+            return jax.device_put(z, sh) if sh is not None else z
+
+        ef = jax.tree.map(_zeros, opt[1])
+        return restored._replace(opt_state=(restored.opt_state, ef))
+
+
 def abstract_like(state: Any) -> Any:
     """Build the abstract (ShapeDtypeStruct+sharding) mirror of a live state."""
     import jax

@@ -31,6 +31,16 @@ class ScalingConfig:
     # consecutive ranks. Each worker's train loop can then build the
     # two-level (dcn x ICI) mesh with session.build_multislice_mesh.
     num_slices: int = 1
+    # interleaved-1F1B depth for pp-outer loops: each pipeline device hosts
+    # this many non-adjacent stage chunks (parallel/pipeline.py), shrinking
+    # the bubble from (pp-1)/(n_mb+pp-1) toward (pp-1)/(v*n_mb+pp-1).
+    # Surfaced to the train loop via session.get_virtual_stages_per_device()
+    # and consumed as TransformerConfig.pp_interleave.
+    virtual_stages_per_device: int = 1
+    # cross-slice gradient compression for dp-outer loops: None inherits
+    # the process-wide train_dcn_grad_compression flag; "off"/"int8" pin it
+    # for this gang (exported to the workers' env so every host agrees).
+    dcn_grad_compression: Optional[str] = None
 
     def __post_init__(self):
         if self.num_slices < 1:
@@ -40,6 +50,16 @@ class ScalingConfig:
                 f"num_workers={self.num_workers} does not split into "
                 f"{self.num_slices} equal slices; slices must hold the same "
                 "number of hosts"
+            )
+        if self.virtual_stages_per_device < 1:
+            raise ValueError(
+                f"virtual_stages_per_device must be >= 1, got "
+                f"{self.virtual_stages_per_device}"
+            )
+        if self.dcn_grad_compression not in (None, "off", "int8"):
+            raise ValueError(
+                f"dcn_grad_compression must be None, 'off' or 'int8', got "
+                f"{self.dcn_grad_compression!r}"
             )
 
     def worker_resources(self) -> Dict[str, float]:

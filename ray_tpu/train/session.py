@@ -27,6 +27,9 @@ class TrainContext:
     # how many DCN slices the gang's hosts form (ScalingConfig.num_slices);
     # this worker belongs to slice world_rank // (world_size // num_slices)
     num_slices: int = 1
+    # interleaved-1F1B depth (ScalingConfig.virtual_stages_per_device):
+    # pp-outer train loops feed this to TransformerConfig.pp_interleave
+    virtual_stages_per_device: int = 1
     results: "queue.Queue" = field(default_factory=queue.Queue)
     done: threading.Event = field(default_factory=threading.Event)
 
@@ -81,6 +84,10 @@ def get_local_rank() -> int:
 
 def get_num_slices() -> int:
     return get_context().num_slices
+
+
+def get_virtual_stages_per_device() -> int:
+    return get_context().virtual_stages_per_device
 
 
 def build_multislice_mesh(slice_spec=None, preset: str = "dp_outer"):

@@ -16,14 +16,42 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .._private.config import GLOBAL_CONFIG
 from ..models.transformer import TransformerConfig, init_params, make_loss_fn, param_specs
 from ..parallel.sharding import ShardingRules
+from ..util.collective import compress
 
 
 class TrainState(NamedTuple):
     step: jax.Array
     params: Any
     opt_state: Any
+
+
+def resolve_dcn_compression(
+    flag: Optional[str], mesh: Mesh, rules: Optional[ShardingRules] = None
+) -> str:
+    """Normalize the train_dcn_grad_compression knob: None reads the global
+    config; 'int8' silently degrades to 'off' on meshes with no real dcn
+    axis (single slice) where there is nothing to compress, and — when the
+    rule table is given — on topologies whose dcn axis does not shard the
+    batch (pp_outer: the slice boundary carries stage activations, not a
+    gradient all-reduce, so there is no dcn gradient exchange to quantize)."""
+    if flag is None:
+        flag = GLOBAL_CONFIG.train_dcn_grad_compression
+    if flag not in ("off", "int8"):
+        raise ValueError(
+            f"train_dcn_grad_compression must be 'off' or 'int8', got {flag!r}"
+        )
+    if flag == "int8":
+        if mesh.shape.get("dcn", 1) < 2:
+            return "off"
+        if rules is not None:
+            bax = rules.mesh_axes("batch")
+            axes = bax if isinstance(bax, tuple) else (bax,)
+            if "dcn" not in axes:
+                return "off"
+    return flag
 
 
 def _param_shardings(mesh: Mesh, rules: ShardingRules, specs_tree):
@@ -57,21 +85,35 @@ def make_sharded_init(
     mesh: Mesh,
     rules: ShardingRules,
     optimizer: optax.GradientTransformation,
+    dcn_grad_compression: Optional[str] = None,
 ) -> Tuple[Callable[[jax.Array], TrainState], Any]:
     """Returns (init_fn, state_shardings). init_fn is jit'ed with sharded
-    outputs so params are born distributed — no host-memory spike."""
+    outputs so params are born distributed — no host-memory spike.
+
+    With dcn_grad_compression='int8' (or the train_dcn_grad_compression
+    config flag) the optimizer state becomes (inner_state, EFState): the
+    error-feedback residuals ride the optimizer state so checkpoints carry
+    them (train/checkpoint.py zero-fills them when restoring a
+    pre-compression checkpoint)."""
+    compression = resolve_dcn_compression(dcn_grad_compression, mesh, rules)
     specs = param_specs(cfg)
     p_shard = _param_shardings(mesh, rules, specs)
     p_shapes = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
     o_shapes = jax.eval_shape(optimizer.init, p_shapes)
     o_shard = _opt_shardings(o_shapes, p_shapes, p_shard, mesh)
+    if compression == "int8":
+        o_shard = (o_shard, compress.ef_state_sharding(mesh))
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()), params=p_shard, opt_state=o_shard
     )
+    n_slices = mesh.shape.get("dcn", 1)
+    block = GLOBAL_CONFIG.train_dcn_grad_compression_block
 
     def _init(rng) -> TrainState:
         params = init_params(rng, cfg)
         opt_state = optimizer.init(params)
+        if compression == "int8":
+            opt_state = (opt_state, compress.init_ef_state(params, n_slices, block))
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
     init_jit = jax.jit(_init, out_shardings=state_shardings)
@@ -94,6 +136,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     state_shardings: TrainState,
     compute_dtype_grads: bool = False,
+    dcn_grad_compression: Optional[str] = None,
 ):
     """Returns train_step(state, batch) -> (state, metrics), jit'ed with
     donated state (in-place HBM update) and sharded in/out.
@@ -105,10 +148,53 @@ def make_train_step(
     copy it introduces is live across the whole step while fp32 grad
     leaves die progressively into the update, so the PEAK-memory effect is
     config-dependent (measured ~neutral on the gpt_1b HBM-limit bench —
-    the remat policy, not this, was the fitting lever there)."""
+    the remat policy, not this, was the fitting lever there).
+
+    dcn_grad_compression='int8' (or the train_dcn_grad_compression config
+    flag; requires a multi-slice mesh and a make_sharded_init built with
+    the same flag) computes PER-SLICE gradients — the batch regains an
+    explicit n_slices dim that a vmap(spmd_axis_name="dcn") backward keeps
+    on its slice, so the automatic all-reduce GSPMD inserts spans only the
+    intra-slice ICI axes — then means them across slices through the int8
+    + error-feedback path of util/collective/compress.py. DCN sees one s8
+    all-reduce plus the shared-scale f32 exchange instead of the fp32
+    gradient all-reduce: ~4x fewer slice-boundary bytes, bit-identical
+    'off' path."""
+    compression = resolve_dcn_compression(dcn_grad_compression, mesh, rules)
     loss_fn = make_loss_fn(cfg, rules, mesh)
+    if compression == "int8":
+        n_slices = mesh.shape["dcn"]
+        block = GLOBAL_CONFIG.train_dcn_grad_compression_block
+        # the per-slice view: inside the vmapped region the dcn axis is
+        # consumed by the stacked dim, so the inner table must not name it
+        rules_in = rules.without_axis("dcn")
+        loss_fn_in = make_loss_fn(cfg, rules_in, mesh)
+        inner_bax = rules_in.mesh_axes("batch")
+        stacked_shard = NamedSharding(mesh, P("dcn", inner_bax))
+
+        def _stack_batch(batch):
+            def split(x):
+                x = x.reshape((n_slices, x.shape[0] // n_slices) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(x, stacked_shard)
+
+            return jax.tree.map(split, batch)
+
+    def _grads(params, batch):
+        if compression == "int8":
+            vg = jax.vmap(
+                jax.value_and_grad(loss_fn_in),
+                in_axes=(None, 0),
+                spmd_axis_name="dcn",
+            )
+            losses, g = vg(params, _stack_batch(batch))
+            return jnp.mean(losses), g
+        return jax.value_and_grad(loss_fn)(params, batch)
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        if compression == "int8":
+            opt_state, ef = state.opt_state
+        else:
+            opt_state, ef = state.opt_state, None
         if compute_dtype_grads:
             # the model casts fp32 leaves to cfg.dtype at use anyway; doing
             # the cast OUTSIDE the grad means d(loss)/d(bf16 leaf) = bf16
@@ -118,12 +204,17 @@ def make_train_step(
                 else p,
                 state.params,
             )
-            loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(p_lo)
+            loss, grads = _grads(p_lo, batch)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            loss, grads = _grads(state.params, batch)
+        if compression == "int8":
+            # mean over slices rides the int8 + error-feedback DCN path
+            grads, ef = compress.compressed_slice_mean(grads, ef, block=block)
+        updates, new_opt = optimizer.update(grads, opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
+        if compression == "int8":
+            new_opt = (new_opt, ef)
         new_state = TrainState(state.step + 1, new_params, new_opt)
         return new_state, {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
 

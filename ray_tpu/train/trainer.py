@@ -76,6 +76,7 @@ class TrainWorker:
         checkpoint=None,
         coordinator: Optional[str] = None,
         num_slices: int = 1,
+        virtual_stages_per_device: int = 1,
     ):
         dist_inited = False
         if self.world_size > 1 and coordinator:
@@ -132,6 +133,7 @@ class TrainWorker:
             dataset_shards=datasets or {},
             checkpoint=checkpoint,
             num_slices=num_slices,
+            virtual_stages_per_device=virtual_stages_per_device,
         )
         _set_context(self.ctx)
         try:
@@ -262,8 +264,15 @@ class JaxTrainer:
         extra = {k: v for k, v in res.items() if k not in ("CPU", "TPU")}
         if extra:
             opts["resources"] = extra
-        if sc.env_vars:
-            opts["runtime_env"] = {"env_vars": dict(sc.env_vars)}
+        env_vars = dict(sc.env_vars)
+        if sc.dcn_grad_compression is not None:
+            # pin the gang-wide compression mode: every host must compile
+            # the same step (the int8 path changes the opt_state pytree)
+            env_vars.setdefault(
+                "RAY_TPU_TRAIN_DCN_GRAD_COMPRESSION", sc.dcn_grad_compression
+            )
+        if env_vars:
+            opts["runtime_env"] = {"env_vars": env_vars}
 
         workers.extend(
             WorkerCls.options(**opts).remote(rank, n) for rank in range(n)
@@ -293,7 +302,7 @@ class JaxTrainer:
         run_refs = [
             w.run.remote(
                 self._train_fn, self._config, shard_for(i), resume_checkpoint,
-                coordinator, sc.num_slices,
+                coordinator, sc.num_slices, sc.virtual_stages_per_device,
             )
             for i, w in enumerate(workers)
         ]

@@ -36,3 +36,9 @@ from .bytes import (  # noqa: F401
     collective_byte_report,
     mesh_collective_report,
 )
+from . import compress  # noqa: F401
+from .compress import (  # noqa: F401
+    EFState,
+    compressed_slice_mean,
+    compression_dcn_byte_ratio,
+)

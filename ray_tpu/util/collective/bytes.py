@@ -13,10 +13,26 @@ their contract — e.g. "tp/sp/ep traffic never crosses a slice boundary" is
 
 Byte convention: each op is charged its per-participant payload (the HLO
 output shape), recorded once per replica group member-set; collective-
-permute is charged per source→target pair.  The numbers are therefore a
-consistent basis for ICI:DCN ratios and zero/nonzero assertions, not a
-wire-level byte count (which would fold in algorithm choice — ring vs tree
-— that XLA owns).
+permute is charged per source→target pair.  A SEPARABLE op whose groups
+span both a dcn axis and ICI axes (e.g. the gradient all-reduce over
+("dcn", "dp")) is charged on BOTH sides: the runtime decomposes it into an
+intra-slice leg (ICI) plus one inter-slice exchange (DCN), so its payload
+appears in `ici_bytes` AND `dcn_bytes` — which is what makes "compression
+left ICI traffic untouched" an equality test rather than a judgement
+call.  Non-separable dcn-crossing ops are charged to DCN alone.  The
+numbers are therefore a consistent basis for ICI:DCN ratios and zero/
+nonzero assertions, not a wire-level byte count (which would fold in
+algorithm choice — ring vs tree — that XLA owns).
+
+Each op also records its payload `dtype` (of the largest buffer), so the
+quantize-wrapped collectives of util/collective/compress.py are auditable:
+the compressed gradient path must show an `s8` all-reduce spanning only
+`dcn` next to the small `f32` shared-scale exchange.
+
+Static-count caveat: an op inside a `while` body (scanned layers, pipeline
+ticks) is counted ONCE, not per iteration — compare like against like
+(e.g. measure compression ratios on scan_layers=False configs, where every
+gradient collective is top-level).
 """
 
 from __future__ import annotations
@@ -66,14 +82,19 @@ class CollectiveOp:
     # e.g. a gradient all-reduce over ("dcn", "dp"). False means the op
     # irreducibly MIXES axes in one exchange.
     separable: bool = True
+    # element type of the LARGEST payload buffer ("f32", "s8", ...) — lets
+    # tests assert a quantize-wrapped exchange really went over the wire
+    # narrow (compress.py's s8 dcn all-reduce) instead of trusting the
+    # python-side cast.
+    dtype: str = ""
 
 
-def _shape_bytes(out: str, async_start: bool = False) -> int:
-    """Payload bytes of an HLO output type. For async `-start` forms the
-    tuple carries BOTH the operand and result buffers (plus u32 context
-    scalars), so summing would double-charge: take the largest single
-    shape instead — the actual payload."""
-    sizes = []
+def _payload_info(out: str, async_start: bool = False) -> Tuple[int, str]:
+    """(payload bytes, dtype of largest buffer) of an HLO output type. For
+    async `-start` forms the tuple carries BOTH the operand and result
+    buffers (plus u32 context scalars), so summing would double-charge:
+    take the largest single shape instead — the actual payload."""
+    sizes: List[Tuple[int, str]] = []
     for dtype, dims in _SHAPE_RE.findall(out):
         if dtype == "token":
             continue
@@ -85,10 +106,17 @@ def _shape_bytes(out: str, async_start: bool = False) -> int:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        sizes.append(n * size)
+        sizes.append((n * size, dtype))
     if not sizes:
-        return 0
-    return max(sizes) if async_start else sum(sizes)
+        return 0, ""
+    big = max(sizes, key=lambda s: s[0])
+    if async_start:
+        return big
+    return sum(s[0] for s in sizes), big[1]
+
+
+def _shape_bytes(out: str, async_start: bool = False) -> int:
+    return _payload_info(out, async_start)[0]
 
 
 def _parse_brace_groups(body: str) -> List[Tuple[int, ...]]:
@@ -163,7 +191,9 @@ def collective_byte_report(
         if m is None:
             continue
         kind = m.group("kind")
-        payload = _shape_bytes(m.group("out"), async_start=bool(m.group("start")))
+        payload, pdtype = _payload_info(
+            m.group("out"), async_start=bool(m.group("start"))
+        )
         if kind == "collective-permute":
             pm = _PAIRS_RE.search(line)
             pairs = _parse_brace_groups(pm.group(1)) if pm else []
@@ -185,6 +215,7 @@ def collective_byte_report(
                 kind=kind, payload_bytes=payload, axes=tuple(sorted(spanned)),
                 group_size=2, crosses_dcn=dcn_b > 0,
                 dcn_bytes=dcn_b, ici_bytes=ici_b, separable=separable,
+                dtype=pdtype,
             ))
             continue
         groups = _extract_groups(line, n_devices)
@@ -201,12 +232,22 @@ def collective_byte_report(
         if not spanned:
             continue
         crosses = any(a in dcn_axes for a in spanned)
+        spans_ici = any(a not in dcn_axes for a in spanned)
+        if crosses and spans_ici and separable:
+            # hierarchical decomposition: intra-slice leg on ICI (reduce-
+            # scatter/gather within the slice) plus one DCN exchange —
+            # charge the payload to both tiers so "ICI traffic unchanged"
+            # stays an equality when a dcn-only op replaces the dcn leg
+            dcn_b, ici_b = payload, payload
+        elif crosses:
+            dcn_b, ici_b = payload, 0
+        else:
+            dcn_b, ici_b = 0, payload
         ops.append(CollectiveOp(
             kind=kind, payload_bytes=payload, axes=tuple(sorted(spanned)),
             group_size=max(len(g) for g in groups), crosses_dcn=crosses,
-            dcn_bytes=payload if crosses else 0,
-            ici_bytes=0 if crosses else payload,
-            separable=separable,
+            dcn_bytes=dcn_b, ici_bytes=ici_b,
+            separable=separable, dtype=pdtype,
         ))
 
     per_axis: Dict[str, int] = {}

@@ -281,6 +281,139 @@ def test_pipeline_combinator_stage_to_slice_placement():
         )
 
 
+# ---------------------------------------------- interleaved-1F1B schedule
+
+
+@pytest.mark.parametrize("layout", ["two_tier", "flat"])
+@pytest.mark.parametrize("v", [1, 2, 4])
+def test_pipeline_interleaved_matches_sequential(v, layout):
+    """Interleaved schedule parity: v round-robin stage chunks per device
+    produce bit-close outputs AND gradients vs the sequential stack, on the
+    two-tier ("dcn","pp") mesh and the flat single-axis ring."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.pipeline import bubble_fraction, pipeline_apply
+
+    if layout == "two_tier":
+        arr = np.array(jax.devices()).reshape(2, 2, 2)
+        mesh = Mesh(arr, ("dcn", "pp", "dp"))
+        axis = ("dcn", "pp")
+    else:
+        arr = np.array(jax.devices()).reshape(4, 2)
+        mesh = Mesh(arr, ("pp", "dp"))
+        axis = "pp"
+    pp = 4
+    rows = pp * v
+    ws = jax.random.normal(jax.random.PRNGKey(0), (rows, 16, 16)) / 4.0
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+
+    def stage_fn(w, xs):
+        return jnp.tanh(xs @ w)
+
+    def pipe(w, xv):
+        return pipeline_apply(
+            stage_fn, w, xv, mesh=mesh, n_microbatches=4,
+            axis_name=axis, virtual_stages_per_device=v,
+        )
+
+    def seq(w):
+        r = x
+        for i in range(rows):
+            r = jnp.tanh(r @ w[i])
+        return r
+
+    out = jax.jit(pipe)(ws, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq(ws)), atol=1e-5)
+    g = jax.jit(jax.grad(lambda w: jnp.sum(pipe(w, x) ** 2)))(ws)
+    g_ref = jax.grad(lambda w: jnp.sum(seq(w) ** 2))(ws)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+    # deeper interleave => strictly smaller bubble
+    assert bubble_fraction(4, pp, v) == (pp - 1) / (v * 4 + pp - 1)
+    if v > 1:
+        assert bubble_fraction(4, pp, v) < bubble_fraction(4, pp, 1)
+
+
+def test_pipeline_interleaved_validates_divisibility():
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.pipeline import interleaved_stage_order, pipeline_apply
+
+    arr = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(arr, ("dcn", "pp", "dp"))
+    ws = jnp.zeros((8, 4, 4))
+
+    def stage_fn(w, xs):
+        return xs @ w
+
+    # n_microbatches must run in groups of pp when interleaving
+    with pytest.raises(ValueError, match="n_microbatches"):
+        pipeline_apply(
+            stage_fn, ws, jnp.zeros((8, 4)), mesh=mesh, n_microbatches=2,
+            axis_name=("dcn", "pp"), virtual_stages_per_device=2,
+        )
+    # stage rows must divide over devices x virtual stages
+    with pytest.raises(ValueError, match="virtual stages"):
+        pipeline_apply(
+            stage_fn, ws[:6], jnp.zeros((8, 4)), mesh=mesh, n_microbatches=4,
+            axis_name=("dcn", "pp"), virtual_stages_per_device=2,
+        )
+    with pytest.raises(ValueError, match="divide over"):
+        interleaved_stage_order(6, 4, 2)
+
+
+def test_pipeline_interleaving_adds_no_dcn_hops_per_tick():
+    """Byte-counter proof of the interleaved schedule's DCN invariant: the
+    tick body has the same number of dcn-crossing boundary hops as GPipe,
+    each shipping the same one-copy payload — the v ICI-hop multiplier
+    never touches DCN. stage_order='schedule' (pre-permuted rows) keeps the
+    compiled HLO free of the one-time model->schedule gather so the report
+    contains only per-tick traffic."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.pipeline import interleaved_stage_order, pipeline_apply
+
+    arr = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(arr, ("dcn", "pp", "dp"))
+    pp, rows, n_mb = 4, 8, 4
+    ws = jax.random.normal(jax.random.PRNGKey(0), (rows, 16, 16)) / 4.0
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+
+    def stage_fn(w, xs):
+        return jnp.tanh(xs @ w)
+
+    def lower(v, w):
+        def loss(wv, xv):
+            out = pipeline_apply(
+                stage_fn, wv, xv, mesh=mesh, n_microbatches=n_mb,
+                axis_name=("dcn", "pp"), virtual_stages_per_device=v,
+                stage_order="schedule",
+            )
+            return jnp.sum(out ** 2)
+
+        return jax.jit(jax.value_and_grad(loss)).lower(w, x).compile().as_text()
+
+    order = interleaved_stage_order(rows, pp, 2)
+    reps = {}
+    for v, w in ((1, ws), (2, jnp.take(ws, order, axis=0))):
+        rep = mesh_collective_report(lower(v, w), mesh)
+        assert_no_cross_slice(rep)
+        reps[v] = rep
+
+    def dcn_hop_payloads(rep):
+        return sorted(
+            op.payload_bytes for op in rep["ops"]
+            if op.crosses_dcn and op.kind == "collective-permute"
+        )
+
+    # same hop count (fwd + transposed bwd), same per-hop payload
+    assert dcn_hop_payloads(reps[2]) == dcn_hop_payloads(reps[1])
+    assert len(dcn_hop_payloads(reps[1])) > 0
+    # one-copy invariant (stages_per_slice=2): each boundary hop ships the
+    # microbatch activation reduce-scattered over the intra-slice pp axis
+    mb_payload = (8 // n_mb) * 16 * 4
+    assert all(p == mb_payload // 2 for p in dcn_hop_payloads(reps[1]))
+
+
 # ------------------------------------------------------- byte counters
 
 
@@ -325,6 +458,55 @@ def test_byte_report_parses_explicit_iota_and_pairs():
     assert rep["total_bytes"] > rep["dcn_bytes"]
 
 
+def test_byte_report_per_axis_split_and_dtype():
+    """Satellite: a separable op whose groups span dcn x ICI axes is
+    charged on BOTH tiers — the runtime reduces/gathers intra-slice first
+    (ICI leg) then exchanges once over DCN — for all-reduce AND the
+    reduce-scatter/all-gather pair fsdp lowers to. Payload dtype rides
+    along so the quantize-wrapped dcn exchange is auditable as s8."""
+    hlo = "\n".join([
+        # gradient all-reduce over ("dcn","dp"): {0,2,4,6} spans both
+        '%ar = f32[256]{0} all-reduce(f32[256]{0} %g), replica_groups={{0,2,4,6},{1,3,5,7}}, to_apply=%add',
+        # fsdp grad reduce-scatter over the same span
+        '%rs = f32[64]{0} reduce-scatter(f32[256]{0} %g), replica_groups={{0,2,4,6},{1,3,5,7}}, dimensions={0}, to_apply=%add',
+        # fsdp param all-gather over the same span
+        '%ag = bf16[256]{0} all-gather(bf16[64]{0} %p), replica_groups={{0,2,4,6},{1,3,5,7}}, dimensions={0}',
+        # quantized dcn-only gradient exchange (compress.py): s8 payload
+        '%q = s8[418,256]{1,0} all-reduce(s8[418,256]{1,0} %qg), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add',
+    ])
+    rep = collective_byte_report(
+        hlo, axis_names=("dcn", "dp", "tp"), axis_sizes=(2, 2, 2)
+    )
+    by_kind = {op.kind: op for op in rep["ops"] if op.dtype != "s8"}
+    ar, rs, ag = by_kind["all-reduce"], by_kind["reduce-scatter"], by_kind["all-gather"]
+    for op in (ar, rs, ag):
+        assert op.axes == ("dcn", "dp") and op.separable, op
+        # charged per tier: payload on the ICI leg AND the DCN exchange
+        assert op.dcn_bytes == op.payload_bytes, op
+        assert op.ici_bytes == op.payload_bytes, op
+    assert ar.payload_bytes == 256 * 4 and ar.dtype == "f32"
+    assert rs.payload_bytes == 64 * 4          # per-participant output
+    assert ag.payload_bytes == 256 * 2 and ag.dtype == "bf16"
+    q = next(op for op in rep["ops"] if op.dtype == "s8")
+    assert q.axes == ("dcn",)
+    assert q.dcn_bytes == 418 * 256 and q.ici_bytes == 0
+    # hierarchical (separable) spans are the supported shape: no flag
+    assert_no_cross_slice(rep)
+
+    # a NON-separable dcn-crossing reduction stays dcn-only (it cannot be
+    # decomposed into an intra-slice leg) and trips the cross-slice check
+    # when it also mixes a bandwidth-hungry axis
+    bad = collective_byte_report(
+        '%b = f32[32]{0} all-reduce(f32[32]{0} %v), replica_groups={{0,3,4,7},{1,2,5,6}}, to_apply=%add',
+        axis_names=("dcn", "dp", "tp"), axis_sizes=(2, 2, 2),
+    )
+    op = bad["ops"][0]
+    assert not op.separable
+    assert op.dcn_bytes == 32 * 4 and op.ici_bytes == 0
+    with pytest.raises(AssertionError, match="all-reduce"):
+        assert_no_cross_slice(bad)
+
+
 def test_byte_report_flags_leaked_tp_across_slices():
     """A data-movement op whose groups mix tp with dcn is exactly the leak
     assert_no_cross_slice exists to catch."""
@@ -342,6 +524,152 @@ def test_byte_report_flags_leaked_tp_across_slices():
     ))
 
 
+# ------------------------------------------- dcn gradient compression
+
+
+def _compress_cfg():
+    # scan_layers=False so every gradient collective is a TOP-LEVEL HLO op:
+    # the static byte counter counts while-body ops once, which would
+    # undercount the fp32 baseline and understate the compression ratio
+    return dataclasses.replace(
+        CONFIGS["tiny"], n_layers=2, dtype=jnp.float32, scan_layers=False
+    )
+
+
+def _train_steps(cfg, mesh, rules, compression, n_steps=6):
+    from ray_tpu.train.step import (
+        default_optimizer, make_sharded_init, make_train_step,
+    )
+
+    opt = default_optimizer(lr=1e-3, warmup=1)
+    init_fn, shardings = make_sharded_init(
+        cfg, mesh, rules, opt, dcn_grad_compression=compression
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    step = make_train_step(
+        cfg, mesh, rules, opt, shardings, dcn_grad_compression=compression
+    )
+    hlo = step.lower(state, _token_batch(cfg, 16, seed=100)).compile().as_text()
+    losses = []
+    for i in range(n_steps):
+        state, m = step(state, _token_batch(cfg, 16, seed=100 + i))
+        losses.append(float(m["loss"]))
+    return losses, hlo, state
+
+
+def test_dcn_grad_compression_int8_ef_tracks_fp32(sharding_invariant_rng):
+    """int8 + error-feedback gradient exchange tracks the fp32 trajectory,
+    cuts DCN bytes >= 3.5x, and leaves intra-slice (ICI) gradient traffic
+    bit-for-bit untouched — the compression is dcn-ONLY by construction
+    (per-slice grads via vmap(spmd_axis_name='dcn'), fp32 ICI reduce,
+    quantized dcn exchange)."""
+    from ray_tpu.util.collective.compress import EFState
+
+    cfg = _compress_cfg()
+    topo, rules = dp_outer(
+        2, MeshSpec(dp=4), fsdp_params=False, tensor_parallel=False
+    )
+    mesh = build_multislice_mesh(topo)
+    l_off, hlo_off, _ = _train_steps(cfg, mesh, rules, "off")
+    l_i8, hlo_i8, state = _train_steps(cfg, mesh, rules, "int8")
+    # step-0 loss is pre-update: identical params — only the loss reduction
+    # order differs (mean of per-slice means vs one global mean)
+    assert abs(l_off[0] - l_i8[0]) < 1e-5, (l_off[0], l_i8[0])
+    assert max(abs(a - b) for a, b in zip(l_off, l_i8)) < 5e-3, (l_off, l_i8)
+
+    rep_off = mesh_collective_report(hlo_off, mesh)
+    rep_i8 = mesh_collective_report(hlo_i8, mesh)
+    assert_no_cross_slice(rep_i8)
+    # dcn-only: the intra-slice gradient reduce is untouched (exact equality
+    # via the per-axis split of the hierarchical ("dcn","dp") all-reduce)
+    assert rep_i8["ici_bytes"] == rep_off["ici_bytes"], (
+        rep_i8["ici_bytes"], rep_off["ici_bytes"]
+    )
+    # the gate figure: >= 3.5x fewer slice-boundary bytes (~3.93 @ block=256)
+    ratio = rep_off["dcn_bytes"] / rep_i8["dcn_bytes"]
+    assert ratio >= 3.5, ratio
+    # the dcn exchange really is ONE s8 all-reduce over the dcn axis alone
+    s8 = [op for op in rep_i8["ops"] if op.dtype == "s8"]
+    assert len(s8) == 1 and s8[0].kind == "all-reduce", s8
+    assert s8[0].axes == ("dcn",)
+    assert "s8[" not in hlo_off  # the off path compiles no quantized ops
+    # EF residuals ride the optimizer state: [n_slices, padded] on P("dcn"),
+    # nonzero after real steps (they carry the quantization rounding error)
+    assert isinstance(state.opt_state[1], EFState)
+    assert state.opt_state[1].residual.shape[0] == 2
+    assert float(jnp.sum(jnp.abs(state.opt_state[1].residual))) > 0.0
+
+
+def test_dcn_grad_compression_resolve_and_degrade():
+    from ray_tpu.train.step import resolve_dcn_compression
+
+    mesh1 = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    # single slice: nothing to compress — int8 silently degrades to off
+    assert resolve_dcn_compression("int8", mesh1) == "off"
+    assert resolve_dcn_compression("off", mesh1) == "off"
+    assert resolve_dcn_compression(None, mesh1) == "off"  # global default
+    with pytest.raises(ValueError, match="train_dcn_grad_compression"):
+        resolve_dcn_compression("fp8", mesh1)
+    topo, rules_dp = dp_outer(2, MeshSpec(dp=4))
+    mesh2 = build_multislice_mesh(topo)
+    assert resolve_dcn_compression("int8", mesh2) == "int8"
+    assert resolve_dcn_compression("int8", mesh2, rules_dp) == "int8"
+    # pp_outer's dcn axis carries stage activations, not a gradient
+    # all-reduce: with the rule table in hand int8 degrades to off
+    topo_pp, rules_pp = pp_outer(2, MeshSpec(dp=4))
+    mesh3 = build_multislice_mesh(topo_pp)
+    assert resolve_dcn_compression("int8", mesh3, rules_pp) == "off"
+
+
+def test_ef_residual_checkpoint_roundtrip(tmp_path, sharding_invariant_rng):
+    """EF residuals ride checkpoints through the optimizer state; a
+    checkpoint written BEFORE compression was on (no EFState entry)
+    restores into a compression-enabled state with zeroed residuals and
+    the right sharding — no tree/shape errors (regression for the
+    restore_train_state fallback)."""
+    from ray_tpu.train.checkpoint import (
+        abstract_like, restore_train_state, save_checkpoint,
+    )
+    from ray_tpu.train.step import default_optimizer, make_sharded_init
+    from ray_tpu.util.collective.compress import EFState
+
+    cfg = _compress_cfg()
+    topo, rules = dp_outer(
+        2, MeshSpec(dp=4), fsdp_params=False, tensor_parallel=False
+    )
+    mesh = build_multislice_mesh(topo)
+    opt = default_optimizer(lr=1e-3, warmup=1)
+    init_i8, _ = make_sharded_init(
+        cfg, mesh, rules, opt, dcn_grad_compression="int8"
+    )
+    state = init_i8(jax.random.PRNGKey(0))
+    inner, ef = state.opt_state
+    ef = EFState(residual=ef.residual + 0.5)  # make the round trip observable
+    state = state._replace(opt_state=(inner, ef))
+    path = save_checkpoint(str(tmp_path / "with_ef"), state, step=1)
+    restored = restore_train_state(path, abstract_like(state))
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state[1].residual), np.asarray(ef.residual)
+    )
+
+    # pre-compression checkpoint: same TrainState minus the EF entry
+    init_off, _ = make_sharded_init(
+        cfg, mesh, rules, opt, dcn_grad_compression="off"
+    )
+    old = init_off(jax.random.PRNGKey(0))
+    path2 = save_checkpoint(str(tmp_path / "no_ef"), old, step=1)
+    restored2 = restore_train_state(path2, abstract_like(state))
+    assert isinstance(restored2.opt_state[1], EFState)
+    assert float(jnp.sum(jnp.abs(restored2.opt_state[1].residual))) == 0.0
+    assert (
+        restored2.opt_state[1].residual.sharding
+        == state.opt_state[1].residual.sharding
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored2.params["embed"]), np.asarray(old.params["embed"])
+    )
+
+
 # ------------------------------------------------------- trainer plumbing
 
 
@@ -353,6 +681,16 @@ def test_scaling_config_validates_num_slices():
     with pytest.raises(ValueError, match="num_slices"):
         ScalingConfig(num_workers=2, num_slices=0)
     assert ScalingConfig(num_workers=4, num_slices=2).num_slices == 2
+    with pytest.raises(ValueError, match="virtual_stages_per_device"):
+        ScalingConfig(virtual_stages_per_device=0)
+    with pytest.raises(ValueError, match="dcn_grad_compression"):
+        ScalingConfig(dcn_grad_compression="fp8")
+    sc = ScalingConfig(
+        num_workers=4, num_slices=2,
+        virtual_stages_per_device=2, dcn_grad_compression="int8",
+    )
+    assert sc.virtual_stages_per_device == 2
+    assert sc.dcn_grad_compression == "int8"
 
 
 def test_session_builds_two_level_mesh_from_context():
@@ -361,9 +699,12 @@ def test_session_builds_two_level_mesh_from_context():
     ScalingConfig.num_slices through."""
     from ray_tpu.train import session as S
 
-    ctx = S.TrainContext(world_rank=1, world_size=2, num_slices=2)
+    ctx = S.TrainContext(
+        world_rank=1, world_size=2, num_slices=2, virtual_stages_per_device=2
+    )
     S._set_context(ctx)
     try:
+        assert S.get_virtual_stages_per_device() == 2
         mesh, rules = S.build_multislice_mesh(
             MeshSpec(dp=-1, tp=2), preset="dp_outer"
         )
