@@ -764,6 +764,118 @@ def bench_serve_cross_replica(trials: int = 3) -> dict:
     }
 
 
+def bench_serve_weight_swap(new_tokens: int = 48, n_streams: int = 4) -> dict:
+    """Live weight hot-swap latency cost, gated (--only row, needs a
+    cluster for the bulk plane + pubsub): decode p99 inter-token latency
+    measured while a WeightPublisher -> WeightSubscriber swap lands
+    mid-generation must stay within 10x the quiescent p99 on the same
+    batcher. The swap preempts every live slot and recomputes their
+    histories under the new weights (see kv_paging.set_params), so the
+    stall IS the product — n_streams/total gaps sit above the 99th
+    percentile by construction, which makes p99 land inside the stall:
+    the gate bounds the stall itself, not the steady state around it.
+    Any stream that drops or comes back short zeroes the row (ratio 999):
+    a fast swap that loses streams is worthless. weight_swap_publish_s
+    (flatten + chunked puts + manifest push) ships informational."""
+    import dataclasses
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import CONFIGS, init_params
+    from ray_tpu.models.kv_paging import PagedDecodeEngine
+    from ray_tpu.serve.batching import ContinuousBatcher
+    from ray_tpu.serve.weight_swap import WeightPublisher, WeightSubscriber
+
+    cfg = CONFIGS["tiny"]
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    versions = [init_params(k, cfg) for k in keys]
+    engine = PagedDecodeEngine(
+        cfg, versions[0], max_batch_size=n_streams, temperature=0.0,
+        num_blocks=128, seed=0, telemetry=False,
+    )
+    batcher = ContinuousBatcher(engine, telemetry=False)
+    sub = WeightSubscriber(engine, "bench_swap", batcher=batcher).start()
+    pub = WeightPublisher("bench_swap")
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, size=8) for _ in range(n_streams)]
+
+    def drain(stream, gaps, toks):
+        last = None
+        while True:
+            items, done = stream.next_batch(max_items=1, wait_s=30.0)
+            now = time.perf_counter()
+            if items:
+                if last is not None:
+                    gaps.append(now - last)
+                last = now
+                toks.extend(items)
+            if done:
+                return
+
+    def phase(swap_params=None, swap_version=None):
+        """Run n_streams concurrent generations to completion; returns
+        (all inter-token gaps, per-stream token counts, publish seconds)."""
+        streams = [
+            batcher.submit(tokens=np.asarray(p, np.int32),
+                           max_new_tokens=new_tokens)
+            for p in prompts
+        ]
+        gaps = [[] for _ in streams]
+        toks = [[] for _ in streams]
+        threads = [
+            threading.Thread(target=drain, args=(s, g, t), daemon=True)
+            for s, g, t in zip(streams, gaps, toks)
+        ]
+        for t in threads:
+            t.start()
+        publish_s = 0.0
+        if swap_params is not None:
+            # let the streams reach steady-state decode, then land the
+            # swap mid-generation through the live plane
+            while min(len(t) for t in toks) < new_tokens // 3:
+                time.sleep(0.005)
+            t0 = time.perf_counter()
+            pub.publish(swap_params, version=swap_version)
+            publish_s = time.perf_counter() - t0
+            deadline = time.time() + 30.0
+            while engine.weight_version != swap_version and time.time() < deadline:
+                time.sleep(0.005)
+        for t in threads:
+            t.join(timeout=60.0)
+        return (
+            [g for gs in gaps for g in gs],
+            [len(t) for t in toks],
+            publish_s,
+        )
+
+    # warmup pays every one-time jit: prefill + decode buckets AND the
+    # swap path's readmit prefill (preempted histories land in a longer
+    # prefill bucket the plain path never compiles) — the measured phase
+    # then times the swap itself, not a first-touch compile
+    phase(swap_params=versions[1], swap_version=1)
+    q_gaps, q_counts, _ = phase()
+    s_gaps, s_counts, publish_s = phase(swap_params=versions[2], swap_version=2)
+    survived = (
+        all(c == new_tokens for c in q_counts + s_counts)
+        and engine.weight_version == 2
+        and engine.weight_swaps == 2
+    )
+    q_p99 = float(np.percentile(q_gaps, 99)) if q_gaps else 0.0
+    s_p99 = float(np.percentile(s_gaps, 99)) if s_gaps else 0.0
+    ratio = (s_p99 / max(q_p99, 1e-9)) if survived else 999.0
+    sub.stop()
+    batcher.close()
+    return {
+        "weight_swap_quiescent_p99_ms": round(q_p99 * 1000, 2),
+        "weight_swap_during_p99_ms": round(s_p99 * 1000, 2),
+        "weight_swap_publish_s": round(publish_s, 3),
+        "weight_swap_streams_survived": survived,
+        "weight_swap_p99_ratio_x": round(ratio, 2),
+    }
+
+
 def bench_decode_telemetry_overhead(
     new_tokens: int = 128, batch: int = 8,
 ) -> dict:
@@ -1320,6 +1432,11 @@ GATES = {
     # slice-boundary bytes than the fp32 all-reduce (~3.93 @ block=256),
     # zeroed unless ICI bytes are untouched and the loss tracks fp32
     "dcn_grad_bytes_ratio_x": (">=", 3.5),
+    # live weight hot-swap (--only serve_weight_swap row): decode p99
+    # inter-token latency with a publish->pull->preempt->recompute swap
+    # landing mid-generation stays within 10x the quiescent p99; zeroed
+    # to 999 if any stream drops or comes back short of its token budget
+    "weight_swap_p99_ratio_x": ("<=", 10.0),
 }
 
 
@@ -1375,7 +1492,8 @@ def main():
         if k not in ("cross_node_256mb_gbps",
                      "cross_replica_prefix_hit_speedup_x",
                      "pipeline_bubble_reduction_x",
-                     "dcn_grad_bytes_ratio_x")
+                     "dcn_grad_bytes_ratio_x",
+                     "weight_swap_p99_ratio_x")
     )
     expected = set(gated) | {"host_memcpy_gbps"}
     trials = []
@@ -1514,6 +1632,8 @@ ROWS = {
     "prefix_hit": (bench_prefix_hit, False, ("prefix_hit_speedup_x",)),
     "serve_cross_replica": (bench_serve_cross_replica, False,
                             ("cross_replica_prefix_hit_speedup_x",)),
+    "serve_weight_swap": (bench_serve_weight_swap, True,
+                          ("weight_swap_p99_ratio_x",)),
     "train_dcn_plane": (bench_train_dcn_plane, False,
                         ("pipeline_bubble_reduction_x",
                          "dcn_grad_bytes_ratio_x")),

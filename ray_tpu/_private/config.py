@@ -341,6 +341,21 @@ D("serve_prefix_hint_tokens", int, 64,
 D("serve_prefix_digest_size", int, 512,
   "per-deployment cap on the controller's prefix->replica digest "
   "(bounded LRU: oldest hint evicted first)")
+D("serve_weight_swap", bool, True,
+  "live weight plane (serve/weight_swap.py): learners publish versioned "
+  "param trees as bulk-plane objects, replicas subscribe over long-poll, "
+  "pull + device_put by their own partition rules and hot-swap between "
+  "engine steps — in-flight streams survive (recompute-on-readmit), the "
+  "prefix cache flushes, and the transfer-sig version bumps so stale "
+  "chain keys can never serve new-weight traffic. Off = subscribers "
+  "never attach; publish() still works for manual pulls")
+D("serve_weight_chunk_mb", int, 64,
+  "per-leaf chunk size for published weights: leaves larger than this "
+  "ship as multiple bulk-plane objects so pulls stripe across senders "
+  "and a single giant leaf cannot serialize the swap; 0 = never chunk")
+D("serve_weight_poll_timeout_s", float, 10.0,
+  "long-poll timeout of the replica-side weight watcher (how long one "
+  "poll parks on the weights channel before re-arming)")
 D("serve_disaggregate", bool, False,
   "disaggregated prefill/decode default for kv_transfer.deploy_"
   "disaggregated(): prefill-tagged replicas run chunked prefill to "

@@ -41,6 +41,11 @@ Directives
                              ships): the importer's verification fails
                              and the request falls back to local
                              recompute — never wrong tokens
+  weight_swap_drop:<sel>     truncate the selected live weight pull
+                             (serve/weight_swap.py): leaf verification
+                             fails, the swap aborts whole, and the
+                             replica keeps serving its previous version
+                             intact — never a half-swapped tree
 
 ``<sel>`` is a 1-based occurrence number (``1`` = first match) or
 ``rand:<p>`` (fire with probability p, seeded). Counters are per-directive
@@ -124,6 +129,12 @@ class FaultController:
                     raise ValueError(f"fault directive needs 2 fields: {part!r}")
                 self.directives.append(
                     _Directive(kind, "kv", ":".join(fields[1:]))
+                )
+            elif kind == "weight_swap_drop":
+                if len(fields) < 2:
+                    raise ValueError(f"fault directive needs 2 fields: {part!r}")
+                self.directives.append(
+                    _Directive(kind, "weight", ":".join(fields[1:]))
                 )
             else:
                 raise ValueError(f"unknown fault directive kind: {part!r}")
@@ -212,6 +223,18 @@ class FaultController:
         with self._lock:
             for d in self.directives:
                 if d.kind == "kv_transfer_drop":
+                    if self._selected(d):
+                        self._record(d)
+                        action = "drop"
+        return action
+
+    def weight_swap_action(self) -> Optional[str]:
+        """'drop' (truncate this live weight pull so verification fails
+        and the swap aborts whole) or None, for one version being pulled."""
+        action = None
+        with self._lock:
+            for d in self.directives:
+                if d.kind == "weight_swap_drop":
                     if self._selected(d):
                         self._record(d)
                         action = "drop"
@@ -311,6 +334,11 @@ def bulk_action() -> Optional[str]:
 def kv_transfer_action() -> Optional[str]:
     c = _CTL
     return c.kv_transfer_action() if c is not None else None
+
+
+def weight_swap_action() -> Optional[str]:
+    c = _CTL
+    return c.weight_swap_action() if c is not None else None
 
 
 # Env arming at import: worker processes import this via protocol.py at

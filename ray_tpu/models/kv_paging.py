@@ -63,6 +63,7 @@ import numpy as np
 
 from .decoding import default_prefill_buckets
 from .transformer import (
+    NEG_INF,
     TransformerConfig,
     init_paged_kv_cache,
     init_params,
@@ -143,6 +144,7 @@ class PrefixCache:
         self._clock = 0
         self.hits = 0
         self.evictions = 0
+        self.flushes = 0
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -243,6 +245,21 @@ class PrefixCache:
                 freed += 1
         return freed
 
+    def flush(self) -> int:
+        """Drop EVERY node unconditionally — the weight-hot-swap path:
+        cached KV was computed under the OLD weights and must never be
+        spliced under a new-weight admission. Only the cache's own
+        references are released; a block shared with a live slot simply
+        loses the cache ref and frees when the slot retires. Returns the
+        number of nodes dropped."""
+        n = len(self._nodes)
+        for node in self._nodes.values():
+            self._alloc.decref(node["block"])
+        self._nodes.clear()
+        self._children.clear()
+        self.flushes += 1
+        return n
+
 
 class PagedDecodeEngine:
     """Block-granular KV-cache decode engine (module docstring has the
@@ -276,6 +293,7 @@ class PagedDecodeEngine:
         prefill_chunk_tokens: Optional[int] = None,
         telemetry=None,
         model_id: Optional[str] = None,
+        logprobs: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -327,23 +345,19 @@ class PagedDecodeEngine:
         # cross-replica transfer identity (serve/kv_transfer.py): two
         # engines produce matching export keys iff they agree on every
         # byte-layout-relevant knob — model identity, block geometry,
-        # pool storage dtype, layer/head shape. The signature SEEDS the
+        # pool storage dtype, layer/head shape, and (once a hot swap has
+        # happened) the WEIGHT VERSION. The signature SEEDS the
         # content-addressed key chain, so keys minted under a different
-        # model / dtype / geometry can never collide with this pool's
-        # (the int8-into-fp poison case is unrepresentable by key
+        # model / dtype / geometry / weight version can never collide
+        # with this pool's (the int8-into-fp poison case — and the
+        # stale-weights-KV poison case — are unrepresentable by key
         # construction, not merely checked at import).
         self.model_id = str(
             model_id if model_id is not None else gcfg.serve_model_id or ""
         )
-        sig = hashlib.sha1()
-        sig.update(b"ray_tpu.kv_transfer.v1|")
-        sig.update(self.model_id.encode())
-        sig.update(
-            f"|bt={bt}|kv={self.kv_cache_dtype}"
-            f"|sd={np.dtype(kv_dtype).name}"
-            f"|L={cfg.n_layers}|H={cfg.n_kv_heads}|D={cfg.d_head}".encode()
-        )
-        self.transfer_sig = sig.digest()
+        self._kv_store_dtype = np.dtype(kv_dtype).name
+        self.weight_version = 0
+        self.transfer_sig = self._compute_transfer_sig()
 
         attention_impl = attention_impl or gcfg.serve_paged_attention
         fused_impl = "auto"
@@ -435,6 +449,39 @@ class PagedDecodeEngine:
                 "speculative decoding"
             )
 
+        # per-token logprobs (generation-based RL, rl/llm): each emitted
+        # token becomes a (token, logprob) pair — the logprob of the
+        # SAMPLED id under the exact distribution the sampler drew from
+        # (same vocab_pad masking, same temperature scaling, fp32), so a
+        # dense re-forward reproduces it bit-for-tolerance. Restricted to
+        # speculative_k == 0: the verify step commits accepted drafts
+        # without returning per-position logits.
+        self.logprobs = bool(logprobs)
+        if self.logprobs and self.speculative_k:
+            raise ValueError(
+                "logprobs=True requires speculative_k == 0 — the verify "
+                "step returns no per-position logits to score"
+            )
+        self.temperature = float(temperature)
+        if self.logprobs:
+            vocab_pad = int(getattr(cfg, "vocab_pad", 0) or 0)
+            temp = self.temperature
+
+            def _lp(logits, toks):
+                logits = logits.astype(jnp.float32)
+                if vocab_pad:
+                    V = logits.shape[-1]
+                    pad = jnp.arange(V) >= V - vocab_pad
+                    logits = jnp.where(pad, NEG_INF, logits)
+                if temp > 0.0:
+                    logits = logits / temp
+                lp = jax.nn.log_softmax(logits, axis=-1)
+                return jnp.take_along_axis(
+                    lp, toks[:, None].astype(jnp.int32), axis=-1
+                )[:, 0]
+
+            self._lp_fn = jax.jit(_lp)
+
         if num_blocks is not None and pool_bytes is not None:
             raise ValueError(
                 "num_blocks and pool_bytes are conflicting pool sizes — "
@@ -488,6 +535,10 @@ class PagedDecodeEngine:
             params if params is not None
             else init_params(jax.random.PRNGKey(seed), cfg)
         )
+        # swap-time device_put (serve/weight_swap.py) re-distributes a
+        # pulled host tree by THIS engine's partition rules
+        self._rules = rules
+        self._mesh = mesh
         self.allocator = BlockAllocator(self.num_blocks)
         if prefix_cache is None:
             prefix_cache = bool(gcfg.serve_kv_prefix_cache)
@@ -535,6 +586,9 @@ class PagedDecodeEngine:
         self._admit_seq = np.zeros(B, np.int64)
         self._seq = 0
         self._preempted: List[Tuple[int, Dict[str, Any]]] = []
+        # logprob of the pending first sampled token per slot (set by the
+        # completing prefill chunk, read by admit()/step() when emitting)
+        self._lp_pending = np.zeros(B, np.float64)
 
         # counters (bench/observability/tests)
         self.tokens_generated = 0
@@ -562,8 +616,27 @@ class PagedDecodeEngine:
         self.kv_blocks_imported = 0
         self.kv_tokens_imported = 0
         self.kv_import_rejects = 0
+        # live weight hot-swap counters (serve/weight_swap.py)
+        self.weight_swaps = 0
 
     # ------------------------------------------------------------- internals
+
+    def _compute_transfer_sig(self) -> bytes:
+        sig = hashlib.sha1()
+        sig.update(b"ray_tpu.kv_transfer.v1|")
+        sig.update(self.model_id.encode())
+        sig.update(
+            f"|bt={self.block_tokens}|kv={self.kv_cache_dtype}"
+            f"|sd={self._kv_store_dtype}"
+            f"|L={self.cfg.n_layers}|H={self.cfg.n_kv_heads}"
+            f"|D={self.cfg.d_head}".encode()
+        )
+        # version 0 (never swapped) keeps the original byte layout, so
+        # engines that never hot-swap interoperate with older peers; any
+        # swap moves the whole key space
+        if self.weight_version:
+            sig.update(f"|wv={self.weight_version}".encode())
+        return sig.digest()
 
     def _next_key(self):
         import jax
@@ -805,7 +878,12 @@ class PagedDecodeEngine:
             tok = self._run_prefill_chunk(slot, whole=True)
         if tok is None:
             return None, False
-        return tok, self._done(slot, tok)
+        done = self._done(slot, tok)
+        if self.logprobs:
+            # (token, logprob) pairs are the emitted unit in logprob mode;
+            # the batcher pushes tuples atomically
+            return (tok, float(self._lp_pending[slot])), done
+        return tok, done
 
     def _run_prefill_chunk(self, slot: int, whole: bool = False) -> Optional[int]:
         """Consume the next prompt span of the slot's pending prefill
@@ -842,7 +920,7 @@ class PagedDecodeEngine:
         # what keeps temperature > 0 tokens invariant to the chunk config
         # (greedy never reads the key at all)
         key = self._next_key() if last else jax.random.PRNGKey(0)
-        next_tok, _, self.pool = self._prefill(
+        next_tok, logits, self.pool = self._prefill(
             self.params, self.pool, self._tables[slot],
             padded[None], np.int32(take), np.int32(ctx),
             key, ctx_blocks,
@@ -861,6 +939,10 @@ class PagedDecodeEngine:
         if not last:
             return None
         tok = int(next_tok[0])
+        if self.logprobs:
+            self._lp_pending[slot] = float(
+                np.asarray(self._lp_fn(logits, next_tok))[0]
+            )
         self._chunk_state[slot] = None
         self._last_tokens[slot] = tok
         self._new_counts[slot] = 1
@@ -944,9 +1026,14 @@ class PagedDecodeEngine:
         ]
         for s in prefilling:
             tok = self._run_prefill_chunk(s)
-            out[s] = ([], False) if tok is None else (
-                [tok], self._done(s, tok)
-            )
+            if tok is None:
+                out[s] = ([], False)
+            else:
+                item = (
+                    (tok, float(self._lp_pending[s]))
+                    if self.logprobs else tok
+                )
+                out[s] = ([item], self._done(s, tok))
         decoding = [s for s in surviving if self._chunk_state[s] is None
                     and s not in out]
         if decoding:
@@ -1033,7 +1120,7 @@ class PagedDecodeEngine:
             )
         return surviving
 
-    def _plain_step(self, surviving: List[int]) -> Dict[int, Tuple[int, bool]]:
+    def _plain_step(self, surviving: List[int]) -> Dict[int, Tuple[Any, bool]]:
         bt = self.block_tokens
         t0 = time.monotonic() if self._tel is not None else 0.0
 
@@ -1053,12 +1140,16 @@ class PagedDecodeEngine:
             pos = int(self._positions[s])
             write_phys[s] = self._tables[s, pos // bt]
             write_off[s] = pos % bt
-        next_toks, _, self.pool = self._decode_step(
+        next_toks, logits, self.pool = self._decode_step(
             self.params, self.pool, self._tables, self._last_tokens,
             self._positions, write_phys, write_off, self._next_key(),
         )
         toks = np.asarray(next_toks)
-        out: Dict[int, Tuple[int, bool]] = {}
+        lps = (
+            np.asarray(self._lp_fn(logits, next_toks))
+            if self.logprobs else None
+        )
+        out: Dict[int, Tuple[Any, bool]] = {}
         for s in surviving:
             tok = int(toks[s])
             self._positions[s] += 1
@@ -1067,7 +1158,8 @@ class PagedDecodeEngine:
             hist = self._history[s]
             if hist is not None:
                 hist.append(tok)
-            out[s] = (tok, self._done(s, tok))
+            item = (tok, float(lps[s])) if lps is not None else tok
+            out[s] = (item, self._done(s, tok))
             if (self._rec is not None and self.eos_id is not None
                     and tok == self.eos_id):
                 self._rec.record("eos", slot=s)
@@ -1275,6 +1367,66 @@ class PagedDecodeEngine:
                     args={"tokens": int(self._new_counts[slot])})
             self._release_blocks(slot)
         self._new_counts[slot] = 0
+
+    # --------------------------------------------------- live weight hot-swap
+
+    def set_params(self, params, version: Optional[int] = None,
+                   bytes_pulled: int = 0) -> int:
+        """Swap the engine's weights between steps (live weight update —
+        serve/weight_swap.py routes here via ContinuousBatcher.run_on_loop;
+        loop thread only, like admit/step). Returns the new version.
+
+        Swap semantics are RECOMPUTE, not splice: every live slot is
+        preempted (full history parked; the batcher readmits it and
+        prompt + generated-so-far prefills under the NEW weights) and the
+        prefix cache is flushed, so KV computed under the old weights can
+        never attend to new-weight queries. That is exactly what makes
+        every post-swap token greedy-identical to a fresh engine loaded
+        with the new weights — splicing stale KV under new weights would
+        emit tokens NEITHER model would produce. In-flight streams stay
+        open throughout (recompute-on-readmit, the preemption contract);
+        their consumers see added latency, never a drop.
+
+        The transfer signature is re-derived with the new version, so
+        cross-replica chain keys minted under the old weights are
+        disjoint from the new key space by construction, and the drafter
+        is refreshed (refresh(params) hook when it has one, stale state
+        cleared) so swap-then-speculate proposes from the new weights."""
+        t0 = time.monotonic()
+        for s in range(self.max_batch_size):
+            if self._live[s]:
+                self._preempt(s)
+        flushed = (
+            self.prefix_cache.flush() if self.prefix_cache is not None else 0
+        )
+        self.params = params
+        self.weight_version = (
+            int(version) if version is not None else self.weight_version + 1
+        )
+        self.transfer_sig = self._compute_transfer_sig()
+        self.weight_swaps += 1
+        drafter = self.drafter
+        if drafter is not None:
+            refresh = getattr(drafter, "refresh", None)
+            if refresh is not None:
+                try:
+                    refresh(params)
+                except Exception:
+                    # drafter faults degrade to 'no draft' (the _propose
+                    # contract) — they must never fail the swap
+                    pass
+        if self._tel is not None:
+            gauge = getattr(self._tel, "weight_version", None)
+            if gauge is not None:
+                gauge.set(self.weight_version)
+        if self._rec is not None:
+            self._rec.record(
+                "weight_swap", dur=time.monotonic() - t0,
+                args={"version": self.weight_version,
+                      "bytes": int(bytes_pulled),
+                      "flushed_blocks": flushed},
+            )
+        return self.weight_version
 
     # --------------------------------------------- cross-replica KV transfer
 
@@ -1485,6 +1637,9 @@ class PagedDecodeEngine:
             "kv_blocks_imported": self.kv_blocks_imported,
             "kv_tokens_imported": self.kv_tokens_imported,
             "kv_import_rejects": self.kv_import_rejects,
+            # live weight hot-swap (serve/weight_swap.py)
+            "weight_version": self.weight_version,
+            "weight_swaps": self.weight_swaps,
             "preemptions": self.preemptions,
             "cow_copies": self.cow_copies,
             # speculative decoding: k=0 means off; rates cover spec steps

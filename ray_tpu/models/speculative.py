@@ -20,6 +20,18 @@ CHEAP relative to a decode step: they run on the batcher's loop thread
 between steps. The engine passes its LIVE history sequence (no per-step
 copy); drafters must treat `tokens` as read-only.
 
+A drafter MAY also expose
+
+    refresh(params) -> None
+
+which the engine calls on a live weight hot-swap
+(`PagedDecodeEngine.set_params`) with the NEW param tree: a
+small-draft-model drafter re-derives its model there, a recording
+drafter drops continuations minted under the old weights. Greedy output
+stays identical either way (verify rejects any stale draft), so refresh
+is a throughput lever, not a correctness one; refresh faults are
+swallowed by the engine (same degrade-to-no-draft contract as propose).
+
 Built-ins:
 
   NGramDrafter   self-drafting suffix lookup (prompt-lookup decoding): find
@@ -73,6 +85,11 @@ class NGramDrafter:
                 return arr[i + n:i + n + k].tolist()
         return []
 
+    def refresh(self, params) -> None:
+        """Weight hot-swap hook: self-drafting holds no model state — the
+        engine re-prefills every live history under the new weights, so
+        the lookup source is already consistent. Nothing to do."""
+
 
 class ReplayDrafter:
     """Propose from recorded sequences: if the history is a proper prefix
@@ -88,6 +105,13 @@ class ReplayDrafter:
             if len(seq) > n and seq[:n] == hist:
                 return seq[n:n + k]
         return []
+
+    def refresh(self, params) -> None:
+        """Weight hot-swap hook: recorded continuations were sampled from
+        the OLD weights — keeping them cannot corrupt output (verify
+        rejects mismatches) but would burn a rejected verify span per
+        step, so drop them."""
+        self.sequences = []
 
 
 class _CallableDrafter:

@@ -62,6 +62,7 @@ from .maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from .qmix import QMIX, QMIXConfig  # noqa: F401
 from .qmix_rec import RecurrentQMIX, RecurrentQMIXConfig  # noqa: F401
 from . import offline  # noqa: F401,E402
+from . import llm  # noqa: F401,E402  (generation-based RL: PPO/GRPO)
 
 from .._private.usage import record_library_usage as _rlu  # noqa: E402
 

@@ -1,0 +1,28 @@
+"""Generation-based RL: PPO/GRPO where the environment is the model.
+
+Reference parity: RLlib's new-stack Learner + the RLHF pattern the LLM
+systems world converged on (rollouts through a serving engine, learner
+updates on a training mesh, live weight sync between them). The pieces:
+
+  rollout.LLMRolloutWorker     samples through ContinuousBatcher +
+                               PagedDecodeEngine(logprobs=True) — the
+                               serving stack IS the env loop
+  advantages                   token-level GAE (PPO) / group-relative
+                               normalized returns (GRPO)
+  learner.LLMLearner           clipped policy updates on the sharded
+                               train-step machinery (+ value head for PPO)
+  trainer.GenerationRLTrainer  rollout -> advantages -> update -> weight
+                               sync; plugs into serve/weight_swap.py's
+                               WeightPublisher for live replica hot-swap
+
+See rl/README.md ("Generation-based RL") for the walkthrough.
+"""
+
+from .advantages import (  # noqa: F401
+    gae_advantages,
+    grpo_advantages,
+    normalize_advantages,
+)
+from .learner import LLMLearner  # noqa: F401
+from .rollout import LLMRolloutWorker  # noqa: F401
+from .trainer import GenerationRLTrainer  # noqa: F401

@@ -1,0 +1,102 @@
+"""Advantage estimation for generation-based RL (host-side numpy).
+
+Two estimators, one batch layout. Rollout batches (rl/llm/rollout.py) are
+padded [N, L] token grids; everything time-indexed here lives on the
+SHIFTED axis T = L-1 — index t scores the prediction of tokens[:, t+1] —
+so advantages drop straight into the learner's per-position logprob grid
+with no realignment.
+
+  gae_advantages   PPO: token-level GAE(gamma, lambda) over the response
+                   span. The scalar sequence reward lands on the LAST
+                   response token (terminal transition, bootstrap 0);
+                   interior response steps carry reward 0 and bootstrap
+                   the critic — the standard RLHF shaping.
+  grpo_advantages  GRPO: no critic. Each prompt's group of sampled
+                   responses normalizes its own rewards,
+                   (r - mean_g) / (std_g + eps), broadcast over that
+                   response's tokens. A group of one (or zero variance)
+                   yields zero advantage — the estimator is RELATIVE by
+                   construction, so group_size >= 2 is the useful regime.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def gae_advantages(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    loss_mask: np.ndarray,
+    gamma: float = 1.0,
+    lam: float = 0.95,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Token-level GAE over response positions.
+
+    rewards [N] scalar sequence rewards; values [N, T] critic outputs on
+    the shifted axis; loss_mask [N, T] 1.0 on response positions.
+    Returns (advantages [N, T], returns [N, T]) — returns are the critic
+    regression targets (adv + value), zero off-response.
+    """
+    rewards = np.asarray(rewards, np.float64)
+    values = np.asarray(values, np.float64)
+    m = np.asarray(loss_mask, bool)
+    N, T = m.shape
+    adv = np.zeros((N, T), np.float64)
+    ret = np.zeros((N, T), np.float64)
+    # last response position per row (terminal transition); rows with no
+    # response tokens never match t == last (last = -1) and stay zero
+    has = m.any(axis=1)
+    last = np.where(has, T - 1 - np.argmax(m[:, ::-1], axis=1), -1)
+    a_next = np.zeros(N, np.float64)
+    v_next = np.zeros(N, np.float64)
+    for t in range(T - 1, -1, -1):
+        active = m[:, t]
+        terminal = last == t
+        r_t = np.where(terminal, rewards, 0.0)
+        delta = r_t + gamma * np.where(terminal, 0.0, v_next) - values[:, t]
+        a_t = delta + gamma * lam * np.where(terminal, 0.0, a_next)
+        adv[:, t] = np.where(active, a_t, 0.0)
+        ret[:, t] = np.where(active, a_t + values[:, t], 0.0)
+        a_next = np.where(active, a_t, a_next)
+        v_next = np.where(active, values[:, t], v_next)
+    return adv.astype(np.float32), ret.astype(np.float32)
+
+
+def grpo_advantages(
+    rewards: np.ndarray,
+    group: np.ndarray,
+    loss_mask: np.ndarray,
+    eps: float = 1e-6,
+) -> np.ndarray:
+    """Group-relative advantages: rewards [N], group [N] (same id = same
+    prompt's sample group), loss_mask [N, T]. Returns [N, T] — the
+    normalized scalar broadcast over each response's tokens."""
+    rewards = np.asarray(rewards, np.float64)
+    group = np.asarray(group)
+    scalar = np.zeros(rewards.shape[0], np.float64)
+    for g in np.unique(group):
+        idx = np.nonzero(group == g)[0]
+        if idx.size < 2:
+            continue  # relative estimator needs a peer to compare against
+        r = rewards[idx]
+        scalar[idx] = (r - r.mean()) / (r.std() + eps)
+    return (scalar[:, None] * np.asarray(loss_mask, np.float64)).astype(
+        np.float32
+    )
+
+
+def normalize_advantages(
+    adv: np.ndarray, loss_mask: np.ndarray, eps: float = 1e-6
+) -> np.ndarray:
+    """Batch-whiten over MASKED entries only (padding zeros would
+    otherwise drag the mean) — the usual PPO variance-reduction step."""
+    adv = np.asarray(adv, np.float64)
+    m = np.asarray(loss_mask, bool)
+    if not m.any():
+        return adv.astype(np.float32)
+    vals = adv[m]
+    out = np.where(m, (adv - vals.mean()) / (vals.std() + eps), 0.0)
+    return out.astype(np.float32)

@@ -1,0 +1,259 @@
+"""PPO-clip / GRPO policy updates over the sharded transformer.
+
+Reference parity: the new-stack Learner (rl/learner.py's PPO machinery)
+re-specialized for generation batches. The update rides the SAME sharded
+train-step machinery as supervised training (train/step.py): param
+shardings come from `param_specs` + the rule table, batches use the
+`batch_sharding` pytree prefix, and the whole update is one jitted
+program whose gradient collectives GSPMD derives from the sharding specs
+alone. (No donated state: the RL learner is exercised by tiny-config CPU
+tests, where donation trips the persistent-compile-cache aliasing issue —
+see ROADMAP.)
+
+Policy logprobs re-derive through `make_forward(_return_backbone=True)`
+with EXACTLY the serving engine's sampler semantics — fp32 logits,
+vocab_pad tail masked to NEG_INF, same temperature divide — so the
+importance ratio exp(logp - behavior_logp) is 1.0 (up to fp noise) on the
+first epoch by construction. The PPO value head is a scalar projection of
+the backbone's final hidden states (w [E] + bias), trained on GAE returns
+— GRPO has no critic, that's its point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+ALGOS = ("ppo", "grpo")
+
+
+class LLMLearner:
+    """One policy (+ optional value head) and its optimizer.
+
+    update(batch) expects the rollout layout (rl/llm/rollout.py) plus
+    `advantages` [N, T] (and, for PPO, `returns` [N, T]) from
+    rl/llm/advantages.py. `params` always exposes the CURRENT model
+    params — what publishers ship and rollout workers adopt."""
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        algo: str = "grpo",
+        temperature: float = 1.0,
+        lr: float = 3e-3,
+        clip_ratio: float = 0.2,
+        vf_coef: float = 0.5,
+        entropy_coef: float = 0.0,
+        kl_coef: float = 0.0,
+        epochs: int = 1,
+        mesh=None,
+        rules=None,
+        optimizer=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ...models.transformer import NEG_INF, make_forward, param_specs
+
+        if algo not in ALGOS:
+            raise ValueError(f"algo must be one of {ALGOS}, got {algo!r}")
+        self.algo = algo
+        self.cfg = cfg
+        self.epochs = int(epochs)
+        self.updates = 0
+        clip = float(clip_ratio)
+        temp = float(temperature)
+        vf = float(vf_coef)
+        ent_c = float(entropy_coef)
+        kl_c = float(kl_coef)
+        vocab_pad = int(getattr(cfg, "vocab_pad", 0) or 0)
+
+        forward, backbone, _constrain = make_forward(
+            cfg, rules, mesh, _return_backbone=True
+        )
+
+        train_params: Dict[str, Any] = {"model": params}
+        if algo == "ppo":
+            train_params["value_w"] = jnp.zeros((cfg.d_model,), jnp.float32)
+            train_params["value_b"] = jnp.zeros((), jnp.float32)
+
+        if optimizer is None:
+            optimizer = optax.chain(
+                optax.clip_by_global_norm(1.0), optax.adam(lr)
+            )
+        self._optimizer = optimizer
+        self._opt_state = optimizer.init(train_params)
+        self._train_params = train_params
+
+        def _logp_and_hidden(model_params, tokens):
+            # engine-sampler-identical logprob semantics (kv_paging._lp):
+            # fp32 -> vocab_pad tail to NEG_INF -> /temperature -> softmax
+            x, unembed = backbone(model_params, tokens[:, :-1])
+            logits = jnp.einsum("bse,ev->bsv", x, unembed)
+            logits = _constrain(logits, "batch", "seq", "vocab")
+            logits = logits.astype(jnp.float32)
+            if vocab_pad:
+                V = logits.shape[-1]
+                pad = jnp.arange(V) >= V - vocab_pad
+                logits = jnp.where(pad, NEG_INF, logits)
+            if temp > 0.0:
+                logits = logits / temp
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            tgt = tokens[:, 1:].astype(jnp.int32)
+            logp = jnp.take_along_axis(logp_all, tgt[..., None], axis=-1)
+            return logp[..., 0], logp_all, x
+
+        def _value(tp, x):
+            h = x.astype(jnp.float32)
+            return jnp.einsum("bse,e->bs", h, tp["value_w"]) + tp["value_b"]
+
+        def loss_fn(tp, batch):
+            logp, logp_all, x = _logp_and_hidden(tp["model"], batch["tokens"])
+            w = batch["loss_mask"].astype(jnp.float32)
+            wsum = jnp.maximum(w.sum(), 1.0)
+            adv = batch["advantages"].astype(jnp.float32)
+            blp = batch["behavior_logp"].astype(jnp.float32)
+            ratio = jnp.exp(logp - blp)
+            clipped = jnp.clip(ratio, 1.0 - clip, 1.0 + clip)
+            pg = -(jnp.minimum(ratio * adv, clipped * adv) * w).sum() / wsum
+            total = pg
+            metrics = {
+                "pg_loss": pg,
+                "ratio_mean": (ratio * w).sum() / wsum,
+                "clip_frac": (
+                    (jnp.abs(ratio - 1.0) > clip).astype(jnp.float32) * w
+                ).sum() / wsum,
+            }
+            if algo == "ppo":
+                v = _value(tp, x)
+                v_loss = (
+                    jnp.square(v - batch["returns"].astype(jnp.float32)) * w
+                ).sum() / wsum
+                total = total + vf * v_loss
+                metrics["vf_loss"] = v_loss
+            if kl_c:
+                # k3 estimator vs the behavior policy: non-negative,
+                # low-variance (the GRPO-paper form)
+                d = blp - logp
+                kl = ((jnp.exp(d) - d - 1.0) * w).sum() / wsum
+                total = total + kl_c * kl
+                metrics["kl"] = kl
+            if ent_c:
+                p = jnp.exp(logp_all)
+                ent = (-(p * logp_all).sum(-1) * w).sum() / wsum
+                total = total - ent_c * ent
+                metrics["entropy"] = ent
+            metrics["loss"] = total
+            return total, metrics
+
+        def step_fn(tp, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(tp, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, tp)
+            tp = optax.apply_updates(tp, updates)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return tp, opt_state, metrics
+
+        if mesh is not None and rules is not None:
+            # the existing sharded-train-step machinery: model leaves by
+            # the rule table, value head + scalars replicated, opt state
+            # matched by leaf shape, batch as a sharding prefix
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...train.step import (
+                _opt_shardings,
+                _param_shardings,
+                batch_sharding,
+            )
+
+            repl = NamedSharding(mesh, P())
+            tp_shard: Dict[str, Any] = {
+                "model": _param_shardings(mesh, rules, param_specs(cfg))
+            }
+            if algo == "ppo":
+                tp_shard["value_w"] = repl
+                tp_shard["value_b"] = repl
+            tp_shapes = jax.eval_shape(lambda t: t, train_params)
+            o_shapes = jax.eval_shape(optimizer.init, tp_shapes)
+            o_shard = _opt_shardings(o_shapes, tp_shapes, tp_shard, mesh)
+            b_shard = batch_sharding(mesh, rules)
+            self._step = jax.jit(
+                step_fn,
+                in_shardings=(tp_shard, o_shard, b_shard),
+                out_shardings=(tp_shard, o_shard, None),
+            )
+            self._values_fn = jax.jit(
+                lambda tp, tokens: _value(
+                    tp, _logp_and_hidden(tp["model"], tokens)[2]
+                ),
+                in_shardings=(tp_shard, b_shard),
+            ) if algo == "ppo" else None
+            self._train_params = jax.device_put(train_params, tp_shard)
+            self._opt_state = jax.device_put(self._opt_state, o_shard)
+        else:
+            self._step = jax.jit(step_fn)
+            self._values_fn = (
+                jax.jit(
+                    lambda tp, tokens: _value(
+                        tp, _logp_and_hidden(tp["model"], tokens)[2]
+                    )
+                )
+                if algo == "ppo"
+                else None
+            )
+
+        # engine-parity logprob probe (tests, diagnostics): logp [N, T]
+        self._logp_fn = jax.jit(
+            lambda mp, tokens: _logp_and_hidden(mp, tokens)[0]
+        )
+
+    # ----------------------------------------------------------------- api
+
+    @property
+    def params(self):
+        """Current model params — the tree publishers ship."""
+        return self._train_params["model"]
+
+    def values(self, tokens: np.ndarray) -> np.ndarray:
+        """Critic values [N, T] for GAE (PPO only)."""
+        if self._values_fn is None:
+            raise RuntimeError("values() is PPO-only — GRPO has no critic")
+        return np.asarray(
+            self._values_fn(self._train_params, np.asarray(tokens, np.int32))
+        )
+
+    def policy_logp(self, tokens: np.ndarray) -> np.ndarray:
+        """Per-position logprobs [N, T] under the CURRENT policy, engine
+        sampler semantics — the parity probe against behavior_logp."""
+        return np.asarray(
+            self._logp_fn(self.params, np.asarray(tokens, np.int32))
+        )
+
+    def update(
+        self, batch: Dict[str, np.ndarray], epochs: Optional[int] = None
+    ) -> Dict[str, float]:
+        """Run the clipped update `epochs` times over the batch; returns
+        the LAST epoch's metrics (floats)."""
+        required = ("tokens", "loss_mask", "behavior_logp", "advantages")
+        for k in required:
+            if k not in batch:
+                raise KeyError(f"update batch missing {k!r}")
+        if self.algo == "ppo" and "returns" not in batch:
+            raise KeyError("PPO update batch missing 'returns'")
+        feed = {
+            k: np.asarray(v)
+            for k, v in batch.items()
+            if k in required + ("returns",)
+        }
+        metrics: Dict[str, Any] = {}
+        for _ in range(int(epochs or self.epochs)):
+            self._train_params, self._opt_state, metrics = self._step(
+                self._train_params, self._opt_state, feed
+            )
+        self.updates += 1
+        return {k: float(v) for k, v in metrics.items()}

@@ -1,0 +1,187 @@
+"""Generation rollouts THROUGH the serving stack.
+
+The reference's env-stepping RolloutWorker (rollout_worker.py:166) samples
+by calling env.step in Python; a generation-based RL worker samples by
+GENERATING — so instead of a gym loop, LLMRolloutWorker drives the exact
+serving data path a live replica runs: ContinuousBatcher in front of a
+PagedDecodeEngine built with `logprobs=True`, which emits every sampled
+token as an atomic `(token_id, behavior_logprob)` pair. Rollout traffic
+therefore gets continuous batching, paged KV, chunked prefill and
+preemption/readmission for free, and — because the engine is the same
+class a serve Replica wraps — a WeightSubscriber (serve/weight_swap.py)
+can hot-swap learner weights under it between steps mid-experiment.
+
+The worker turns a prompt list into the padded batch layout the learner
+and advantages modules share:
+
+  tokens         [N, L] i32   prompt + response, right-padded
+  loss_mask      [N, T] f32   T = L-1, shifted axis: 1.0 where position t
+                              PREDICTS a response token (tokens[:, t+1])
+  behavior_logp  [N, T] f32   engine logprob of that token at sample time
+  rewards        [N]    f32   reward_fn(prompt_tokens, response_tokens)
+  group          [N]    i32   prompt index — GRPO's sample groups
+  prompt_len / response_len [N] i32
+
+GRPO's group_size submits each prompt group_size times; the engine's
+seeded sampler keeps runs reproducible, and per-request RNG streams give
+the group its diversity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...util.metrics import rl_reward_mean_gauge, rl_rollout_tokens_counter
+
+RewardFn = Callable[[np.ndarray, np.ndarray], float]
+
+
+class LLMRolloutWorker:
+    """Owns one serving stack (batcher + paged engine) and samples
+    experience batches from it.
+
+    `pad_to` fixes the token-grid length L so every rollout compiles the
+    learner's update exactly once (defaults to the worst case:
+    longest prompt + max_new_tokens, recomputed per call when prompts
+    vary). `reward_fn(prompt_tokens, response_tokens) -> float` is the
+    task: the only environment a generation-based RL run has."""
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        reward_fn: RewardFn,
+        *,
+        group_size: int = 1,
+        max_new_tokens: int = 16,
+        temperature: float = 1.0,
+        seed: int = 0,
+        mesh=None,
+        rules=None,
+        max_batch_size: Optional[int] = None,
+        pad_to: Optional[int] = None,
+        deployment: str = "rl_llm",
+        replica: str = "rollout0",
+        telemetry=False,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        # serving-stack imports stay lazy so `import ray_tpu.rl` does not
+        # drag the serve package in (mirrors the engine's own discipline)
+        from ...models.kv_paging import PagedDecodeEngine
+        from ...serve.batching import ContinuousBatcher
+
+        if group_size < 1:
+            raise ValueError(f"group_size must be >= 1, got {group_size}")
+        kw = dict(engine_kwargs or {})
+        kw.setdefault("speculative_k", 0)  # logprobs need per-step logits
+        if max_batch_size is not None:
+            kw.setdefault("max_batch_size", max_batch_size)
+        self.engine = PagedDecodeEngine(
+            cfg,
+            params,
+            temperature=temperature,
+            logprobs=True,
+            default_max_new_tokens=max_new_tokens,
+            seed=seed,
+            mesh=mesh,
+            rules=rules,
+            telemetry=telemetry,
+            **kw,
+        )
+        self.batcher = ContinuousBatcher(self.engine, telemetry=telemetry)
+        self.reward_fn = reward_fn
+        self.group_size = int(group_size)
+        self.max_new_tokens = int(max_new_tokens)
+        self.pad_to = pad_to
+        self._tags = {"deployment": deployment, "replica": replica}
+        self._tokens_total = rl_rollout_tokens_counter()
+        self._reward_gauge = rl_reward_mean_gauge()
+        self.rollouts = 0
+
+    # ------------------------------------------------------------- weights
+
+    def set_params(self, params, version: Optional[int] = None) -> int:
+        """Adopt new policy weights between engine steps (the learner's
+        post-update sync). Runs on the batcher loop thread — the same
+        swap point a live replica's WeightSubscriber uses."""
+        return self.batcher.run_on_loop(
+            lambda: self.engine.set_params(params, version=version)
+        )
+
+    @property
+    def weight_version(self) -> int:
+        return int(getattr(self.engine, "weight_version", 0))
+
+    # ------------------------------------------------------------- rollout
+
+    def rollout(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        max_new_tokens: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Sample group_size responses per prompt; returns the padded
+        batch dict (layout in the module docstring)."""
+        mnt = int(max_new_tokens or self.max_new_tokens)
+        streams: List[tuple] = []
+        for gi, p in enumerate(prompts):
+            toks = np.asarray(p, np.int32).reshape(-1)
+            for _ in range(self.group_size):
+                streams.append((
+                    gi, toks,
+                    self.batcher.submit(tokens=toks, max_new_tokens=mnt),
+                ))
+        rows = []
+        for gi, toks, stream in streams:
+            pairs: List[tuple] = []
+            while True:
+                items, done = stream.next_batch(max_items=512, wait_s=10.0)
+                pairs.extend(items)
+                if done:
+                    break
+            resp = np.asarray([t for t, _ in pairs], np.int32)
+            blp = np.asarray([lp for _, lp in pairs], np.float32)
+            reward = float(self.reward_fn(toks, resp))
+            rows.append((gi, toks, resp, blp, reward))
+
+        N = len(rows)
+        longest = max(r[1].size + r[2].size for r in rows)
+        L = max(int(self.pad_to or 0), longest, 2)
+        T = L - 1
+        tokens = np.zeros((N, L), np.int32)
+        loss_mask = np.zeros((N, T), np.float32)
+        behavior_logp = np.zeros((N, T), np.float32)
+        rewards = np.zeros(N, np.float32)
+        group = np.zeros(N, np.int32)
+        prompt_len = np.zeros(N, np.int32)
+        response_len = np.zeros(N, np.int32)
+        for i, (gi, toks, resp, blp, reward) in enumerate(rows):
+            pl, rl = toks.size, resp.size
+            tokens[i, :pl] = toks
+            tokens[i, pl:pl + rl] = resp
+            # response token j lives at index pl+j, predicted at t=pl+j-1
+            loss_mask[i, pl - 1:pl - 1 + rl] = 1.0
+            behavior_logp[i, pl - 1:pl - 1 + rl] = blp
+            rewards[i] = reward
+            group[i] = gi
+            prompt_len[i] = pl
+            response_len[i] = rl
+
+        total_resp = int(response_len.sum())
+        self._tokens_total.inc(total_resp, tags=self._tags)
+        self._reward_gauge.set(float(rewards.mean()), tags=self._tags)
+        self.rollouts += 1
+        return {
+            "tokens": tokens,
+            "loss_mask": loss_mask,
+            "behavior_logp": behavior_logp,
+            "rewards": rewards,
+            "group": group,
+            "prompt_len": prompt_len,
+            "response_len": response_len,
+        }
+
+    def close(self) -> None:
+        self.batcher.close()

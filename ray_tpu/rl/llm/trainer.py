@@ -1,0 +1,169 @@
+"""The generation-RL driver: rollout -> advantages -> update -> weight sync.
+
+One `step()` is the full PPO/GRPO iteration:
+
+  1. the rollout worker samples group_size responses per prompt through
+     the serving stack (behavior logprobs ride the token stream),
+  2. advantages: GAE against the learner's value head (PPO) or
+     group-relative normalized rewards (GRPO),
+  3. the learner runs the clipped update,
+  4. the new weights reach the sampler — DIRECTLY (set_params between
+     engine steps) by default, or through the live weight plane when a
+     WeightPublisher is given: the learner publishes a version, serving
+     replicas' WeightSubscribers pull and hot-swap on their own, and the
+     local rollout worker adopts the same version so behavior policy and
+     published version never diverge.
+
+That last arm is the on-policy contract: every rollout batch is sampled
+by the weights of the update that precedes it, so `behavior_logp` is the
+current policy's logprob on epoch one and the importance ratio starts at
+1.0 exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .advantages import gae_advantages, grpo_advantages, normalize_advantages
+from .learner import ALGOS, LLMLearner
+from .rollout import LLMRolloutWorker, RewardFn
+
+
+class GenerationRLTrainer:
+    """PPO ('ppo') / GRPO ('grpo') over a fixed prompt set.
+
+    With `publisher` (serve/weight_swap.WeightPublisher) every update
+    also publishes a bulk-plane weight version for subscribing replicas;
+    without one the trainer is fully local (no cluster needed)."""
+
+    def __init__(
+        self,
+        cfg,
+        reward_fn: RewardFn,
+        prompts: Sequence[Sequence[int]],
+        *,
+        algo: str = "grpo",
+        params=None,
+        seed: int = 0,
+        group_size: int = 4,
+        max_new_tokens: int = 8,
+        temperature: float = 1.0,
+        lr: float = 3e-3,
+        epochs: int = 1,
+        clip_ratio: float = 0.2,
+        vf_coef: float = 0.5,
+        entropy_coef: float = 0.0,
+        kl_coef: float = 0.0,
+        gamma: float = 1.0,
+        gae_lambda: float = 0.95,
+        normalize_adv: Optional[bool] = None,
+        mesh=None,
+        rules=None,
+        publisher=None,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        deployment: str = "rl_llm",
+        replica: str = "rollout0",
+    ):
+        import jax
+
+        from ...models.transformer import init_params
+
+        if algo not in ALGOS:
+            raise ValueError(f"algo must be one of {ALGOS}, got {algo!r}")
+        if algo == "grpo" and group_size < 2:
+            raise ValueError(
+                "GRPO is group-RELATIVE: group_size must be >= 2"
+            )
+        self.algo = algo
+        self.prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        self.gamma = float(gamma)
+        self.gae_lambda = float(gae_lambda)
+        # GRPO advantages arrive normalized per group; whitening again
+        # across the batch would fight that. PPO whitens by default.
+        self.normalize_adv = (
+            (algo == "ppo") if normalize_adv is None else bool(normalize_adv)
+        )
+        self.publisher = publisher
+
+        if params is None:
+            params = init_params(jax.random.PRNGKey(seed), cfg)
+        self.learner = LLMLearner(
+            cfg,
+            params,
+            algo=algo,
+            temperature=temperature,
+            lr=lr,
+            clip_ratio=clip_ratio,
+            vf_coef=vf_coef,
+            entropy_coef=entropy_coef,
+            kl_coef=kl_coef,
+            epochs=epochs,
+            mesh=mesh,
+            rules=rules,
+        )
+        longest = max(p.size for p in self.prompts)
+        self.worker = LLMRolloutWorker(
+            cfg,
+            self.learner.params,
+            reward_fn,
+            group_size=group_size,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            seed=seed,
+            mesh=mesh,
+            rules=rules,
+            # fixed grid: one compile of the update for the whole run
+            pad_to=longest + int(max_new_tokens),
+            deployment=deployment,
+            replica=replica,
+            engine_kwargs=engine_kwargs,
+        )
+        self.iteration = 0
+        self.history: List[Dict[str, float]] = []
+
+    def step(self) -> Dict[str, float]:
+        """One rollout->update->sync iteration; returns its metrics."""
+        batch = self.worker.rollout(self.prompts)
+        if self.algo == "grpo":
+            adv = grpo_advantages(
+                batch["rewards"], batch["group"], batch["loss_mask"]
+            )
+        else:
+            values = self.learner.values(batch["tokens"])
+            adv, ret = gae_advantages(
+                batch["rewards"],
+                values,
+                batch["loss_mask"],
+                gamma=self.gamma,
+                lam=self.gae_lambda,
+            )
+            batch["returns"] = ret
+        if self.normalize_adv:
+            adv = normalize_advantages(adv, batch["loss_mask"])
+        batch["advantages"] = adv
+
+        metrics = self.learner.update(batch)
+
+        version: Optional[int] = None
+        if self.publisher is not None:
+            version = self.publisher.publish(self.learner.params)
+        self.worker.set_params(self.learner.params, version=version)
+
+        self.iteration += 1
+        metrics.update(
+            reward_mean=float(batch["rewards"].mean()),
+            reward_max=float(batch["rewards"].max()),
+            response_tokens=float(batch["response_len"].sum()),
+            weight_version=float(self.worker.weight_version),
+            iteration=float(self.iteration),
+        )
+        self.history.append(metrics)
+        return metrics
+
+    def train(self, iterations: int) -> List[Dict[str, float]]:
+        return [self.step() for _ in range(int(iterations))]
+
+    def close(self) -> None:
+        self.worker.close()

@@ -44,6 +44,11 @@ from .kv_transfer import (  # noqa: F401
     deploy_generation,
     prefix_hint,
 )
+from .weight_swap import (  # noqa: F401
+    WeightPublisher,
+    WeightSubscriber,
+    WeightSwapError,
+)
 
 _PROXY_NAME = "SERVE_HTTP_PROXY"
 

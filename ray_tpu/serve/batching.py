@@ -527,7 +527,8 @@ class ContinuousBatcher:
                           "spec_k", "spec_steps", "spec_slot_steps",
                           "spec_proposed_tokens", "spec_accepted_tokens",
                           "spec_emitted_tokens", "spec_accept_rate",
-                          "spec_tokens_per_step"):
+                          "spec_tokens_per_step",
+                          "weight_version", "weight_swaps"):
                     if k in es:
                         out[k] = es[k]
         return out
@@ -848,8 +849,10 @@ class ContinuousBatcher:
                     continue
                 # multi-token retirement: a speculative verify step may
                 # emit a burst of accepted tokens — push each one so the
-                # stream (and its SSE consumer) sees them all in order
-                for t in (tok if isinstance(tok, (list, tuple)) else (tok,)):
+                # stream (and its SSE consumer) sees them all in order.
+                # Only LISTS fan out: a tuple is one atomic item — the
+                # (token, logprob) pair a logprobs=True engine emits
+                for t in (tok if isinstance(tok, list) else (tok,)):
                     stream._push(t)
                 if done:
                     stream._finish()

@@ -32,6 +32,12 @@ def prefix_channel(deployment_name: str) -> str:
     return f"serve:prefix:{deployment_name}"
 
 
+def weights_channel(deployment_name: str) -> str:
+    # live weight plane (serve/weight_swap.py): the publisher pushes each
+    # version's manifest here; replica-side watchers long-poll it
+    return f"serve:weights:{deployment_name}"
+
+
 class ReplicaWatcher:
     """Daemon thread long-polling one deployment's replica channel.
 

@@ -173,12 +173,18 @@ class ServeTelemetry:
             "serve_prefix_remote_hit_rate",
             "remote pulls installed / remote pulls attempted (cumulative)",
             tag_keys=base)
+        # live weight plane (serve/weight_swap.py): the version the
+        # engine is CURRENTLY serving — advances mid-stream on a hot swap
+        self.weight_version = Gauge(
+            "serve_weight_version",
+            "learner weight version the replica's engine is serving",
+            tag_keys=base)
         self._all = [
             self.ttft, self.inter_token, self.queue_wait,
             self.request_latency, self.engine_step, self.requests,
             self.preemptions, self.tokens, self.kv_util, self.occupancy,
             self.spec_accept, self.kv_transfer_bytes, self.kv_transfer_hits,
-            self.prefix_remote_hit_rate,
+            self.prefix_remote_hit_rate, self.weight_version,
         ]
         self._last_push = 0.0
         self._last_push_total = -1  # recorder.total at the last push

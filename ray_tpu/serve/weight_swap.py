@@ -1,0 +1,389 @@
+"""Live weight hot-swap: the learner->replica weight plane.
+
+Reference parity: Serve's in-place deployment updates + the weight-sync
+half of RLlib's new-stack Learner (learner pushes versioned weights,
+samplers adopt them without a restart) — rebuilt TPU-native on two planes
+this repo already has:
+
+  publish   `WeightPublisher.publish(params)` flattens the param tree to
+            leaves, ships every leaf as BULK-PLANE objects (`ray_tpu.put`;
+            leaves larger than serve_weight_chunk_mb split into chunks so
+            pulls stripe across senders and one giant embedding can never
+            serialize the swap), and pushes a version MANIFEST — leaf
+            paths, shapes, dtypes, sha1 digests, content-addressed
+            per-version keys, object refs — over the head's pubsub
+            channel `serve:weights:<deployment>` (long_poll.py
+            weights_channel). The manifest is tiny; the weights ride the
+            zero-copy slab senders like any other large object.
+
+  subscribe `WeightSubscriber` long-polls the channel (same daemon-thread
+            shape as long_poll.ReplicaWatcher), pulls the leaves, verifies
+            EVERY leaf (length + sha1 — a truncated or corrupt pull fails
+            verification, the swap aborts whole, and the replica keeps
+            serving its previous version intact: never a half-swapped
+            tree; counted in `weight_swap_fallbacks_total`), re-places
+            each leaf by the REPLICA'S OWN partition rules (device_put
+            onto the current leaf's sharding — a dp=8 learner can feed a
+            tp=4 replica), and swaps between engine steps via
+            `ContinuousBatcher.run_on_loop(engine.set_params)`.
+
+Swap semantics (PagedDecodeEngine.set_params): live slots are preempted
+and readmitted so their continuations recompute under the new weights —
+in-flight streams survive (no drop, added latency only) and every
+post-swap token is greedy-identical to a fresh engine loaded with the new
+weights. The prefix cache flushes and the transfer signature re-derives
+with the new version, so KV minted under old weights — local or
+cross-replica — can never serve new-weight traffic (stale chain keys are
+disjoint by construction, not merely checked).
+
+Fault injection: the `weight_swap_drop:<nth|rand:p>` directive
+(_private/faults.py) truncates the selected pull before verification —
+the chaos suite proves the old version keeps serving.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .._private.config import GLOBAL_CONFIG
+from .._private import faults
+from ..util import pubsub
+from ..util.metrics import weight_swap_fallbacks_counter
+from .long_poll import weights_channel
+
+# wire-format identity: bumped only on incompatible manifest changes
+WEIGHT_WIRE_SIG = "ray_tpu.weight_swap.v1"
+
+
+class WeightSwapError(RuntimeError):
+    """A pulled version failed verification (truncated/corrupt leaf,
+    manifest mismatch). The subscriber catches it: the OLD version keeps
+    serving and the failure counts as a fallback, never a half-swap."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends live in ml_dtypes (a jax dependency); their
+        # names register with numpy on import
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _flatten_with_paths(params) -> Tuple[List[str], List[Any], Any]:
+    """Stable (path, leaf) flattening. Paths are the cross-process leaf
+    identity: the subscriber rebuilds against ITS OWN tree structure by
+    path match, so no treedef ever rides the wire."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    return paths, [leaf for _, leaf in flat], treedef
+
+
+def content_key(deployment: str, version: int, path: str) -> str:
+    """Content-addressed per-version leaf key: two publishers of the same
+    deployment mint identical keys for identical (version, leaf), and keys
+    from different versions/deployments are disjoint by construction —
+    the weight-plane analogue of the KV plane's transfer_keys chain."""
+    h = hashlib.sha1()
+    h.update(f"{WEIGHT_WIRE_SIG}|{deployment}|v{int(version)}|{path}".encode())
+    return h.hexdigest()
+
+
+class WeightPublisher:
+    """Learner-side half: ships a param tree as versioned bulk-plane
+    objects + a pubsub manifest. One publisher per deployment per learner
+    process; publish() is cheap relative to a train step (host gather +
+    N object puts).
+
+    The publisher RETAINS the refs of the last two published versions:
+    pubsub is snapshot-semantics (late subscribers see only the latest
+    manifest) but a replica may still be mid-pull on version N when N+1
+    publishes — dropping N's refs under it would turn a healthy swap into
+    a fallback."""
+
+    def __init__(
+        self,
+        deployment: str,
+        *,
+        chunk_bytes: Optional[int] = None,
+        model_id: str = "",
+    ):
+        self.deployment = str(deployment)
+        self.model_id = str(model_id)
+        if chunk_bytes is None:
+            chunk_bytes = int(GLOBAL_CONFIG.serve_weight_chunk_mb) * (1 << 20)
+        self.chunk_bytes = int(chunk_bytes)
+        self.version = 0
+        self.published_bytes = 0
+        self._retained: List[Tuple[int, List[Any]]] = []
+
+    def publish(self, params, version: Optional[int] = None) -> int:
+        """Ship `params` as the next version; returns the version number.
+        Blocks until every leaf is in the object store (so the manifest
+        never references objects that do not exist yet)."""
+        import ray_tpu
+
+        version = int(version) if version is not None else self.version + 1
+        if version <= self.version:
+            raise ValueError(
+                f"version must advance: have {self.version}, got {version}"
+            )
+        paths, leaves, _ = _flatten_with_paths(params)
+        entries: List[Dict[str, Any]] = []
+        refs_live: List[Any] = []
+        total = 0
+        cb = self.chunk_bytes
+        for path, leaf in zip(paths, leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            buf = arr.tobytes()
+            n = len(buf)
+            if cb > 0 and n > cb:
+                chunks = [buf[i:i + cb] for i in range(0, n, cb)]
+            else:
+                chunks = [buf]
+            refs = [ray_tpu.put(c) for c in chunks]
+            refs_live.extend(refs)
+            entries.append({
+                "path": path,
+                "key": content_key(self.deployment, version, path),
+                "shape": tuple(int(d) for d in arr.shape),
+                "dtype": str(arr.dtype),
+                "nbytes": n,
+                "sha1": hashlib.sha1(buf).hexdigest(),
+                "refs": refs,
+            })
+            total += n
+        manifest = {
+            "sig": WEIGHT_WIRE_SIG,
+            "deployment": self.deployment,
+            "model_id": self.model_id,
+            "version": version,
+            "total_bytes": total,
+            "entries": entries,
+        }
+        pubsub.publish(weights_channel(self.deployment), manifest)
+        self.version = version
+        self.published_bytes += total
+        self._retained.append((version, refs_live))
+        while len(self._retained) > 2:
+            self._retained.pop(0)
+        return version
+
+
+def pull_manifest(manifest: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], int]:
+    """Pull + verify every leaf of a published version. Returns
+    ({path: host array}, bytes pulled). Raises WeightSwapError on ANY
+    verification failure — all-or-nothing is the whole contract.
+
+    The `weight_swap_drop` fault directive hooks here: a selected pull
+    truncates one leaf's bytes before verification, which is
+    indistinguishable from a mid-flight sender death — exactly the
+    failure the abort-whole path exists for."""
+    import ray_tpu
+
+    if not isinstance(manifest, dict) or manifest.get("sig") != WEIGHT_WIRE_SIG:
+        raise WeightSwapError(f"bad manifest sig: {manifest!r:.80}")
+    drop = faults.weight_swap_action() if faults.ACTIVE else None
+    out: Dict[str, np.ndarray] = {}
+    total = 0
+    for i, entry in enumerate(manifest["entries"]):
+        bufs = ray_tpu.get(list(entry["refs"]))
+        data = b"".join(bufs)
+        if drop == "drop" and i == 0:
+            data = data[: len(data) // 2]
+        if len(data) != int(entry["nbytes"]):
+            raise WeightSwapError(
+                f"leaf {entry['path']} truncated: {len(data)} of "
+                f"{entry['nbytes']} bytes"
+            )
+        if hashlib.sha1(data).hexdigest() != entry["sha1"]:
+            raise WeightSwapError(f"leaf {entry['path']} digest mismatch")
+        arr = np.frombuffer(data, _np_dtype(entry["dtype"]))
+        out[entry["path"]] = arr.reshape(entry["shape"])
+        total += len(data)
+    return out, total
+
+
+class WeightSubscriber:
+    """Replica-side half: adopt published versions into one engine.
+
+    With `batcher` given, the swap executes on the batcher's loop thread
+    (run_on_loop) BETWEEN engine steps — the only thread allowed to touch
+    admit/step state. Without one (bare-engine rollout workers), the
+    caller owns the engine's threading and apply() swaps directly.
+
+    `start()` (or auto_start=True with the serve_weight_swap flag on)
+    spawns a daemon watcher thread long-polling the weights channel —
+    the long_poll.ReplicaWatcher shape, one per subscriber because each
+    adopts into its own engine."""
+
+    def __init__(
+        self,
+        engine,
+        deployment: str,
+        *,
+        batcher=None,
+        auto_start: bool = False,
+        poll_timeout_s: Optional[float] = None,
+        swap_timeout_s: float = 60.0,
+    ):
+        self.engine = engine
+        self.batcher = batcher
+        self.deployment = str(deployment)
+        self.channel = weights_channel(self.deployment)
+        self.version = int(getattr(engine, "weight_version", 0))
+        self.swaps = 0
+        self.fallbacks = 0
+        self.bytes_pulled = 0
+        self._fallback_counter = weight_swap_fallbacks_counter()
+        self._poll_timeout = float(
+            GLOBAL_CONFIG.serve_weight_poll_timeout_s
+            if poll_timeout_s is None else poll_timeout_s
+        )
+        self._swap_timeout = float(swap_timeout_s)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        tel = getattr(engine, "_tel", None)
+        self._rec = tel.recorder if tel is not None else None
+        if auto_start and bool(GLOBAL_CONFIG.serve_weight_swap):
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "WeightSubscriber":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"weight-swap:{self.channel}",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = pubsub.poll(
+                    self.channel, self._seq, timeout=self._poll_timeout
+                )
+            except Exception:
+                # head unreachable / shutting down: back off, re-arm
+                self._stop.wait(1.0)
+                continue
+            if item is None:
+                continue
+            self._seq, manifest = item
+            try:
+                self.apply(manifest)
+            except Exception:
+                # apply() already accounted the fallback; a bug in the
+                # swap path must not kill the watcher
+                pass
+
+    def poll_once(self, timeout: float = 0.0) -> bool:
+        """One synchronous poll+apply (tests, manual adoption). Returns
+        True when a NEW version was adopted."""
+        item = pubsub.poll(self.channel, self._seq, timeout=timeout)
+        if item is None:
+            return False
+        self._seq, manifest = item
+        return self.apply(manifest)
+
+    # ------------------------------------------------------------- adoption
+
+    def _rebuild(self, by_path: Dict[str, np.ndarray]):
+        """Reassemble the pulled leaves into THIS engine's tree structure
+        and placement: path-match against the current params, device_put
+        each leaf onto the current leaf's sharding (= the replica's own
+        partition rules — the learner's layout never leaks in)."""
+        import jax
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self.engine.params
+        )
+        have = {jax.tree_util.keystr(p) for p, _ in flat}
+        want = set(by_path)
+        if have != want:
+            missing = sorted(want ^ have)[:4]
+            raise WeightSwapError(
+                f"param tree mismatch (paths differ, e.g. {missing})"
+            )
+        new_leaves = []
+        for p, cur in flat:
+            arr = by_path[jax.tree_util.keystr(p)]
+            if tuple(arr.shape) != tuple(np.shape(cur)):
+                raise WeightSwapError(
+                    f"leaf {jax.tree_util.keystr(p)} shape "
+                    f"{tuple(arr.shape)} != engine's {tuple(np.shape(cur))}"
+                )
+            sharding = getattr(cur, "sharding", None)
+            if sharding is not None:
+                new_leaves.append(jax.device_put(arr, sharding))
+            else:
+                new_leaves.append(jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def apply(self, manifest: Dict[str, Any]) -> bool:
+        """Adopt one published version; returns True on a swap, False
+        when the manifest is stale or the pull failed verification (the
+        fallback: OLD version keeps serving, counted)."""
+        with self._lock:
+            version = int(manifest.get("version", 0) or 0)
+            if version <= self.version:
+                return False
+            t0 = time.monotonic()
+            try:
+                by_path, nbytes = pull_manifest(manifest)
+                tree = self._rebuild(by_path)
+            except Exception as e:  # noqa: BLE001 — any failure = fallback
+                self.fallbacks += 1
+                self._fallback_counter.inc()
+                if self._rec is not None:
+                    self._rec.record(
+                        "weight_swap_fallback",
+                        dur=time.monotonic() - t0,
+                        args={"version": version, "error": repr(e)[:160]},
+                    )
+                return False
+
+            def _swap():
+                return self.engine.set_params(
+                    tree, version=version, bytes_pulled=nbytes
+                )
+
+            if self.batcher is not None:
+                self.batcher.run_on_loop(_swap, timeout_s=self._swap_timeout)
+            else:
+                _swap()
+            self.version = version
+            self.swaps += 1
+            self.bytes_pulled += nbytes
+            if self._rec is not None:
+                self._rec.record(
+                    "weight_pull", dur=time.monotonic() - t0,
+                    args={"version": version, "bytes": nbytes},
+                )
+            return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "weight_version": self.version,
+            "weight_swaps": self.swaps,
+            "weight_swap_fallbacks": self.fallbacks,
+            "weight_bytes_pulled": self.bytes_pulled,
+        }

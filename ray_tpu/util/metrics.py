@@ -293,6 +293,41 @@ def kv_transfer_fallbacks_counter() -> Counter:
     )
 
 
+def weight_swap_fallbacks_counter() -> Counter:
+    """Live weight swaps abandoned with the OLD version left serving —
+    a leaf pull failed, arrived truncated/corrupt, or the manifest did
+    not verify (serve/weight_swap.py). The invariant the counter guards:
+    a replica serves version N or version N+1 in full, never a
+    half-swapped tree."""
+    return Counter(
+        "weight_swap_fallbacks_total",
+        "weight pulls that failed verification and left the replica on "
+        "its previous (intact) weight version",
+        tag_keys=(),
+    )
+
+
+def rl_rollout_tokens_counter() -> Counter:
+    """Tokens sampled through the serving engine by generation-based RL
+    rollouts (rl/llm), tagged like the serve metrics so a dashboard can
+    split rollout traffic from user traffic per deployment/replica."""
+    return Counter(
+        "rl_rollout_tokens_total",
+        "tokens generated by rl/llm rollout workers",
+        tag_keys=("deployment", "replica"),
+    )
+
+
+def rl_reward_mean_gauge() -> Gauge:
+    """Mean reward of the latest rl/llm rollout batch — the
+    one-glance learning signal on the push registry."""
+    return Gauge(
+        "rl_reward_mean",
+        "mean reward over the most recent rl/llm rollout batch",
+        tag_keys=("deployment", "replica"),
+    )
+
+
 def local_counter_by_tag(name: str, tag_key: str) -> Dict[str, float]:
     """THIS process's counter totals grouped by one tag's value (stats
     surfaces, no cluster round trip). Empty dict when absent/never inc'd."""
